@@ -1,0 +1,29 @@
+"""kubeflow_tpu — a TPU-native ML platform framework.
+
+A ground-up, TPU-first rebuild of the capabilities of early Kubeflow
+(reference: cjimti/kubeflow v0.1.x): deployable training operators,
+distributed SPMD compute, model serving, notebooks, and storage plumbing —
+with the weight inverted.  In the reference, the "framework" is jsonnet
+config-generation orchestrating external C++/Go binaries (tf-operator,
+tensorflow_model_server, OpenMPI).  Here the numerical runtime
+(JAX/XLA SPMD over TPU pod slices) is first-party code, and the
+orchestration surface (CRDs, prototypes, gang scheduling) is re-designed
+around slice topologies instead of PS/gRPC/NCCL.
+
+Layout (mirrors SURVEY.md layer map):
+  config/    typed parameter & prototype system  (heir of ksonnet @param layer)
+  manifests/ Kubernetes manifest generation      (heir of kubeflow/*.libsonnet)
+  operator/  TPUJob reconciler + gang scheduler  (heir of tf-operator manifests)
+  runtime/   worker bootstrap, trainer, checkpoint, metrics, elasticity
+  parallel/  device mesh, sharding rules, collectives, ring attention, pipeline
+  ops/       Pallas TPU kernels + numerics
+  models/    first-party reference models (ResNet-50, Inception-v3, Transformer)
+  serving/   export, model server, REST<->gRPC-contract proxy, batching
+  data/      input pipeline (C++ prefetch core + python API)
+  tools/     launcher / bootstrap CLI            (heir of launcher.py, bootstrap/)
+  testing/   CI harness utilities (JUnit, workflow DAG)
+"""
+
+from kubeflow_tpu.version import __version__, version_info
+
+__all__ = ["__version__", "version_info"]

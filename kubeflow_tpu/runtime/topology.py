@@ -1,0 +1,147 @@
+"""TPU slice topology model.
+
+The reference scheduled onto anonymous GPU nodes via `nvidia.com/gpu` counts
+(kubeflow/tf-job/tf-job.libsonnet:19-27); a TPU pod slice is different — it is
+an *indivisible* gang of hosts wired by ICI, and the scheduler must place all
+workers of a job onto one slice (or a set of slices joined by DCN) or none.
+This module is the single source of truth for slice shapes used by the
+operator (gang sizing), the parallel library (mesh construction), and the
+manifests (node selectors / resource requests).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import re
+from typing import Dict, List, Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class SliceTopology:
+    """One TPU pod-slice shape.
+
+    chips: total TPU chips in the slice.
+    hosts: number of worker VMs (k8s pods) the slice spans; chips are evenly
+      divided across hosts — `chips_per_host` is the gang replica's TPU
+      resource request.
+    ici_mesh: physical ICI torus dims (x, y, z); collectives within the slice
+      ride this fabric, cross-slice traffic rides DCN.
+    cores_per_chip: 2 for v4/v5p (fused into one device under megacore),
+      1 for v5e.
+    """
+
+    name: str
+    generation: str
+    chips: int
+    hosts: int
+    ici_mesh: Tuple[int, ...]
+    cores_per_chip: int = 1
+    hbm_gib_per_chip: int = 16
+    bf16_tflops_per_chip: float = 197.0  # per-chip peak, used for MFU
+
+    @property
+    def chips_per_host(self) -> int:
+        return self.chips // self.hosts
+
+    @property
+    def devices(self) -> int:
+        """JAX device count the runtime will see across the whole slice."""
+        return self.chips
+
+    def k8s_node_selector(self) -> Dict[str, str]:
+        return {
+            "cloud.google.com/gke-tpu-accelerator": self.gke_accelerator(),
+            "cloud.google.com/gke-tpu-topology": "x".join(map(str, self.ici_mesh)),
+        }
+
+    def gke_accelerator(self) -> str:
+        return {
+            "v4": "tpu-v4-podslice",
+            "v5e": "tpu-v5-lite-podslice",
+            "v5p": "tpu-v5p-slice",
+            "v6e": "tpu-v6e-slice",
+        }[self.generation]
+
+
+def _v5e(chips: int, mesh: Tuple[int, ...], hosts: int) -> SliceTopology:
+    return SliceTopology(
+        name=f"v5e-{chips}", generation="v5e", chips=chips, hosts=hosts,
+        ici_mesh=mesh, cores_per_chip=1, hbm_gib_per_chip=16,
+        bf16_tflops_per_chip=197.0,
+    )
+
+
+def _v5p(chips: int, mesh: Tuple[int, ...], hosts: int) -> SliceTopology:
+    return SliceTopology(
+        name=f"v5p-{2 * chips}", generation="v5p", chips=chips, hosts=hosts,
+        ici_mesh=mesh, cores_per_chip=2, hbm_gib_per_chip=95,
+        bf16_tflops_per_chip=459.0,
+    )
+
+
+# Registry of supported slice shapes.  v5p names follow the cloud convention
+# of counting TensorCores (v5p-8 = 4 chips); v5e names count chips.
+_TOPOLOGIES: Dict[str, SliceTopology] = {}
+for topo in [
+    _v5e(1, (1, 1), 1),
+    _v5e(4, (2, 2), 1),
+    _v5e(8, (2, 4), 1),
+    _v5e(16, (4, 4), 4),
+    _v5e(32, (4, 8), 8),
+    _v5e(64, (8, 8), 16),
+    _v5e(128, (8, 16), 32),
+    _v5e(256, (16, 16), 64),
+    _v5p(4, (2, 2, 1), 1),     # v5p-8
+    _v5p(8, (2, 2, 2), 2),     # v5p-16
+    _v5p(16, (2, 2, 4), 4),    # v5p-32  <- BASELINE north-star slice
+    _v5p(32, (2, 4, 4), 8),    # v5p-64
+    _v5p(64, (4, 4, 4), 16),   # v5p-128
+    _v5p(128, (4, 4, 8), 32),  # v5p-256
+]:
+    _TOPOLOGIES[topo.name] = topo
+
+
+def get_topology(name: str) -> SliceTopology:
+    try:
+        return _TOPOLOGIES[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown slice type {name!r}; known: {sorted(_TOPOLOGIES)}"
+        ) from None
+
+
+def list_topologies() -> List[str]:
+    return sorted(_TOPOLOGIES)
+
+
+def parse_slice_type(name: str) -> SliceTopology:
+    """Accept either a registered name (v5p-32) or gen-NxM form (v5e-4x4)."""
+    if name in _TOPOLOGIES:
+        return _TOPOLOGIES[name]
+    match = re.fullmatch(r"(v\d+[ep]?)-(\d+(?:x\d+)*)", name)
+    if match and "x" in match.group(2):
+        gen = match.group(1)
+        mesh = tuple(int(d) for d in match.group(2).split("x"))
+        chips = math.prod(mesh)
+        for topo in _TOPOLOGIES.values():
+            if topo.generation == gen and topo.ici_mesh == mesh:
+                return topo
+        raise ValueError(f"unsupported topology {name!r} ({gen}, {mesh}, {chips} chips)")
+    raise ValueError(
+        f"unknown slice type {name!r}; known: {sorted(_TOPOLOGIES)}"
+    )
+
+
+def fake_slice(n_devices: int, hosts: int = 1) -> SliceTopology:
+    """A synthetic topology for CPU fake-slice testing.
+
+    The reference could not test multi-worker GPU paths without hardware
+    (SURVEY.md §4); we can — JAX's `--xla_force_host_platform_device_count`
+    gives an n-device CPU "slice" with the same SPMD semantics.
+    """
+    return SliceTopology(
+        name=f"fake-{n_devices}", generation="v5e", chips=n_devices,
+        hosts=hosts, ici_mesh=(n_devices,), cores_per_chip=1,
+        hbm_gib_per_chip=16, bf16_tflops_per_chip=197.0,
+    )

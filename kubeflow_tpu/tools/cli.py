@@ -1,0 +1,185 @@
+"""kubeflow-tpu CLI — the deployment workflow surface.
+
+Heir of the reference's ks workflow (README.md:93-134, user_guide.md:366-410):
+
+  ks generate <proto> <name> --param=v   ->  kubeflow-tpu generate <proto> <name> --param v
+  ks param set <comp> <k> <v>            ->  kubeflow-tpu param set <comp> <k> <v>
+  ks show default                        ->  kubeflow-tpu show
+  ks apply default                       ->  kubeflow-tpu apply [--dry-run]
+  ks prototype describe <proto>          ->  kubeflow-tpu prototype describe <proto>
+
+App state is a plain JSON file (app.yaml equivalent) in the working
+directory, so the whole flow is inspectable and diffable.  The reference's
+arg-escaping wart (`--`-prefixed values broke ks, user_guide.md:395-397) is
+avoided by argparse's `--param key=value` form.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+from typing import Any, Dict, List
+
+import kubeflow_tpu.manifests  # noqa: F401 - registers prototypes
+from kubeflow_tpu.config import ParamError, default_registry
+from kubeflow_tpu.config.registry import App
+from kubeflow_tpu.manifests.base import to_yaml
+
+APP_FILE = "tpuflow.json"
+
+
+def _load_app(path: str) -> App:
+    app = App()
+    if os.path.exists(path):
+        with open(path) as f:
+            state = json.load(f)
+        app.namespace = state.get("namespace", "kubeflow")
+        for comp in state.get("components", []):
+            app.add(comp["prototype"], comp["name"], **comp["params"])
+    return app
+
+
+def _save_app(app: App, path: str) -> None:
+    with open(path, "w") as f:
+        json.dump({"namespace": app.namespace, "components": app.components},
+                  f, indent=2)
+        f.write("\n")
+
+
+def _parse_params(pairs: List[str]) -> Dict[str, Any]:
+    params: Dict[str, Any] = {}
+    for pair in pairs:
+        if "=" not in pair:
+            raise ParamError(f"--param must be key=value, got {pair!r}")
+        key, value = pair.split("=", 1)
+        params[key] = value
+    return params
+
+
+def cmd_init(args: argparse.Namespace) -> int:
+    app = App(namespace=args.namespace)
+    _save_app(app, args.app_file)
+    print(f"initialized {args.app_file} (namespace={args.namespace})")
+    return 0
+
+
+def cmd_generate(args: argparse.Namespace) -> int:
+    app = _load_app(args.app_file)
+    app.add(args.prototype, args.name, **_parse_params(args.param))
+    _save_app(app, args.app_file)
+    print(f"generated component {args.name} from prototype {args.prototype}")
+    return 0
+
+
+def cmd_param_set(args: argparse.Namespace) -> int:
+    app = _load_app(args.app_file)
+    app.set_param(args.component, args.key, args.value)
+    _save_app(app, args.app_file)
+    print(f"set {args.component}.{args.key} = {args.value}")
+    return 0
+
+
+def cmd_show(args: argparse.Namespace) -> int:
+    app = _load_app(args.app_file)
+    sys.stdout.write(to_yaml(app.render()))
+    return 0
+
+
+def cmd_apply(args: argparse.Namespace) -> int:
+    """Render and apply via kubectl — same final hop as the reference's
+    bootstrapper (`ks show default | kubectl apply -f -`,
+    bootstrap/cmd/bootstrap/app/server.go:514-533)."""
+    app = _load_app(args.app_file)
+    manifest = to_yaml(app.render())
+    if args.dry_run:
+        sys.stdout.write(manifest)
+        return 0
+    proc = subprocess.run(
+        ["kubectl", "apply", "-f", "-"], input=manifest.encode(),
+    )
+    return proc.returncode
+
+
+def cmd_prototype(args: argparse.Namespace) -> int:
+    if args.action == "list":
+        for name in default_registry.names():
+            proto = default_registry.get(name)
+            print(f"{name:24s} {proto.doc.splitlines()[0] if proto.doc else ''}")
+    else:
+        print(default_registry.get(args.prototype).describe())
+    return 0
+
+
+def cmd_version(args: argparse.Namespace) -> int:
+    from kubeflow_tpu.version import version_info
+
+    print(json.dumps(version_info()))
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="kubeflow-tpu",
+        description="Deploy and manage the TPU-native ML platform.",
+    )
+    parser.add_argument("--app-file", default=APP_FILE,
+                        help="app state file (default: %(default)s)")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p = sub.add_parser("init", help="create a new app")
+    p.add_argument("--namespace", default="kubeflow")
+    p.set_defaults(func=cmd_init)
+
+    p = sub.add_parser("generate", help="instantiate a prototype")
+    p.add_argument("prototype")
+    p.add_argument("name")
+    p.add_argument("--param", action="append", default=[],
+                   metavar="KEY=VALUE")
+    p.set_defaults(func=cmd_generate)
+
+    p = sub.add_parser("param", help="get/set component params")
+    psub = p.add_subparsers(dest="action", required=True)
+    pset = psub.add_parser("set")
+    pset.add_argument("component")
+    pset.add_argument("key")
+    pset.add_argument("value")
+    pset.set_defaults(func=cmd_param_set)
+
+    p = sub.add_parser("show", help="render manifests to stdout")
+    p.set_defaults(func=cmd_show)
+
+    p = sub.add_parser("apply", help="render and kubectl-apply")
+    p.add_argument("--dry-run", action="store_true")
+    p.set_defaults(func=cmd_apply)
+
+    p = sub.add_parser("prototype", help="inspect prototypes")
+    psub = p.add_subparsers(dest="action", required=True)
+    plist = psub.add_parser("list")
+    plist.set_defaults(func=cmd_prototype, action="list")
+    pdesc = psub.add_parser("describe")
+    pdesc.add_argument("prototype")
+    pdesc.set_defaults(func=cmd_prototype, action="describe")
+
+    p = sub.add_parser("version", help="print version info")
+    p.set_defaults(func=cmd_version)
+
+    return parser
+
+
+def main(argv: List[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    try:
+        return args.func(args)
+    except ParamError as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        return 2
+    except ValueError as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

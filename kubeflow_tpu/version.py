@@ -1,0 +1,17 @@
+"""Version introspection.
+
+Heir of the reference's version ConfigMap (kubeflow/core/version.libsonnet:1-15),
+which embedded a version-info.json into the cluster for deployed-version
+introspection; here the same dict is importable and also rendered into a
+ConfigMap by manifests/core.py.
+"""
+
+__version__ = "0.1.0"
+
+
+def version_info() -> dict:
+    return {
+        "version": __version__,
+        "framework": "kubeflow_tpu",
+        "accelerator": "tpu",
+    }

@@ -1,0 +1,38 @@
+"""Test configuration: fake-slice JAX backend.
+
+The reference could not test its multi-worker GPU paths without renting
+hardware (SURVEY.md §4 — it created GCE VMs per CI run).  We do better:
+every test runs on a virtual 8-device CPU "slice" via
+``--xla_force_host_platform_device_count``, so SPMD sharding, collectives,
+and gang logic are exercised hermetically.  bench.py intentionally does NOT
+import this — it runs on the real TPU chip.
+"""
+
+import os
+
+# Must be set before jax initializes its backends.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 fake-slice devices, got {len(devs)}"
+    return devs
+
+
+@pytest.fixture()
+def mesh8(devices):
+    """A 2x4 {data, model} mesh over the fake slice."""
+    import numpy as np
+    from jax.sharding import Mesh
+
+    return Mesh(np.array(devices).reshape(2, 4), ("data", "model"))

@@ -1,0 +1,151 @@
+"""Tests for slice topologies and the TPUJob spec model."""
+
+import pytest
+
+from kubeflow_tpu.operator.crd import (
+    MeshSpec,
+    SpecError,
+    TPUJobSpec,
+    WorkerSpec,
+)
+from kubeflow_tpu.runtime.topology import (
+    fake_slice,
+    get_topology,
+    list_topologies,
+    parse_slice_type,
+)
+
+
+class TestTopology:
+    def test_v5p_32_baseline_slice(self):
+        topo = get_topology("v5p-32")
+        assert topo.chips == 16
+        assert topo.hosts == 4
+        assert topo.chips_per_host == 4
+        assert topo.ici_mesh == (2, 2, 4)
+
+    def test_v5e_8_single_host(self):
+        topo = get_topology("v5e-8")
+        assert topo.hosts == 1 and topo.chips == 8
+
+    def test_parse_mesh_form(self):
+        assert parse_slice_type("v5e-4x4").name == "v5e-16"
+
+    def test_unknown_raises(self):
+        with pytest.raises(ValueError, match="unknown slice type"):
+            get_topology("v99-1")
+
+    def test_node_selector_targets_tpu(self):
+        sel = get_topology("v5p-32").k8s_node_selector()
+        assert sel["cloud.google.com/gke-tpu-accelerator"] == "tpu-v5p-slice"
+        assert sel["cloud.google.com/gke-tpu-topology"] == "2x2x4"
+
+    def test_registry_nonempty(self):
+        assert "v5e-8" in list_topologies()
+
+    def test_fake_slice(self):
+        assert fake_slice(8).chips == 8
+
+
+class TestMeshSpec:
+    def test_wildcard_resolution(self):
+        sizes = MeshSpec(data=-1, model=2).resolve(16)
+        assert sizes["data"] == 8 and sizes["model"] == 2
+
+    def test_exact_match(self):
+        sizes = MeshSpec(data=4, model=2, sequence=2).resolve(16)
+        assert sizes == {"data": 4, "fsdp": 1, "model": 2,
+                         "sequence": 2, "expert": 1}
+
+    def test_mismatch_raises(self):
+        with pytest.raises(SpecError, match="devices"):
+            MeshSpec(data=3).resolve(16)
+
+    def test_two_wildcards_raise(self):
+        with pytest.raises(SpecError, match="-1"):
+            MeshSpec(data=-1, model=-1).resolve(16)
+
+    def test_zero_axis_rejected(self):
+        with pytest.raises(SpecError, match=">= 1"):
+            MeshSpec(model=0).resolve(8)
+
+    def test_negative_axis_rejected(self):
+        with pytest.raises(SpecError, match=">= 1"):
+            MeshSpec(data=4, model=-2).resolve(8)
+
+
+class TestTPUJobSpec:
+    def test_worker_count_derived_from_slice(self):
+        job = TPUJobSpec(name="j", slice_type="v5p-32")
+        assert job.num_workers == 4       # one pod per slice host
+        assert job.num_devices == 16
+
+    def test_multislice(self):
+        job = TPUJobSpec(name="j", slice_type="v5p-32", num_slices=2)
+        assert job.num_workers == 8 and job.num_devices == 32
+
+    def test_invalid_mesh_rejected_at_admission(self):
+        with pytest.raises(SpecError):
+            TPUJobSpec(name="j", slice_type="v5e-8",
+                       mesh=MeshSpec(data=3, model=1))
+
+    def test_cr_roundtrip(self):
+        job = TPUJobSpec(
+            name="train", slice_type="v5e-16",
+            mesh=MeshSpec(data=-1, model=4),
+            worker=WorkerSpec(image="me:1", args=["--steps=5"]),
+        )
+        cr = job.to_custom_resource()
+        back = TPUJobSpec.from_custom_resource(cr)
+        assert back.name == "train"
+        assert back.mesh.model == 4
+        assert back.worker.args == ["--steps=5"]
+        assert back.topology.chips == 16
+
+    def test_camelcase_wire_schema(self):
+        """The CR wire schema is uniformly camelCase; parse accepts it and
+        rejects unknown fields with SpecError (admission error, not traceback)."""
+        job = TPUJobSpec(name="j", slice_type="v5e-8",
+                         worker=WorkerSpec(working_dir="/app"))
+        cr = job.to_custom_resource()
+        assert cr["spec"]["worker"]["workingDir"] == "/app"
+        assert cr["spec"]["restartPolicy"]["maxRestarts"] == 3
+        back = TPUJobSpec.from_custom_resource(cr)
+        assert back.worker.working_dir == "/app"
+
+    def test_unknown_worker_field_is_spec_error(self):
+        cr = {"metadata": {"name": "x"},
+              "spec": {"worker": {"image": "i", "wrokingDir": "/typo"}}}
+        with pytest.raises(SpecError, match="unknown field"):
+            TPUJobSpec.from_custom_resource(cr)
+
+    def test_zero_mesh_axis_in_cr_is_spec_error(self):
+        cr = {"metadata": {"name": "x"}, "spec": {"mesh": {"model": 0}}}
+        with pytest.raises(SpecError):
+            TPUJobSpec.from_custom_resource(cr)
+
+    def test_tfjob_compat_replicas(self):
+        """Reference-shaped TFJob replicaSpecs fold into the SPMD gang:
+        PS dropped, WORKER template adopted (kubeflow/tf-job/tf-job.libsonnet:45-57)."""
+        cr = {
+            "apiVersion": "kubeflow-tpu.org/v1alpha1",
+            "kind": "TPUJob",
+            "metadata": {"name": "legacy", "namespace": "kubeflow"},
+            "spec": {
+                "sliceType": "v5e-8",
+                "replicaSpecs": [
+                    {"tfReplicaType": "PS", "replicas": 2,
+                     "template": {"spec": {"containers": [
+                         {"image": "ps:1"}]}}},
+                    {"tfReplicaType": "WORKER", "replicas": 4,
+                     "template": {"spec": {"containers": [
+                         {"image": "worker:1",
+                          "args": ["--train"]}]}}},
+                ],
+            },
+        }
+        job = TPUJobSpec.from_custom_resource(cr)
+        assert job.worker.image == "worker:1"
+        assert job.worker.args == ["--train"]
+        # gang size comes from the slice, not the legacy replica counts
+        assert job.num_workers == 1
