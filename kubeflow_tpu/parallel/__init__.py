@@ -1,0 +1,41 @@
+"""Parallelism library: device meshes, sharding rules, collectives.
+
+TPU-native replacement for the reference's PS/MPI/NCCL distribution
+machinery (SURVEY.md §2.3-2.4): one mesh abstraction covers data, FSDP,
+pipeline, expert, sequence, and tensor parallelism, with collectives
+compiled by XLA onto ICI/DCN instead of daemons and hostfiles.
+"""
+
+from kubeflow_tpu.parallel.mesh import (
+    AXIS_ORDER,
+    DATA,
+    DEFAULT_RULES,
+    EXPERT,
+    FSDP,
+    PIPELINE,
+    SEQUENCE,
+    TENSOR,
+    MeshSpec,
+    batch_sharding,
+    constrain,
+    logical_spec,
+    named_sharding,
+    replicated,
+)
+
+__all__ = [
+    "AXIS_ORDER",
+    "DATA",
+    "FSDP",
+    "PIPELINE",
+    "EXPERT",
+    "SEQUENCE",
+    "TENSOR",
+    "DEFAULT_RULES",
+    "MeshSpec",
+    "batch_sharding",
+    "constrain",
+    "logical_spec",
+    "named_sharding",
+    "replicated",
+]

@@ -11,7 +11,7 @@ import this — it runs on the real TPU chip.
 import os
 
 # Must be set before jax initializes its backends.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
@@ -20,6 +20,11 @@ if "xla_force_host_platform_device_count" not in _flags:
 
 import jax  # noqa: E402
 import pytest  # noqa: E402
+
+# The driver image registers the real-TPU PJRT plugin from sitecustomize and
+# pins jax.config.jax_platforms to it at interpreter start, which overrides
+# the env var above.  Re-pin to cpu before any backend initializes.
+jax.config.update("jax_platforms", "cpu")
 
 
 @pytest.fixture(scope="session")

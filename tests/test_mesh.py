@@ -1,0 +1,98 @@
+"""Mesh / sharding-rule tests on the 8-device fake slice."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec
+
+from kubeflow_tpu.parallel import (
+    DATA,
+    FSDP,
+    SEQUENCE,
+    TENSOR,
+    MeshSpec,
+    batch_sharding,
+    logical_spec,
+    named_sharding,
+)
+from kubeflow_tpu.runtime.topology import fake_slice
+
+
+class TestMeshSpec:
+    def test_infer_data_axis(self):
+        spec = MeshSpec(tensor=2)
+        assert spec.sizes(8)[DATA] == 4
+
+    def test_explicit_sizes_must_multiply(self):
+        with pytest.raises(ValueError, match="slots"):
+            MeshSpec(data=3, tensor=2).sizes(8)
+
+    def test_two_wildcards_rejected(self):
+        with pytest.raises(ValueError, match="at most one"):
+            MeshSpec(data=-1, fsdp=-1).sizes(8)
+
+    def test_indivisible_rejected(self):
+        with pytest.raises(ValueError, match="divide"):
+            MeshSpec(tensor=3).sizes(8)
+
+    def test_build_full_axes(self, devices):
+        mesh = MeshSpec(data=2, sequence=2, tensor=2).build(devices)
+        assert mesh.shape == {
+            DATA: 2, FSDP: 1, "pipeline": 1, "expert": 1, SEQUENCE: 2, TENSOR: 2,
+        }
+        assert mesh.devices.size == 8
+
+    def test_topology_mismatch(self, devices):
+        with pytest.raises(ValueError, match="expects"):
+            MeshSpec().build(devices, topology=fake_slice(16))
+
+
+class TestLogicalRules:
+    def test_transformer_kernel_spec(self):
+        spec = logical_spec(("embed", "mlp"))
+        # embed->tensor wins; mlp degrades (tensor already used).
+        assert spec == PartitionSpec(TENSOR)
+
+    def test_batch_maps_to_both_dp_axes(self):
+        spec = logical_spec(("batch", "seq", "embed"))
+        assert spec == PartitionSpec((DATA, FSDP), SEQUENCE, TENSOR)
+
+    def test_unknown_axis_unsharded(self):
+        assert logical_spec(("mystery", "embed")) == PartitionSpec(None, TENSOR)
+
+    def test_trailing_nones_trimmed(self):
+        assert logical_spec(("embed", "norm")) == PartitionSpec(TENSOR)
+
+
+class TestShardedCompute:
+    def test_batch_sharded_matmul_runs(self, devices):
+        mesh = MeshSpec(data=4, tensor=2).build(devices)
+        x = jnp.ones((8, 16))
+        w = jnp.ones((16, 32))
+        xs = jax.device_put(x, batch_sharding(mesh))
+        ws = jax.device_put(w, named_sharding(mesh, (None, "embed")))
+
+        @jax.jit
+        def f(x, w):
+            return x @ w
+
+        out = f(xs, ws)
+        np.testing.assert_allclose(np.asarray(out), np.full((8, 32), 16.0))
+        # Output batch dim stays sharded over data.
+        assert out.sharding.spec[0] in ((DATA, FSDP), DATA)
+
+    def test_psum_over_mesh_axis(self, devices):
+        mesh = MeshSpec(data=8).build(devices)
+
+        @jax.jit
+        def total(x):
+            return jax.shard_map(
+                lambda v: jax.lax.psum(v, DATA),
+                mesh=mesh,
+                in_specs=PartitionSpec(DATA),
+                out_specs=PartitionSpec(),
+            )(x)
+
+        x = jnp.arange(8.0)
+        np.testing.assert_allclose(np.asarray(total(x)), np.full((1,), 28.0))
