@@ -1,0 +1,131 @@
+"""Benchmark entrypoint — prints ONE JSON line on stdout.
+
+Measures the framework's heir of the reference's headline benchmark:
+ResNet-50 training throughput (tf_cnn_benchmarks --model=resnet50,
+kubeflow/tf-job/prototypes/tf-cnn-benchmarks.jsonnet:7).  The reference
+published no absolute numbers (BASELINE.md), so ``vs_baseline`` reports
+achieved MFU relative to the BASELINE.json north-star of 50% MFU.
+
+Runs on whatever devices JAX sees: the real TPU chip under the driver, or
+a fake CPU slice with --fake-devices N for hermetic testing.  Diagnostics
+go to stderr; stdout carries exactly the one JSON line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+
+import sys
+import time
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--warmup", type=int, default=3)
+    ap.add_argument("--batch", type=int, default=0,
+                    help="global batch (default: 64 per device)")
+    ap.add_argument("--image-size", type=int, default=224)
+    ap.add_argument("--fake-devices", type=int, default=0,
+                    help="run on an N-device virtual CPU slice")
+    args = ap.parse_args()
+
+    import os
+
+    if args.fake_devices:
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={args.fake_devices}"
+        ).strip()
+    import jax
+
+    if args.fake_devices:
+        jax.config.update("jax_platforms", "cpu")
+
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from kubeflow_tpu.models.classification import classification_task
+    from kubeflow_tpu.models.resnet import ResNetConfig
+    from kubeflow_tpu.parallel import MeshSpec
+    from kubeflow_tpu.runtime.metrics import MetricsLogger, mfu
+    from kubeflow_tpu.runtime.train import Trainer
+
+    devices = jax.devices()
+    n_chips = len(devices)
+    on_tpu = devices[0].platform == "tpu"
+    batch = args.batch or 64 * n_chips
+    size = args.image_size
+    print(
+        f"bench: resnet50 train step, {n_chips}x{devices[0].device_kind}, "
+        f"global batch {batch}, image {size}",
+        file=sys.stderr,
+    )
+
+    cfg = ResNetConfig(name="resnet50")
+    model = cfg.build()
+    init_fn, loss_fn = classification_task(model, (1, size, size, 3))
+    mesh = MeshSpec(data=n_chips).build(devices)
+    trainer = Trainer(
+        init_fn=init_fn, loss_fn=loss_fn,
+        tx=optax.sgd(0.1, momentum=0.9), mesh=mesh,
+        metrics=MetricsLogger(stream=sys.stderr),
+    )
+    state = trainer.create_state()
+    step = trainer.compile_step()
+
+    rng = np.random.RandomState(0)
+    host_batch = {
+        "image": rng.randn(batch, size, size, 3).astype(np.float32),
+        "label": rng.randint(0, 1000, size=(batch,)),
+    }
+    dev_batch = trainer.shard_batch(host_batch)
+
+    # Warmup (compile + cache), each synced to the host.
+    for i in range(args.warmup):
+        t0 = time.perf_counter()
+        state, metrics = step(state, dev_batch)
+        loss = float(metrics["loss"])
+        print(f"warmup {i}: {(time.perf_counter()-t0)*1e3:.1f} ms "
+              f"loss={loss:.3f}", file=sys.stderr)
+
+    # Steady state: pipelined dispatch, ONE sync at the end.  Per-step
+    # host syncs would measure host<->device round-trip latency (~100 ms
+    # through the driver's TPU tunnel), not device throughput.
+    t0 = time.perf_counter()
+    for _ in range(args.steps):
+        state, metrics = step(state, dev_batch)
+    jax.block_until_ready(state.params)
+    step_s = (time.perf_counter() - t0) / args.steps
+    print(f"steady state: {step_s*1e3:.2f} ms/step", file=sys.stderr)
+    images_per_sec = batch / step_s
+    # fwd+bwd ~= 3x fwd FLOPs; peak from the chip spec (v5e unless v5p/v6e).
+    peak = {"v5p": 459e12, "v6e": 918e12}.get(
+        next((g for g in ("v5p", "v6e")
+              if g in devices[0].device_kind.lower()), ""), 197e12
+    ) if on_tpu else 1e12  # nominal CPU "peak" to keep the field defined
+    flops_per_step = 3 * cfg.fwd_flops_per_image * batch \
+        * (size / 224) ** 2
+    achieved_mfu = mfu(flops_per_step, step_s, n_chips, peak)
+
+    result = {
+        "metric": "resnet50_images_per_sec_per_chip",
+        "value": round(images_per_sec / n_chips, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(achieved_mfu / 0.50, 4),
+        "detail": {
+            "images_per_sec": round(images_per_sec, 2),
+            "step_time_ms": round(step_s * 1e3, 2),
+            "global_batch": batch,
+            "n_chips": n_chips,
+            "mfu": round(achieved_mfu, 4),
+            "device": devices[0].device_kind,
+        },
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

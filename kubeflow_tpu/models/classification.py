@@ -1,0 +1,70 @@
+"""Glue: turn a Flax image-classification model into Trainer callables.
+
+Heir of the reference's launcher/benchmark split: tf_cnn_benchmarks owned
+the loss/optimizer recipe outside the platform
+(kubeflow/tf-job/prototypes/tf-cnn-benchmarks.jsonnet:40-62); here the task
+recipe is a first-party, testable unit.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import linen as nn
+
+
+def classification_task(
+    model: nn.Module, input_shape: Tuple[int, ...]
+) -> Tuple[Callable, Callable]:
+    """Build (init_fn, loss_fn) for softmax cross-entropy training.
+
+    Handles BatchNorm-style mutable collections: everything the model
+    ``init``s besides 'params' rides TrainState.mutable and is threaded
+    through apply(mutable=...) each step.
+    """
+
+    def init_fn(rng: jax.Array):
+        variables = model.init(rng, jnp.zeros(input_shape), train=False)
+        params = variables["params"]
+        mutable = {k: v for k, v in variables.items() if k != "params"}
+        return params, mutable
+
+    def loss_fn(params, mutable, batch, rng):
+        images, labels = batch["image"], batch["label"]
+        outputs = model.apply(
+            {"params": params, **mutable},
+            images,
+            train=True,
+            mutable=list(mutable.keys()),
+            rngs={"dropout": rng},
+        )
+        logits, new_mutable = outputs
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, labels
+        ).mean()
+        accuracy = jnp.mean(jnp.argmax(logits, -1) == labels)
+        return loss, ({"accuracy": accuracy}, new_mutable)
+
+    return init_fn, loss_fn
+
+
+def eval_step(model: nn.Module) -> Callable[[Any, Any, Dict], Dict]:
+    """Jittable eval step (running BN averages, no mutation)."""
+
+    @jax.jit
+    def step(params, mutable, batch):
+        logits = model.apply(
+            {"params": params, **mutable}, batch["image"], train=False
+        )
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, batch["label"]
+        ).mean()
+        return {
+            "loss": loss,
+            "accuracy": jnp.mean(jnp.argmax(logits, -1) == batch["label"]),
+        }
+
+    return step

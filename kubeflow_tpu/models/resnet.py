@@ -1,0 +1,180 @@
+"""ResNet family (v1.5) in Flax — the framework's image-classification
+reference model.
+
+Heir of the reference's benchmark workload: the tf-cnn prototype ran
+``tf_cnn_benchmarks.py --model=resnet50`` as an external TF program
+(kubeflow/tf-job/prototypes/tf-cnn-benchmarks.jsonnet:40-62,
+tf-controller-examples/tf-cnn/create_job_specs.py:98-119).  Here the model
+is first-party JAX, designed for the MXU:
+
+  - compute dtype bfloat16 end-to-end, fp32 master params and batch stats;
+  - NHWC layout (XLA:TPU's native conv layout) — the reference had to flag
+    NHWC manually for CPU (`--data_format=NHWC`, create_job_specs.py:111);
+  - channel counts multiples of 128 in all hot convs -> clean MXU tiling;
+  - data parallelism only (conv nets saturate a slice with DP alone), so
+    kernels carry no sharding annotations; batch-norm statistics are
+    per-shard during training and synced at use (matching the standard
+    large-batch recipe).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Callable, Sequence, Tuple
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+ModuleDef = Any
+
+
+class ResNetBlock(nn.Module):
+    """Basic 3x3+3x3 residual block (ResNet-18/34)."""
+
+    filters: int
+    conv: ModuleDef
+    norm: ModuleDef
+    act: Callable
+    strides: Tuple[int, int] = (1, 1)
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (3, 3), self.strides)(x)
+        y = self.norm()(y)
+        y = self.act(y)
+        y = self.conv(self.filters, (3, 3))(y)
+        y = self.norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = self.conv(self.filters, (1, 1), self.strides,
+                                 name="conv_proj")(residual)
+            residual = self.norm(name="norm_proj")(residual)
+        return self.act(residual + y)
+
+
+class BottleneckBlock(nn.Module):
+    """1x1 -> 3x3 -> 1x1 bottleneck (ResNet-50/101/152), v1.5 variant:
+    stride lives on the 3x3 (not the first 1x1), worth ~0.5% top-1."""
+
+    filters: int
+    conv: ModuleDef
+    norm: ModuleDef
+    act: Callable
+    strides: Tuple[int, int] = (1, 1)
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (1, 1))(x)
+        y = self.norm()(y)
+        y = self.act(y)
+        y = self.conv(self.filters, (3, 3), self.strides)(y)
+        y = self.norm()(y)
+        y = self.act(y)
+        y = self.conv(self.filters * 4, (1, 1))(y)
+        # Zero-init the last norm scale: the block starts as identity,
+        # stabilising large-batch training (the DP regime we target).
+        y = self.norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = self.conv(self.filters * 4, (1, 1), self.strides,
+                                 name="conv_proj")(residual)
+            residual = self.norm(name="norm_proj")(residual)
+        return self.act(residual + y)
+
+
+class ResNet(nn.Module):
+    """Configurable ResNet; see constructors below for standard depths."""
+
+    stage_sizes: Sequence[int]
+    block_cls: ModuleDef
+    num_classes: int = 1000
+    num_filters: int = 64
+    dtype: Any = jnp.bfloat16
+    act: Callable = nn.relu
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = functools.partial(
+            nn.Conv, use_bias=False, dtype=self.dtype, padding="SAME"
+        )
+        norm = functools.partial(
+            nn.BatchNorm,
+            use_running_average=not train,
+            momentum=0.9,
+            epsilon=1e-5,
+            dtype=self.dtype,
+            axis_name=None,
+        )
+        x = x.astype(self.dtype)
+        x = conv(self.num_filters, (7, 7), (2, 2), name="conv_init")(x)
+        x = norm(name="bn_init")(x)
+        x = self.act(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for i, block_count in enumerate(self.stage_sizes):
+            for j in range(block_count):
+                strides = (2, 2) if i > 0 and j == 0 else (1, 1)
+                x = self.block_cls(
+                    filters=self.num_filters * 2 ** i,
+                    strides=strides,
+                    conv=conv,
+                    norm=norm,
+                    act=self.act,
+                )(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=jnp.float32,
+                     name="head")(x.astype(jnp.float32))
+        return x
+
+
+ResNet18 = functools.partial(ResNet, stage_sizes=[2, 2, 2, 2],
+                             block_cls=ResNetBlock)
+ResNet34 = functools.partial(ResNet, stage_sizes=[3, 4, 6, 3],
+                             block_cls=ResNetBlock)
+ResNet50 = functools.partial(ResNet, stage_sizes=[3, 4, 6, 3],
+                             block_cls=BottleneckBlock)
+ResNet101 = functools.partial(ResNet, stage_sizes=[3, 4, 23, 3],
+                              block_cls=BottleneckBlock)
+ResNet152 = functools.partial(ResNet, stage_sizes=[3, 8, 36, 3],
+                              block_cls=BottleneckBlock)
+
+# Forward-pass useful FLOPs per image for MFU accounting; the canonical
+# figures for 224x224 inputs (multiply-accumulate counted as 2 FLOPs).
+FWD_FLOPS_224 = {
+    "resnet18": 3.6e9,
+    "resnet34": 7.3e9,
+    "resnet50": 8.2e9,
+    "resnet101": 15.7e9,
+    "resnet152": 23.1e9,
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class ResNetConfig:
+    """Typed model selector, heir of the prototype's stringly `--model`
+    param (kubeflow/tf-job/prototypes/tf-cnn-benchmarks.jsonnet:7)."""
+
+    name: str = "resnet50"
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+
+    _FACTORIES = {
+        "resnet18": ResNet18,
+        "resnet34": ResNet34,
+        "resnet50": ResNet50,
+        "resnet101": ResNet101,
+        "resnet152": ResNet152,
+    }
+
+    def build(self) -> ResNet:
+        try:
+            factory = self._FACTORIES[self.name]
+        except KeyError:
+            raise ValueError(
+                f"unknown resnet {self.name!r}; known: {sorted(self._FACTORIES)}"
+            ) from None
+        return factory(num_classes=self.num_classes, dtype=self.dtype)
+
+    @property
+    def fwd_flops_per_image(self) -> float:
+        return FWD_FLOPS_224[self.name]

@@ -1,0 +1,322 @@
+"""Decoder-only Transformer LM — the framework's flagship model.
+
+New capability relative to the reference (its model zoo was whatever TF
+image you mounted; SURVEY.md §2.2), designed TPU-first:
+
+  - bfloat16 activations, fp32 params; every matmul MXU-shaped
+    (d_model/d_ff/head_dim multiples of 128 in real configs);
+  - logical-axis annotations on every kernel (nn.with_logical_partitioning)
+    so the parallel/mesh.py rule table alone decides dp/fsdp/tp/sp layout;
+  - layers stacked with ``nn.scan``: one compiled block body regardless of
+    depth (compile time O(1) in n_layers), with selective rematerialisation
+    via ``nn.remat`` to trade FLOPs for HBM;
+  - RoPE positions, RMSNorm, SwiGLU MLP, grouped-query attention —
+    the contemporary LLM block;
+  - attention dispatches to ops/ (XLA now, Pallas flash / ring attention
+    over the `sequence` axis for long context).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from kubeflow_tpu.ops.attention import dot_product_attention
+
+Dtype = Any
+
+init = nn.initializers
+kernel_init = init.lecun_normal()
+embed_init = init.normal(stddev=0.02)
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32_000
+    d_model: int = 512
+    n_layers: int = 4
+    n_heads: int = 8
+    n_kv_heads: int = 8
+    d_ff: int = 1408
+    head_dim: int = 64
+    max_seq_len: int = 2048
+    rope_theta: float = 10_000.0
+    dropout_rate: float = 0.0
+    dtype: Dtype = jnp.bfloat16
+    remat: bool = False
+    # Tie input embedding and output projection (small models benefit).
+    tied_embeddings: bool = True
+    # Attention backend: "dot" (XLA einsum), "flash" (Pallas kernel, heads
+    # TP-sharded via shard_map when a mesh is given), "ring" (context
+    # parallel over the `sequence` mesh axis; requires a mesh).
+    attention: str = "dot"
+    flash_block_q: int = 128
+    flash_block_k: int = 128
+
+    def __post_init__(self):
+        assert self.n_heads % self.n_kv_heads == 0
+
+    def flops_per_token(self) -> float:
+        """Forward useful FLOPs per token (2*params matmul convention +
+        attention term) — the MFU numerator, bwd counted as 2x by caller."""
+        p_attn = self.d_model * self.head_dim * (
+            self.n_heads + 2 * self.n_kv_heads
+        ) + self.n_heads * self.head_dim * self.d_model
+        p_mlp = 3 * self.d_model * self.d_ff
+        p_embed = self.vocab_size * self.d_model
+        matmul = 2 * (self.n_layers * (p_attn + p_mlp) + p_embed)
+        attn = 2 * 2 * self.n_layers * self.n_heads * self.head_dim \
+            * self.max_seq_len  # qk^T + av, causal halving ignored
+        return float(matmul + attn)
+
+
+def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Rotary position embedding, applied per head. x: [b, s, h, d]."""
+    d = x.shape[-1]
+    freqs = theta ** (-jnp.arange(0, d, 2, dtype=jnp.float32) / d)
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [b, s, d/2]
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+class RMSNorm(nn.Module):
+    dtype: Dtype = jnp.bfloat16
+    eps: float = 1e-6
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param(
+            "scale",
+            nn.with_logical_partitioning(init.ones_init(), ("norm",)),
+            (x.shape[-1],),
+            jnp.float32,
+        )
+        x32 = x.astype(jnp.float32)
+        norm = x32 * jax.lax.rsqrt(
+            jnp.mean(x32 * x32, axis=-1, keepdims=True) + self.eps
+        )
+        return (norm * scale).astype(self.dtype)
+
+
+class Attention(nn.Module):
+    cfg: TransformerConfig
+    mesh: Optional[jax.sharding.Mesh] = None
+
+    def _attend(self, q, k, v, segment_ids):
+        cfg = self.cfg
+        if cfg.attention == "ring":
+            if self.mesh is None:
+                raise ValueError("attention='ring' requires a mesh")
+            from kubeflow_tpu.parallel.ring import make_ring_attention
+
+            return make_ring_attention(self.mesh, causal=True)(q, k, v)
+        if cfg.attention == "flash":
+            from kubeflow_tpu.ops.flash import (
+                flash_attention,
+                make_sharded_flash,
+            )
+
+            if self.mesh is not None:
+                return make_sharded_flash(
+                    self.mesh, causal=True,
+                    block_q=cfg.flash_block_q, block_k=cfg.flash_block_k,
+                )(q, k, v)
+            return flash_attention(
+                q, k, v, causal=True,
+                block_q=cfg.flash_block_q, block_k=cfg.flash_block_k,
+            )
+        return dot_product_attention(q, k, v, causal=True,
+                                     segment_ids=segment_ids)
+
+    @nn.compact
+    def __call__(self, x, positions, segment_ids=None):
+        cfg = self.cfg
+        wq = self.param(
+            "wq",
+            nn.with_logical_partitioning(kernel_init, ("embed", "heads", "kv")),
+            (cfg.d_model, cfg.n_heads, cfg.head_dim),
+            jnp.float32,
+        )
+        wkv = self.param(
+            "wkv",
+            nn.with_logical_partitioning(kernel_init, (None, "embed", "heads", "kv")),
+            (2, cfg.d_model, cfg.n_kv_heads, cfg.head_dim),
+            jnp.float32,
+        )
+        wo = self.param(
+            "wo",
+            nn.with_logical_partitioning(kernel_init, ("heads", "kv", "embed")),
+            (cfg.n_heads, cfg.head_dim, cfg.d_model),
+            jnp.float32,
+        )
+        dt = cfg.dtype
+        q = jnp.einsum("bse,ehd->bshd", x, wq.astype(dt))
+        k = jnp.einsum("bse,ehd->bshd", x, wkv[0].astype(dt))
+        v = jnp.einsum("bse,ehd->bshd", x, wkv[1].astype(dt))
+        q = rope(q, positions, cfg.rope_theta)
+        k = rope(k, positions, cfg.rope_theta)
+        q = nn.with_logical_constraint(q, ("batch", "seq", "heads", "kv"))
+        k = nn.with_logical_constraint(k, ("batch", "seq", "heads", "kv"))
+        out = self._attend(q, k, v, segment_ids)
+        return jnp.einsum("bshd,hde->bse", out, wo.astype(dt))
+
+
+class MLP(nn.Module):
+    """SwiGLU feed-forward, column->row parallel under the rule table."""
+
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        wi = self.param(
+            "wi",
+            nn.with_logical_partitioning(kernel_init, (None, "embed", "mlp")),
+            (2, cfg.d_model, cfg.d_ff),
+            jnp.float32,
+        )
+        wo = self.param(
+            "wo",
+            nn.with_logical_partitioning(kernel_init, ("mlp", "embed")),
+            (cfg.d_ff, cfg.d_model),
+            jnp.float32,
+        )
+        dt = cfg.dtype
+        gate = jnp.einsum("bse,ef->bsf", x, wi[0].astype(dt))
+        up = jnp.einsum("bse,ef->bsf", x, wi[1].astype(dt))
+        h = nn.silu(gate) * up
+        h = nn.with_logical_constraint(h, ("batch", "seq", "mlp"))
+        return jnp.einsum("bsf,fe->bse", h, wo.astype(dt))
+
+
+class Block(nn.Module):
+    """One decoder block in nn.scan carry form: (x, bcast...) -> (x, None)."""
+
+    cfg: TransformerConfig
+    deterministic: bool = True
+    mesh: Optional[jax.sharding.Mesh] = None
+
+    @nn.compact
+    def __call__(self, x, positions, segment_ids):
+        cfg = self.cfg
+        y = RMSNorm(dtype=cfg.dtype, name="attn_norm")(x)
+        y = Attention(cfg, mesh=self.mesh, name="attn")(y, positions,
+                                                        segment_ids)
+        if cfg.dropout_rate:
+            y = nn.Dropout(cfg.dropout_rate,
+                           deterministic=self.deterministic)(y)
+        x = x + y
+        y = RMSNorm(dtype=cfg.dtype, name="mlp_norm")(x)
+        y = MLP(cfg, name="mlp")(y)
+        if cfg.dropout_rate:
+            y = nn.Dropout(cfg.dropout_rate,
+                           deterministic=self.deterministic)(y)
+        x = x + y
+        x = nn.with_logical_constraint(x, ("batch", "seq", "act_embed"))
+        return x, None
+
+
+class Transformer(nn.Module):
+    """LM: token ids [b, s] -> logits [b, s, vocab]."""
+
+    cfg: TransformerConfig
+    mesh: Optional[jax.sharding.Mesh] = None
+
+    @nn.compact
+    def __call__(
+        self,
+        tokens: jax.Array,
+        *,
+        positions: Optional[jax.Array] = None,
+        segment_ids: Optional[jax.Array] = None,
+        deterministic: bool = True,
+    ) -> jax.Array:
+        cfg = self.cfg
+        embed = self.param(
+            "embed",
+            nn.with_logical_partitioning(embed_init, ("vocab", "embed")),
+            (cfg.vocab_size, cfg.d_model),
+            jnp.float32,
+        )
+        if positions is None:
+            positions = jnp.broadcast_to(
+                jnp.arange(tokens.shape[1]), tokens.shape
+            )
+        x = embed.astype(cfg.dtype)[tokens]
+        x = nn.with_logical_constraint(x, ("batch", "seq", "act_embed"))
+
+        block = Block
+        if cfg.remat:
+            block = nn.remat(
+                Block,
+                policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+            )
+        # One compiled body for all layers; params gain a leading 'layers'
+        # dim (unsharded by default; a pipeline schedule maps it to `stage`).
+        x, _ = nn.scan(
+            block,
+            variable_axes={"params": 0},
+            split_rngs={"params": True, "dropout": True},
+            length=cfg.n_layers,
+            metadata_params={nn.PARTITION_NAME: "layers"},
+            in_axes=(nn.broadcast, nn.broadcast),
+        )(cfg, deterministic, self.mesh, name="layers")(x, positions, segment_ids)
+
+        x = RMSNorm(dtype=cfg.dtype, name="final_norm")(x)
+        if cfg.tied_embeddings:
+            logits = jnp.einsum("bse,ve->bsv", x, embed.astype(cfg.dtype))
+        else:
+            w_out = self.param(
+                "w_out",
+                nn.with_logical_partitioning(kernel_init, ("embed", "vocab")),
+                (cfg.d_model, cfg.vocab_size),
+                jnp.float32,
+            )
+            logits = jnp.einsum("bse,ev->bsv", x, w_out.astype(cfg.dtype))
+        return logits.astype(jnp.float32)
+
+
+def lm_task(cfg: TransformerConfig, mesh=None):
+    """(init_fn, loss_fn) pair for Trainer: next-token cross-entropy.
+
+    Batch contract: {"tokens": [b, s] int32}; loss predicts tokens[1:].
+    """
+    import optax
+
+    model = Transformer(cfg, mesh=mesh)
+
+    def init_fn(rng):
+        # Shapes only seed parameter shapes, but sharded attention backends
+        # (ring/flash via shard_map) trace with them — keep both batch and
+        # seq divisible by the relevant mesh axes.
+        b, s = 1, min(cfg.max_seq_len, 16)
+        if mesh is not None:
+            b = mesh.shape.get("data", 1) * mesh.shape.get("fsdp", 1)
+            s_ax = mesh.shape.get("sequence", 1)
+            s = max(s, s_ax) // s_ax * s_ax
+        toks = jnp.zeros((b, s), jnp.int32)
+        variables = model.init(rng, toks)
+        return variables["params"], {}
+
+    def loss_fn(params, mutable, batch, rng):
+        del mutable
+        tokens = batch["tokens"]
+        logits = model.apply(
+            {"params": params}, tokens,
+            deterministic=False,
+            rngs={"dropout": rng},
+        )
+        targets = tokens[:, 1:]
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits[:, :-1], targets
+        ).mean()
+        return loss, ({"perplexity": jnp.exp(loss)}, {})
+
+    return init_fn, loss_fn

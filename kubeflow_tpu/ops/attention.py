@@ -1,0 +1,91 @@
+"""Attention ops: one interface, three backends (XLA, Pallas flash, ring).
+
+The reference had no attention op at all — its compute was external TF
+binaries (SURVEY.md §2.2).  This module is new capability, designed for the
+TPU memory hierarchy:
+
+  - ``dot_product_attention``: straightforward XLA einsum path.  Correct
+    everywhere (CPU fake-slice tests, small models); materialises the
+    [b, h, q, k] score matrix in HBM, so O(seq^2) memory.
+  - ``flash_attention``: Pallas TPU kernel (ops/flash.py) — blockwise
+    online-softmax in VMEM, O(seq) memory, MXU-tiled.  Falls back to the
+    XLA path off-TPU so tests stay hermetic.
+  - ring attention (parallel/ring.py) wraps either kernel with a ppermute
+    pipeline over the `sequence` mesh axis for context parallelism.
+
+All backends share the signature (q, k, v, *, causal, segment_ids) with
+q/k/v shaped [batch, seq, heads, head_dim]; GQA is expressed by passing
+fewer kv heads (num_heads % num_kv_heads == 0).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _repeat_kv(k: jax.Array, q_heads: int) -> jax.Array:
+    """Broadcast kv heads up to q heads for grouped-query attention."""
+    kv_heads = k.shape[2]
+    if kv_heads == q_heads:
+        return k
+    assert q_heads % kv_heads == 0, (q_heads, kv_heads)
+    return jnp.repeat(k, q_heads // kv_heads, axis=2)
+
+
+def dot_product_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    segment_ids: Optional[jax.Array] = None,
+    kv_offset: int | jax.Array = 0,
+) -> jax.Array:
+    """Reference XLA attention. [b, sq, h, d] x [b, sk, hkv, d] -> [b, sq, h, d].
+
+    kv_offset: absolute position of k[0] relative to q[0]'s frame — used by
+    ring attention (rotating kv blocks) and decode (single-query vs cache).
+    Softmax accumulates in fp32 regardless of input dtype (bf16-safe).
+    """
+    orig_dtype = q.dtype
+    q_heads = q.shape[2]
+    k = _repeat_kv(k, q_heads)
+    v = _repeat_kv(v, q_heads)
+    scale = q.shape[-1] ** -0.5
+    scores = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    mask = _build_mask(
+        q_len=q.shape[1], k_len=k.shape[1], causal=causal,
+        segment_ids=segment_ids, kv_offset=kv_offset,
+    )
+    if mask is not None:
+        scores = jnp.where(mask, scores, jnp.finfo(jnp.float32).min)
+    weights = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+    out = jnp.einsum(
+        "bhqk,bkhd->bqhd", weights.astype(orig_dtype), v,
+        preferred_element_type=jnp.float32,
+    )
+    return out.astype(orig_dtype)
+
+
+def _build_mask(
+    q_len: int,
+    k_len: int,
+    causal: bool,
+    segment_ids: Optional[jax.Array],
+    kv_offset: int | jax.Array,
+) -> Optional[jax.Array]:
+    """Boolean keep-mask broadcastable to [b, h, q, k]."""
+    mask = None
+    if causal:
+        q_pos = jnp.arange(q_len)[:, None] + kv_offset
+        k_pos = jnp.arange(k_len)[None, :]
+        mask = (q_pos >= k_pos)[None, None, :, :]
+    if segment_ids is not None:
+        seg = segment_ids[:, None, :, None] == segment_ids[:, None, None, :]
+        mask = seg if mask is None else mask & seg
+    return mask

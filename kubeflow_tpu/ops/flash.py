@@ -1,0 +1,223 @@
+"""Pallas TPU flash attention (forward) with online softmax.
+
+Blockwise attention computed entirely in VMEM: for each query block the
+kernel streams key/value blocks through the MXU, maintaining the running
+max / normalizer / weighted-value accumulator of the online-softmax
+recurrence.  The [s, s] score matrix never exists in HBM — memory is O(s)
+— and every matmul is a [BQ, d] x [d, BK] or [BQ, BK] x [BK, d] MXU tile.
+
+Grid layout: (batch*heads, q_blocks, k_blocks) with the k dimension
+innermost — TPU grids execute sequentially on a core, so VMEM scratch
+accumulators legally carry across the innermost iterations.  Causal jobs
+skip fully-masked k blocks via predication (half the FLOPs back).
+
+Backward: jax.custom_vjp recomputes attention with the XLA path —
+correct everywhere, O(s^2) transient in bwd only.  A blockwise Pallas
+bwd is a planned optimisation, the fwd kernel is the serving/prefill
+hot path.
+
+Off-TPU the public entrypoint falls back to ops/attention.py so the CPU
+fake-slice tests stay hermetic; the kernel itself is additionally tested
+under the Pallas interpreter.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from kubeflow_tpu.ops.attention import dot_product_attention
+
+NEG_INF = float(jnp.finfo(jnp.float32).min)
+
+
+def _flash_kernel(
+    q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
+    *, scale: float, causal: bool, block_q: int, block_k: int,
+):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    q_start = qi * block_q
+    k_start = ki * block_k
+    # Causal: block is live unless every (q, k) pair has k > q.
+    live = (not causal) or (q_start + block_q - 1 >= k_start)
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)           # [BQ, d]
+        k = k_ref[0].astype(jnp.float32)           # [BK, d]
+        v = v_ref[0].astype(jnp.float32)           # [BK, d]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale                                   # [BQ, BK]
+        if causal:
+            q_pos = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = k_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+
+        m_prev = m_scr[:, :1]                       # [BQ, 1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)                      # [BQ, BK]
+        alpha = jnp.exp(m_prev - m_new)             # [BQ, 1]
+        l_new = alpha * l_scr[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        l = l_scr[:, :1]
+        # Fully-masked rows (possible only with padding) produce l == 0.
+        safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_scr[:] / safe).astype(o_ref.dtype)
+
+
+def _flash_fwd_bhsd(
+    q: jax.Array, k: jax.Array, v: jax.Array,
+    *, causal: bool, block_q: int, block_k: int, interpret: bool,
+) -> jax.Array:
+    """q: [bh, sq, d], k/v: [bh, sk, d] -> [bh, sq, d]."""
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    assert sq % block_q == 0 and sk % block_k == 0, (sq, sk, block_q, block_k)
+    scale = d ** -0.5
+    grid = (bh, sq // block_q, sk // block_k)
+    kernel = functools.partial(
+        _flash_kernel, scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k,
+    )
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, qi, ki: (b, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, qi, ki: (b, ki, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
+        scratch_shapes=[
+            # m/l padded to a full 128-lane tile; column 0 is authoritative.
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+
+
+@functools.partial(
+    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6)
+)
+def _flash(q, k, v, causal, block_q, block_k, interpret):
+    return _flash_fwd_bhsd(
+        q, k, v, causal=causal, block_q=block_q, block_k=block_k,
+        interpret=interpret,
+    )
+
+
+def _flash_vjp_fwd(q, k, v, causal, block_q, block_k, interpret):
+    out = _flash(q, k, v, causal, block_q, block_k, interpret)
+    return out, (q, k, v)
+
+
+def _flash_vjp_bwd(causal, block_q, block_k, interpret, res, g):
+    q, k, v = res
+
+    def ref(q, k, v):
+        # [bh, s, d] -> [bh, s, 1, d] for the bshd reference path.
+        o = dot_product_attention(
+            q[:, :, None, :], k[:, :, None, :], v[:, :, None, :],
+            causal=causal,
+        )
+        return o[:, :, 0, :]
+
+    _, vjp = jax.vjp(ref, q, k, v)
+    return vjp(g)
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def make_sharded_flash(
+    mesh,
+    *,
+    causal: bool = True,
+    block_q: int = 128,
+    block_k: int = 128,
+):
+    """shard_map wrapper: flash per shard, batch over (data, fsdp), heads
+    over tensor, sequence resident (use ring attention for sequence
+    sharding).  Pallas kernels don't auto-partition under jit, so any
+    sharded caller must come through here."""
+    from jax.sharding import PartitionSpec
+
+    from kubeflow_tpu.parallel.mesh import DATA, FSDP, TENSOR
+
+    spec = PartitionSpec((DATA, FSDP), None, TENSOR, None)
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
+    )
+    def fn(q, k, v):
+        return flash_attention(
+            q, k, v, causal=causal, block_q=block_q, block_k=block_k
+        )
+
+    return fn
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    segment_ids: Optional[jax.Array] = None,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool = False,
+) -> jax.Array:
+    """Flash attention with the ops/attention.py [b, s, h, d] signature.
+
+    GQA is handled by repeating kv heads before the kernel (the repeat is
+    fused by XLA into the gather feeding the kernel).  Segment masking is
+    not yet in the kernel: segmented calls fall back to the XLA path.
+    """
+    on_tpu = jax.default_backend() == "tpu"
+    if segment_ids is not None or (not on_tpu and not interpret):
+        return dot_product_attention(
+            q, k, v, causal=causal, segment_ids=segment_ids
+        )
+    b, sq, h, d = q.shape
+    hkv = k.shape[2]
+    if hkv != h:
+        k = jnp.repeat(k, h // hkv, axis=2)
+        v = jnp.repeat(v, h // hkv, axis=2)
+    sk = k.shape[1]
+    qt = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    kt = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+    vt = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+    out = _flash(qt, kt, vt, causal, block_q, block_k, interpret)
+    return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)

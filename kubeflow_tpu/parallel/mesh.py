@@ -68,6 +68,11 @@ class MeshSpec:
             SEQUENCE: self.sequence,
             TENSOR: self.tensor,
         }
+        bad = {k: v for k, v in raw.items() if v < 1 and v != -1}
+        if bad:
+            raise ValueError(
+                f"axis sizes must be positive (or -1 to infer), got {bad}"
+            )
         wild = [k for k, v in raw.items() if v == -1]
         if len(wild) > 1:
             raise ValueError(f"at most one axis may be -1, got {wild}")
@@ -122,19 +127,24 @@ class MeshSpec:
 
 LogicalRules = Tuple[Tuple[str, Union[str, Tuple[str, ...], None]], ...]
 
-# Default rule table for transformer + conv models.
+# Default rule table for transformer + conv models (megatron-style TP with
+# FSDP weight sharding on the embed dim — the maxtext-proven layout):
+#   - weights: embed dim over fsdp (ZeRO-3: gathered per layer), heads/mlp/
+#     vocab over tensor (column/row-parallel matmuls; XLA inserts the
+#     all-reduce after the row-parallel projection);
+#   - activations: batch over (data, fsdp), seq over sequence (ring
+#     attention / context parallelism).
 DEFAULT_RULES: LogicalRules = (
     ("batch", (DATA, FSDP)),        # global batch sharded over both dp axes
     ("seq", SEQUENCE),              # context parallelism (ring attention)
-    ("embed", TENSOR),              # activations' feature dim: TP-sharded
-    ("embed_unsharded", None),
+    ("embed", FSDP),                # weight embed dim: ZeRO-3 over fsdp
+    ("act_embed", None),            # activation feature dim between blocks
     ("heads", TENSOR),              # attention heads split across TP
-    ("kv_heads", TENSOR),
+    ("kv", None),                   # per-head dim never sharded
     ("mlp", TENSOR),                # MLP hidden dim split across TP
     ("vocab", TENSOR),              # embedding/output table split
     ("expert", EXPERT),             # MoE expert dim
     ("stage", PIPELINE),            # pipeline stage dim
-    ("kernel_fsdp", FSDP),          # weight shards gathered per-layer (ZeRO-3)
     ("conv_out", None),             # conv channels replicated (ResNet is DP-only)
     ("norm", None),
 )
@@ -197,14 +207,3 @@ def batch_sharding(mesh: Mesh, ndim: int = 2) -> NamedSharding:
 
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, PartitionSpec())
-
-
-def tree_shard(tree, mesh: Mesh, spec_fn) -> object:
-    """Apply `jax.device_put` shard placement over a pytree.
-
-    spec_fn: leaf_path_free callable leaf -> NamedSharding (e.g. from
-    flax logical metadata, see parallel/sharding_rules in models/).
-    """
-    return jax.tree_util.tree_map(
-        lambda leaf: jax.device_put(leaf, spec_fn(leaf)), tree
-    )

@@ -1,0 +1,144 @@
+"""Ring attention: context parallelism over the `sequence` mesh axis.
+
+The capability SURVEY.md §5 flags as absent from the reference in any form
+("no ring attention, no context/sequence parallel") — its era scaled replica
+count, not sequence length.  Here long-context is first-class: the sequence
+dimension of q/k/v is sharded over the `sequence` mesh axis, each device
+keeps its resident query block, and key/value blocks rotate around the ring
+via ``ppermute`` — on a TPU slice that permutation compiles to
+neighbour-to-neighbour ICI transfers, overlapping each hop with the local
+blockwise attention (the Ring Attention schedule of Liu et al. 2023,
+per PAPERS.md).
+
+Numerics: each (q-block, kv-block) pair yields a partial output plus a
+log-sum-exp; partials combine with the standard online-softmax merge, so
+the result is exactly softmax attention — verified bit-close against the
+single-device reference in tests/test_ring.py.
+
+Memory: O(seq/ring_size) per device — sequence length scales linearly with
+the mesh axis.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec
+
+from kubeflow_tpu.parallel.mesh import DATA, FSDP, SEQUENCE, TENSOR
+
+NEG_INF = float(jnp.finfo(jnp.float32).min)
+
+
+def _block_partial(
+    q: jax.Array, k: jax.Array, v: jax.Array,
+    q_offset: jax.Array, k_offset: jax.Array, causal: bool,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """One (q-block, kv-block) partial of the online-softmax recurrence.
+
+    q: [b, sq, h, d]; k/v: [b, sk, h, d]; offsets are the blocks' absolute
+    sequence positions (traced values — the ring step index is dynamic).
+    Returns (u, m, l): u = sum_k exp(s - m) v  [b, sq, h, d] fp32,
+    m = rowwise max score [b, h, sq] (NEG_INF if fully masked),
+    l = sum_k exp(s - m)  [b, h, sq].
+    """
+    scale = q.shape[-1] ** -0.5
+    scores = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    if causal:
+        q_pos = q_offset + jnp.arange(q.shape[1])[:, None]
+        k_pos = k_offset + jnp.arange(k.shape[1])[None, :]
+        scores = jnp.where(
+            (q_pos >= k_pos)[None, None], scores, NEG_INF
+        )
+    m = jnp.max(scores, axis=-1)                       # [b, h, q]
+    safe_m = jnp.where(jnp.isfinite(m), m, 0.0)
+    p = jnp.exp(scores - safe_m[..., None])
+    p = jnp.where(jnp.isfinite(m)[..., None], p, 0.0)
+    l = jnp.sum(p, axis=-1)                            # [b, h, q]
+    u = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    return u, m, l
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str = SEQUENCE,
+    causal: bool = True,
+) -> jax.Array:
+    """Per-shard ring attention body — call inside shard_map.
+
+    q/k/v: the local sequence shard [b, s_local, h_local, d].  Requires the
+    global sequence be evenly sharded over ``axis_name``.
+    """
+    axis_size = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    s_local = q.shape[1]
+    q_offset = my_idx * s_local
+
+    def expand(w):
+        # [b, h, q] -> [b, q, h, 1] for broadcasting against u.
+        return w.swapaxes(1, 2)[..., None]
+
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    def body(step, carry):
+        u_acc, m_acc, l_acc, k_cur, v_cur = carry
+        src = (my_idx - step) % axis_size          # whose kv block we hold
+        u_p, m_p, l_p = _block_partial(
+            q, k_cur, v_cur, q_offset, src * s_local, causal
+        )
+        # Rotate kv to the next device; overlapped with the merge math.
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        # Online-softmax merge of (u, m, l) pairs.
+        m_new = jnp.maximum(m_acc, m_p)
+        safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        a_acc = jnp.where(jnp.isfinite(m_acc), jnp.exp(m_acc - safe), 0.0)
+        a_p = jnp.where(jnp.isfinite(m_p), jnp.exp(m_p - safe), 0.0)
+        u_new = u_acc * expand(a_acc) + u_p * expand(a_p)
+        l_new = l_acc * a_acc + l_p * a_p
+        return u_new, m_new, l_new, k_nxt, v_nxt
+
+    b, s, h, d = q.shape
+    # Initial accumulators must carry the same varying-manual-axes type as
+    # the loop outputs (shard_map vma rule), so derive them from q.
+    vma = tuple(jax.typeof(q).vma)
+    vary = lambda x: jax.lax.pcast(x, vma, to="varying")
+    u0 = vary(jnp.zeros((b, s, h, d), jnp.float32))
+    m0 = vary(jnp.full((b, h, s), NEG_INF, jnp.float32))
+    l0 = vary(jnp.zeros((b, h, s), jnp.float32))
+    u, m, l, _, _ = jax.lax.fori_loop(
+        0, axis_size, body, (u0, m0, l0, k, v)
+    )
+    out = u / jnp.maximum(expand(l), 1e-37)
+    return out.astype(q.dtype)
+
+
+def make_ring_attention(
+    mesh: Mesh,
+    *,
+    causal: bool = True,
+    axis_name: str = SEQUENCE,
+) -> Callable[[jax.Array, jax.Array, jax.Array], jax.Array]:
+    """shard_map-wrapped ring attention over a mesh.
+
+    Layout contract (matches DEFAULT_RULES): batch over (data, fsdp),
+    sequence over `sequence`, heads over `tensor`.
+    """
+    spec = PartitionSpec((DATA, FSDP), axis_name, TENSOR, None)
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(spec, spec, spec), out_specs=spec,
+    )
+    def fn(q, k, v):
+        return ring_attention(q, k, v, axis_name=axis_name, causal=causal)
+
+    return fn

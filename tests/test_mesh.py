@@ -28,6 +28,12 @@ class TestMeshSpec:
         with pytest.raises(ValueError, match="slots"):
             MeshSpec(data=3, tensor=2).sizes(8)
 
+    def test_nonpositive_size_rejected(self):
+        with pytest.raises(ValueError, match="positive"):
+            MeshSpec(tensor=0).sizes(8)
+        with pytest.raises(ValueError, match="positive"):
+            MeshSpec(tensor=-2).sizes(8)
+
     def test_two_wildcards_rejected(self):
         with pytest.raises(ValueError, match="at most one"):
             MeshSpec(data=-1, fsdp=-1).sizes(8)
@@ -50,19 +56,22 @@ class TestMeshSpec:
 
 class TestLogicalRules:
     def test_transformer_kernel_spec(self):
-        spec = logical_spec(("embed", "mlp"))
-        # embed->tensor wins; mlp degrades (tensor already used).
-        assert spec == PartitionSpec(TENSOR)
+        # Column-parallel MLP kernel: embed over fsdp, mlp over tensor.
+        assert logical_spec(("embed", "mlp")) == PartitionSpec(FSDP, TENSOR)
 
-    def test_batch_maps_to_both_dp_axes(self):
-        spec = logical_spec(("batch", "seq", "embed"))
-        assert spec == PartitionSpec((DATA, FSDP), SEQUENCE, TENSOR)
+    def test_activation_spec(self):
+        spec = logical_spec(("batch", "seq", "act_embed"))
+        assert spec == PartitionSpec((DATA, FSDP), SEQUENCE)
+
+    def test_duplicate_mesh_axis_degrades(self):
+        # vocab and heads both map to tensor; second use degrades to None.
+        assert logical_spec(("vocab", "heads")) == PartitionSpec(TENSOR)
 
     def test_unknown_axis_unsharded(self):
-        assert logical_spec(("mystery", "embed")) == PartitionSpec(None, TENSOR)
+        assert logical_spec(("mystery", "mlp")) == PartitionSpec(None, TENSOR)
 
     def test_trailing_nones_trimmed(self):
-        assert logical_spec(("embed", "norm")) == PartitionSpec(TENSOR)
+        assert logical_spec(("mlp", "norm")) == PartitionSpec(TENSOR)
 
 
 class TestShardedCompute:

@@ -1,0 +1,111 @@
+"""Flagship Transformer tests: shapes, causality, sharded training on a
+dp x tp mesh, GQA, remat equivalence."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from flax import linen as nn
+
+from kubeflow_tpu.models.transformer import (
+    Transformer,
+    TransformerConfig,
+    lm_task,
+)
+from kubeflow_tpu.parallel import DEFAULT_RULES, MeshSpec, TENSOR
+from kubeflow_tpu.runtime.metrics import MetricsLogger
+from kubeflow_tpu.runtime.train import Trainer
+
+CFG = TransformerConfig(
+    vocab_size=64, d_model=32, n_layers=2, n_heads=4, n_kv_heads=2,
+    d_ff=64, head_dim=8, max_seq_len=32,
+)
+
+
+def _init(cfg=CFG, seed=0, seq=16):
+    model = Transformer(cfg)
+    toks = jnp.zeros((2, seq), jnp.int32)
+    return model, model.init(jax.random.key(seed), toks)
+
+
+class TestForward:
+    def test_logits_shape_dtype(self):
+        model, vars_ = _init()
+        toks = jnp.ones((2, 16), jnp.int32)
+        logits = model.apply(vars_, toks)
+        assert logits.shape == (2, 16, CFG.vocab_size)
+        assert logits.dtype == jnp.float32
+
+    def test_causality(self):
+        """Changing a future token must not affect earlier logits."""
+        model, vars_ = _init()
+        rng = np.random.RandomState(0)
+        toks = rng.randint(0, CFG.vocab_size, (1, 16)).astype(np.int32)
+        toks2 = toks.copy()
+        toks2[0, -1] = (toks2[0, -1] + 1) % CFG.vocab_size
+        l1 = model.apply(vars_, jnp.asarray(toks))
+        l2 = model.apply(vars_, jnp.asarray(toks2))
+        np.testing.assert_allclose(
+            np.asarray(l1[0, :-1]), np.asarray(l2[0, :-1]), atol=1e-5
+        )
+        assert not np.allclose(np.asarray(l1[0, -1]), np.asarray(l2[0, -1]))
+
+    def test_scan_stacks_layer_params(self):
+        _, vars_ = _init()
+        wq = vars_["params"]["layers"]["attn"]["wq"]
+        assert nn.unbox(wq).shape == (CFG.n_layers, CFG.d_model, CFG.n_heads,
+                                      CFG.head_dim)
+
+    def test_remat_matches_baseline(self):
+        cfg_r = TransformerConfig(**{**CFG.__dict__, "remat": True})
+        model, vars_ = _init()
+        model_r = Transformer(cfg_r)
+        toks = jnp.ones((1, 8), jnp.int32)
+        np.testing.assert_allclose(
+            np.asarray(model.apply(vars_, toks)),
+            np.asarray(model_r.apply(vars_, toks)),
+            atol=1e-5,
+        )
+
+
+class TestShardedTraining:
+    def test_tp_sharded_params_and_loss_decreases(self, devices):
+        mesh = MeshSpec(data=2, fsdp=2, tensor=2).build(devices)
+        init_fn, loss_fn = lm_task(CFG)
+        tr = Trainer(
+            init_fn=init_fn, loss_fn=loss_fn, tx=optax.adam(3e-3), mesh=mesh,
+            metrics=MetricsLogger(stream=open("/dev/null", "w")),
+        )
+        state = tr.create_state()
+        # MLP wi kernel [2, layers?, embed, ff]: ff dim sharded over tensor.
+        wi = state.params["layers"]["mlp"]["wi"]
+        spec = tuple(wi.sharding.spec)
+        assert TENSOR in spec and "fsdp" in spec, spec
+
+        rng = np.random.RandomState(0)
+
+        def data():
+            while True:
+                # Learnable structure: token t follows t (copy-ish stream).
+                start = rng.randint(0, 8, size=(8, 1))
+                toks = (start + np.arange(16)[None, :]) % 16
+                yield {"tokens": toks.astype(np.int32)}
+
+        state = tr.fit(data(), num_steps=30, examples_per_step=8, log_every=0)
+        assert tr._last_metrics["loss"] < 2.0, tr._last_metrics
+
+    def test_gqa_fewer_kv_heads(self):
+        model, vars_ = _init()
+        n_q = nn.unbox(vars_["params"]["layers"]["attn"]["wq"]).shape[2]
+        n_kv = nn.unbox(vars_["params"]["layers"]["attn"]["wkv"]).shape[3]
+        assert (n_q, n_kv) == (4, 2)
+
+
+class TestFlops:
+    def test_flops_positive_and_scales(self):
+        small = CFG.flops_per_token()
+        big = TransformerConfig(
+            **{**CFG.__dict__, "n_layers": 4}
+        ).flops_per_token()
+        assert 0 < small < big
