@@ -1,0 +1,173 @@
+"""Inception-v3 in Flax — the reference's serving showcase model.
+
+The reference's serving E2E test deployed an Inception SavedModel and
+diffed a gRPC Predict against golden outputs
+(testing/test_tf_serving.py; goldens at
+components/k8s-model-server/images/test-worker/result.txt).  This is the
+TPU-first re-implementation used by the serving path's classifier loader
+(serving/loaders.py): bf16 compute, NHWC, BatchNorm with fp32 stats.
+
+Architecture per Szegedy et al. 2015 ("Rethinking the Inception
+Architecture"): stem -> 3xInceptionA -> InceptionB -> 4xInceptionC ->
+InceptionD -> 2xInceptionE -> pool -> logits; 299x299 canonical input.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Sequence, Tuple
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+ModuleDef = Any
+
+
+class ConvBN(nn.Module):
+    """conv -> BN -> relu, the basic Inception unit."""
+
+    features: int
+    kernel: Tuple[int, int]
+    strides: Tuple[int, int] = (1, 1)
+    padding: Any = "SAME"
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = nn.Conv(self.features, self.kernel, self.strides,
+                    padding=self.padding, use_bias=False,
+                    dtype=self.dtype)(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9997,
+                         epsilon=1e-3, dtype=self.dtype)(x)
+        return nn.relu(x)
+
+
+def _pool(x, window=(3, 3), strides=(1, 1), kind="avg"):
+    fn = nn.avg_pool if kind == "avg" else nn.max_pool
+    return fn(x, window, strides=strides, padding="SAME")
+
+
+class InceptionA(nn.Module):
+    pool_features: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train=True):
+        c = functools.partial(ConvBN, dtype=self.dtype)
+        b1 = c(64, (1, 1))(x, train)
+        b2 = c(64, (5, 5))(c(48, (1, 1))(x, train), train)
+        b3 = c(96, (3, 3))(c(96, (3, 3))(c(64, (1, 1))(x, train), train), train)
+        b4 = c(self.pool_features, (1, 1))(_pool(x), train)
+        return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+
+
+class InceptionB(nn.Module):
+    """Grid reduction 35x35 -> 17x17."""
+
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train=True):
+        c = functools.partial(ConvBN, dtype=self.dtype)
+        b1 = c(384, (3, 3), strides=(2, 2), padding="VALID")(x, train)
+        b2 = c(96, (3, 3), strides=(2, 2), padding="VALID")(
+            c(96, (3, 3))(c(64, (1, 1))(x, train), train), train)
+        b3 = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        return jnp.concatenate([b1, b2, b3], axis=-1)
+
+
+class InceptionC(nn.Module):
+    channels_7x7: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train=True):
+        c = functools.partial(ConvBN, dtype=self.dtype)
+        c7 = self.channels_7x7
+        b1 = c(192, (1, 1))(x, train)
+        b2 = c(192, (7, 1))(c(c7, (1, 7))(c(c7, (1, 1))(x, train), train), train)
+        b3 = c(192, (1, 7))(
+            c(c7, (7, 1))(
+                c(c7, (1, 7))(
+                    c(c7, (7, 1))(c(c7, (1, 1))(x, train), train),
+                    train), train), train)
+        b4 = c(192, (1, 1))(_pool(x), train)
+        return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+
+
+class InceptionD(nn.Module):
+    """Grid reduction 17x17 -> 8x8."""
+
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train=True):
+        c = functools.partial(ConvBN, dtype=self.dtype)
+        b1 = c(320, (3, 3), strides=(2, 2), padding="VALID")(
+            c(192, (1, 1))(x, train), train)
+        b2 = c(192, (3, 3), strides=(2, 2), padding="VALID")(
+            c(192, (7, 1))(
+                c(192, (1, 7))(c(192, (1, 1))(x, train), train), train),
+            train)
+        b3 = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        return jnp.concatenate([b1, b2, b3], axis=-1)
+
+
+class InceptionE(nn.Module):
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train=True):
+        c = functools.partial(ConvBN, dtype=self.dtype)
+        b1 = c(320, (1, 1))(x, train)
+        b2in = c(384, (1, 1))(x, train)
+        b2 = jnp.concatenate([
+            c(384, (1, 3))(b2in, train), c(384, (3, 1))(b2in, train)
+        ], axis=-1)
+        b3in = c(384, (3, 3))(c(448, (1, 1))(x, train), train)
+        b3 = jnp.concatenate([
+            c(384, (1, 3))(b3in, train), c(384, (3, 1))(b3in, train)
+        ], axis=-1)
+        b4 = c(192, (1, 1))(_pool(x), train)
+        return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+
+
+class InceptionV3(nn.Module):
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+    dropout_rate: float = 0.2
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        c = functools.partial(ConvBN, dtype=self.dtype)
+        x = x.astype(self.dtype)
+        # Stem: 299x299x3 -> 35x35x192.
+        x = c(32, (3, 3), strides=(2, 2), padding="VALID")(x, train)
+        x = c(32, (3, 3), padding="VALID")(x, train)
+        x = c(64, (3, 3))(x, train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        x = c(80, (1, 1), padding="VALID")(x, train)
+        x = c(192, (3, 3), padding="VALID")(x, train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        # Inception stacks.
+        x = InceptionA(32, self.dtype)(x, train)
+        x = InceptionA(64, self.dtype)(x, train)
+        x = InceptionA(64, self.dtype)(x, train)
+        x = InceptionB(self.dtype)(x, train)
+        x = InceptionC(128, self.dtype)(x, train)
+        x = InceptionC(160, self.dtype)(x, train)
+        x = InceptionC(160, self.dtype)(x, train)
+        x = InceptionC(192, self.dtype)(x, train)
+        x = InceptionD(self.dtype)(x, train)
+        x = InceptionE(self.dtype)(x, train)
+        x = InceptionE(self.dtype)(x, train)
+        # Head.
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        x = nn.Dense(self.num_classes, dtype=jnp.float32,
+                     name="logits")(x.astype(jnp.float32))
+        return x
+
+
+# Canonical forward FLOPs per 299x299 image (~5.7 GFLOPs, 2*MAC).
+FWD_FLOPS_299 = 11.4e9 / 2

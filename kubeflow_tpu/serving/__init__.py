@@ -1,0 +1,23 @@
+"""Serving plane: versioned export, TPU model server, REST contract.
+
+Heir of the reference's L6 serving stack (SURVEY.md §1): the C++
+tensorflow_model_server + python http-proxy pair collapses into one
+first-party process — export.py is the SavedModel-equivalent on-disk
+contract, model_server.py the versioned loader/hot-swapper/batcher,
+http.py the reference-compatible REST surface, main.py the container
+entrypoint.
+"""
+
+from kubeflow_tpu.serving.export import export, list_versions, load_version
+from kubeflow_tpu.serving.http import ServingAPI, make_http_server
+from kubeflow_tpu.serving.model_server import MicroBatcher, ModelServer
+
+__all__ = [
+    "export",
+    "list_versions",
+    "load_version",
+    "ServingAPI",
+    "make_http_server",
+    "MicroBatcher",
+    "ModelServer",
+]

@@ -1,0 +1,108 @@
+"""Versioned model export — the SavedModel-equivalent for JAX models.
+
+The reference served C++ ``tensorflow_model_server`` pointed at a
+``--model_base_path`` of numbered SavedModel versions
+(kubeflow/tf-serving/tf-serving.libsonnet:118-132); new versions dropped
+into the directory are picked up live.  This module defines the TPU
+framework's on-disk contract with the same shape:
+
+    {base_path}/{version}/
+        model.json       — loader spec: how to rebuild the predict fn
+        params.msgpack   — flax-serialized variables
+
+``model.json`` names a *loader* (an importable ``module:function``) plus a
+config dict; the loader returns a callable ``predict(variables, inputs
+dict) -> outputs dict``.  The framework ships loaders for its model
+families (serving/loaders.py); user models register by exporting their own
+loader path.  This replaces TF's graph serialization with the JAX-native
+equivalent: code + weights, with jit/AOT compilation at load time.
+"""
+
+from __future__ import annotations
+
+import importlib
+import json
+import re
+from pathlib import Path
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from flax import serialization
+
+MODEL_FILE = "model.json"
+PARAMS_FILE = "params.msgpack"
+_VERSION_RE = re.compile(r"^\d+$")
+
+
+def export(
+    base_path: str | Path,
+    version: int,
+    variables: Any,
+    loader: str,
+    config: Optional[Dict[str, Any]] = None,
+    signature: Optional[Dict[str, Any]] = None,
+) -> Path:
+    """Write one model version.  Atomic: builds in a temp dir then renames,
+    so a half-written version is never visible to the watcher (the same
+    guarantee SavedModel writers provide)."""
+    base = Path(base_path)
+    final = base / str(version)
+    tmp = base / f".tmp-{version}"
+    if final.exists():
+        raise FileExistsError(f"version {version} already exists at {final}")
+    tmp.mkdir(parents=True, exist_ok=True)
+    (tmp / PARAMS_FILE).write_bytes(serialization.to_bytes(variables))
+    (tmp / MODEL_FILE).write_text(json.dumps({
+        "format": "kubeflow-tpu/1",
+        "loader": loader,
+        "config": config or {},
+        "signature": signature or {},
+    }, indent=2))
+    tmp.rename(final)
+    return final
+
+
+def list_versions(base_path: str | Path) -> List[int]:
+    base = Path(base_path)
+    if not base.is_dir():
+        return []
+    out = []
+    for child in base.iterdir():
+        if child.is_dir() and _VERSION_RE.match(child.name) \
+                and (child / MODEL_FILE).exists():
+            out.append(int(child.name))
+    return sorted(out)
+
+
+def resolve_loader(path: str) -> Callable:
+    """'pkg.mod:fn' -> callable."""
+    mod_name, _, fn_name = path.partition(":")
+    if not fn_name:
+        raise ValueError(f"loader {path!r} must be 'module:function'")
+    return getattr(importlib.import_module(mod_name), fn_name)
+
+
+def load_version(
+    base_path: str | Path, version: int
+) -> Tuple[Callable[[Dict[str, Any]], Dict[str, Any]], Dict[str, Any]]:
+    """Rebuild (predict_fn, metadata) for one exported version.
+
+    predict_fn takes/returns dicts of arrays — the serving server's only
+    interface to the model.
+    """
+    vdir = Path(base_path) / str(version)
+    spec = json.loads((vdir / MODEL_FILE).read_text())
+    if spec.get("format") != "kubeflow-tpu/1":
+        raise ValueError(f"unknown model format in {vdir}: {spec.get('format')}")
+    loader = resolve_loader(spec["loader"])
+    make_predict = loader(spec["config"])
+    variables = serialization.msgpack_restore(
+        (vdir / PARAMS_FILE).read_bytes()
+    )
+    predict = make_predict(variables)
+    meta = {
+        "loader": spec["loader"],
+        "config": spec["config"],
+        "signature": spec["signature"],
+        "version": version,
+    }
+    return predict, meta

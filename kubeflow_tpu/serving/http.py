@@ -1,0 +1,215 @@
+"""REST front-end implementing the reference http-proxy wire contract.
+
+Routes, request keys, b64 handling, and response shapes mirror
+components/k8s-model-server/http-proxy/server.py:283-297 (route table),
+:208-249 (PredictHandler: {"instances": [...]} -> {"predictions": [...]}),
+:177-186 (decode_b64_if_needed), :200-206 (MetadataHandler) — so clients
+written against the reference proxy work unchanged.  The gRPC hop behind
+the proxy is gone: the model lives in this process on the TPU, the REST
+layer calls it through ModelServer (optionally via the MicroBatcher).
+
+Implementation is stdlib http.server (threaded): zero extra deps, and the
+serving container stays a single process.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import logging
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from kubeflow_tpu.serving.model_server import ModelServer
+
+log = logging.getLogger(__name__)
+
+WELCOME = "kubeflow-tpu model server"
+
+_ROUTES = [
+    ("GET", re.compile(r"^/model/(?P<name>[^/:]+):metadata$"), "metadata"),
+    ("POST", re.compile(r"^/model/(?P<name>[^/:]+):predict$"), "predict"),
+    ("POST", re.compile(r"^/model/(?P<name>[^/:]+):classify$"), "classify"),
+    ("POST", re.compile(
+        r"^/model/(?P<name>[^/:]+)/version/(?P<version>\d+):predict$"),
+     "predict"),
+    ("POST", re.compile(
+        r"^/model/(?P<name>[^/:]+)/version/(?P<version>\d+):classify$"),
+     "classify"),
+    ("GET", re.compile(r"^/$"), "index"),
+    ("GET", re.compile(r"^/healthz$"), "health"),
+]
+
+
+def decode_b64_if_needed(value: Any) -> Any:
+    """Recursively decode {"b64": "..."} leaves (reference server.py:177)."""
+    if isinstance(value, dict):
+        if len(value) == 1 and "b64" in value:
+            return np.frombuffer(
+                base64.b64decode(value["b64"]), dtype=np.uint8
+            )
+        return {k: decode_b64_if_needed(v) for k, v in value.items()}
+    if isinstance(value, list):
+        return [decode_b64_if_needed(v) for v in value]
+    return value
+
+
+def instances_to_inputs(
+    instances: List[Any], input_names: Optional[List[str]] = None
+) -> Dict[str, np.ndarray]:
+    """Column-ize row-major instances, as the reference did per-column
+    (server.py:240-242).  Non-dict rows bind to the signature's sole
+    input."""
+    if not isinstance(instances, (list, tuple)) or not instances:
+        raise ValueError("'instances' must be a non-empty list")
+    first = instances[0]
+    if isinstance(first, dict):
+        columns = list(first.keys())
+        return {
+            c: np.stack([np.asarray(row[c]) for row in instances])
+            for c in columns
+        }
+    if input_names and len(input_names) == 1:
+        name = input_names[0]
+    else:
+        raise ValueError(
+            "non-dict instances require a single-input signature"
+        )
+    return {name: np.stack([np.asarray(row) for row in instances])}
+
+
+def outputs_to_predictions(outputs: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """Row-ize output columns back to per-instance dicts
+    (reference server.py:246-248)."""
+    arrays = {k: np.asarray(v) for k, v in outputs.items()}
+    n = next(iter(arrays.values())).shape[0]
+    return [
+        {k: v[i].tolist() for k, v in arrays.items()} for i in range(n)
+    ]
+
+
+class ServingAPI:
+    """Transport-independent request handling (shared by tests + HTTP)."""
+
+    def __init__(self, server: ModelServer):
+        self.server = server
+
+    def metadata(self, name: str) -> Dict[str, Any]:
+        model = self.server.get(name)
+        return {
+            "model_spec": {"name": name, "version": str(model.version)},
+            "metadata": {
+                "signature": model.meta.get("signature", {}),
+                "loader": model.meta.get("loader"),
+            },
+        }
+
+    def predict(
+        self, name: str, body: Dict[str, Any],
+        version: Optional[int] = None,
+    ) -> Dict[str, Any]:
+        instances = body.get("instances")
+        if instances is None:
+            raise ValueError("Request json object must use the key: instances")
+        instances = decode_b64_if_needed(instances)
+        model = self.server.get(name, version)
+        sig_inputs = list(
+            model.meta.get("signature", {}).get("inputs", []) or []
+        )
+        inputs = instances_to_inputs(instances, sig_inputs or None)
+        outputs = self.server.predict(name, inputs, version)
+        return {"predictions": outputs_to_predictions(outputs)}
+
+    def classify(
+        self, name: str, body: Dict[str, Any],
+        version: Optional[int] = None,
+    ) -> Dict[str, Any]:
+        """Classification response shape: [[ [class_id, score], ... ], ...]
+        per instance (TF-Serving ClassificationResult equivalent)."""
+        result = self.predict(name, body, version)
+        classifications = []
+        for row in result["predictions"]:
+            if "top_k_classes" in row:
+                pairs = [
+                    [str(c), float(s)]
+                    for c, s in zip(row["top_k_classes"], row["top_k_scores"])
+                ]
+            else:
+                scores = row.get("scores", [])
+                pairs = [[str(i), float(s)] for i, s in enumerate(scores)]
+            classifications.append(pairs)
+        return {"result": {"classifications": classifications}}
+
+
+class _Handler(BaseHTTPRequestHandler):
+    api: ServingAPI  # set by make_http_server
+
+    def log_message(self, fmt, *args):  # route to logging, not stderr spam
+        log.debug("http: " + fmt, *args)
+
+    def _dispatch(self, method: str) -> None:
+        for m, pattern, action in _ROUTES:
+            if m != method:
+                continue
+            match = pattern.match(self.path)
+            if not match:
+                continue
+            try:
+                self._run(action, match.groupdict())
+            except KeyError as e:
+                self._send(404, {"error": str(e)})
+            except ValueError as e:
+                self._send(400, {"error": str(e)})
+            except Exception as e:  # noqa: BLE001 — serving must not die
+                log.exception("handler error")
+                self._send(500, {"error": f"{type(e).__name__}: {e}"})
+            return
+        self._send(404, {"error": f"no route for {method} {self.path}"})
+
+    def _run(self, action: str, groups: Dict[str, str]) -> None:
+        version = int(groups["version"]) if groups.get("version") else None
+        if action == "index":
+            self._send(200, WELCOME, raw=True)
+        elif action == "health":
+            self._send(200, {"status": "ok", "models": self.api.server.models()})
+        elif action == "metadata":
+            self._send(200, self.api.metadata(groups["name"]))
+        else:
+            length = int(self.headers.get("Content-Length", 0))
+            body = json.loads(self.rfile.read(length) or b"{}")
+            fn = getattr(self.api, action)
+            self._send(200, fn(groups["name"], body, version))
+
+    def _send(self, code: int, payload: Any, raw: bool = False) -> None:
+        data = (payload if raw else json.dumps(payload)).encode()
+        self.send_response(code)
+        self.send_header(
+            "Content-Type", "text/plain" if raw else "application/json"
+        )
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self):
+        self._dispatch("GET")
+
+    def do_POST(self):
+        self._dispatch("POST")
+
+
+def make_http_server(
+    model_server: ModelServer, port: int = 8000, host: str = "0.0.0.0"
+) -> Tuple[ThreadingHTTPServer, threading.Thread]:
+    """Build and start the REST server on a daemon thread; returns
+    (httpd, thread).  Port 8000 matches the reference proxy
+    (kubeflow/tf-serving/tf-serving.libsonnet:176-207)."""
+    handler = type("BoundHandler", (_Handler,), {"api": ServingAPI(model_server)})
+    httpd = ThreadingHTTPServer((host, port), handler)
+    thread = threading.Thread(target=httpd.serve_forever, daemon=True,
+                              name="serving-http")
+    thread.start()
+    return httpd, thread

@@ -1,0 +1,89 @@
+"""Built-in loaders: rebuild predict functions from exported configs.
+
+A loader is ``fn(config) -> (variables -> predict)`` where predict maps
+{input_name: array} -> {output_name: array}.  Loader paths are recorded in
+model.json at export time (serving/export.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict
+
+import jax
+import jax.numpy as jnp
+
+
+def classifier(config: Dict[str, Any]) -> Callable:
+    """Image classifier over models/resnet.py or models/inception.py.
+
+    config: {"family": "resnet50"|"inception_v3"|..., "num_classes": int}
+    Signature: {"image": [b, h, w, 3] float32} ->
+               {"scores": [b, classes], "classes": [b, top_k]}
+    """
+    family = config.get("family", "resnet50")
+    num_classes = int(config.get("num_classes", 1000))
+    top_k = int(config.get("top_k", 5))
+    if family.startswith("resnet"):
+        from kubeflow_tpu.models.resnet import ResNetConfig
+
+        factory = ResNetConfig._FACTORIES.get(family)
+        if factory is None:
+            raise ValueError(f"unknown resnet family {family!r}")
+        model = factory(
+            num_classes=num_classes,
+            num_filters=int(config.get("num_filters", 64)),
+        )
+    elif family == "inception_v3":
+        from kubeflow_tpu.models.inception import InceptionV3
+
+        model = InceptionV3(num_classes=num_classes)
+    else:
+        raise ValueError(f"unknown classifier family {family!r}")
+
+    def make_predict(variables):
+        @jax.jit
+        def fwd(image):
+            logits = model.apply(variables, image, train=False)
+            probs = jax.nn.softmax(logits, axis=-1)
+            top = jax.lax.top_k(probs, top_k)
+            return probs, top
+
+        def predict(inputs: Dict[str, Any]) -> Dict[str, Any]:
+            image = jnp.asarray(inputs["image"], jnp.float32)
+            if image.ndim == 3:
+                image = image[None]
+            probs, (top_p, top_i) = fwd(image)
+            return {
+                "scores": probs,
+                "top_k_scores": top_p,
+                "top_k_classes": top_i,
+            }
+
+        return predict
+
+    return make_predict
+
+
+def lm(config: Dict[str, Any]) -> Callable:
+    """Transformer LM loader: next-token logits for a token batch.
+
+    config: TransformerConfig field overrides.
+    Signature: {"tokens": [b, s] int32} -> {"logits": [b, s, vocab]}
+    """
+    from kubeflow_tpu.models.transformer import Transformer, TransformerConfig
+
+    cfg = TransformerConfig(**config)
+    model = Transformer(cfg)
+
+    def make_predict(variables):
+        @jax.jit
+        def fwd(tokens):
+            return model.apply(variables, tokens)
+
+        def predict(inputs: Dict[str, Any]) -> Dict[str, Any]:
+            tokens = jnp.asarray(inputs["tokens"], jnp.int32)
+            return {"logits": fwd(tokens)}
+
+        return predict
+
+    return make_predict

@@ -1,0 +1,51 @@
+"""Serving container entrypoint.
+
+Flag-compatible heir of the model server invocation the reference's
+manifests assembled: ``tensorflow_model_server --port=9000
+--model_name=... --model_base_path=...``
+(kubeflow/tf-serving/tf-serving.libsonnet:118-132) plus the http proxy's
+``--port 8000`` sidecar (:176-207) — here one process serves both the
+REST contract and (optionally) warm models on the local TPU.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import sys
+import threading
+
+from kubeflow_tpu.serving.http import make_http_server
+from kubeflow_tpu.serving.model_server import ModelServer
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="kubeflow-tpu-serve")
+    ap.add_argument("--model_name", required=True)
+    ap.add_argument("--model_base_path", required=True)
+    ap.add_argument("--port", type=int, default=8000,
+                    help="REST port (reference http-proxy contract)")
+    ap.add_argument("--poll_interval_s", type=float, default=2.0,
+                    help="model version poll period (hot-swap latency)")
+    ap.add_argument("--host", default="0.0.0.0")
+    args = ap.parse_args(argv)
+
+    logging.basicConfig(level=logging.INFO, stream=sys.stderr)
+    server = ModelServer(poll_interval_s=args.poll_interval_s)
+    server.add_model(args.model_name, args.model_base_path)
+    server.start_watcher()
+    httpd, _ = make_http_server(server, port=args.port, host=args.host)
+    logging.info("serving %r on :%d", args.model_name, args.port)
+
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    stop.wait()
+    httpd.shutdown()
+    server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

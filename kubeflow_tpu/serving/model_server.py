@@ -1,0 +1,229 @@
+"""Model server core: versioned loading, hot-swap, micro-batching.
+
+TPU-native heir of C++ ``tensorflow_model_server``
+(kubeflow/tf-serving/tf-serving.libsonnet:118-132): watches a model base
+path for numbered versions, serves the latest, hot-swaps when new versions
+land, and unloads superseded ones — the semantics the reference got for
+free from TF-Serving (SURVEY.md §7 "Hard parts: serving on TPU").
+
+Batching: TPU inference wants large, fixed-shape batches for the MXU; the
+MicroBatcher coalesces concurrent single requests into one device call,
+padding to the nearest allowed batch size so XLA reuses a handful of
+compiled programs instead of one per request shape.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from kubeflow_tpu.serving.export import list_versions, load_version
+
+log = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class LoadedModel:
+    name: str
+    version: int
+    predict: Callable[[Dict[str, Any]], Dict[str, Any]]
+    meta: Dict[str, Any]
+
+
+class ModelServer:
+    """Serves N named models, each from a versioned base path."""
+
+    def __init__(self, poll_interval_s: float = 2.0):
+        self._models: Dict[str, Dict[int, LoadedModel]] = {}
+        self._base_paths: Dict[str, str] = {}
+        self._lock = threading.RLock()
+        self._poll_interval_s = poll_interval_s
+        self._watcher: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    # -- loading ----------------------------------------------------------
+
+    def add_model(self, name: str, base_path: str) -> None:
+        with self._lock:
+            self._base_paths[name] = base_path
+            self._models.setdefault(name, {})
+        self.reload(name)
+
+    def reload(self, name: str) -> bool:
+        """Scan the base path; load new latest version, drop stale ones.
+        Returns True if the served version changed."""
+        base = self._base_paths[name]
+        versions = list_versions(base)
+        if not versions:
+            log.warning("no versions for model %r under %s", name, base)
+            return False
+        latest = versions[-1]
+        with self._lock:
+            have = self._models[name]
+            if latest in have:
+                return False
+        predict, meta = load_version(base, latest)
+        with self._lock:
+            self._models[name][latest] = LoadedModel(
+                name=name, version=latest, predict=predict, meta=meta
+            )
+            # Keep only the latest (TF-Serving default version policy).
+            for v in [v for v in self._models[name] if v != latest]:
+                del self._models[name][v]
+        log.info("model %r now serving version %d", name, latest)
+        return True
+
+    def start_watcher(self) -> None:
+        """Background version polling — the hot-swap path."""
+        if self._watcher is not None:
+            return
+        self._stop.clear()
+
+        def run():
+            while not self._stop.wait(self._poll_interval_s):
+                for name in list(self._base_paths):
+                    try:
+                        self.reload(name)
+                    except Exception:
+                        log.exception("reload of %r failed", name)
+
+        self._watcher = threading.Thread(target=run, daemon=True,
+                                         name="version-watcher")
+        self._watcher.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._watcher is not None:
+            self._watcher.join(timeout=5)
+            self._watcher = None
+
+    # -- queries ----------------------------------------------------------
+
+    def get(self, name: str, version: Optional[int] = None) -> LoadedModel:
+        with self._lock:
+            if name not in self._models or not self._models[name]:
+                raise KeyError(f"model {name!r} not loaded")
+            versions = self._models[name]
+            if version is None:
+                return versions[max(versions)]
+            if version not in versions:
+                raise KeyError(
+                    f"model {name!r} has no version {version}; "
+                    f"serving {sorted(versions)}"
+                )
+            return versions[version]
+
+    def models(self) -> Dict[str, List[int]]:
+        with self._lock:
+            return {n: sorted(v) for n, v in self._models.items()}
+
+    def predict(
+        self, name: str, inputs: Dict[str, Any],
+        version: Optional[int] = None,
+    ) -> Dict[str, Any]:
+        model = self.get(name, version)
+        return model.predict(inputs)
+
+
+class MicroBatcher:
+    """Coalesce concurrent requests into padded device batches.
+
+    Callers block in ``submit`` until their rows come back.  Batches are
+    padded up to the next size in ``allowed_batch_sizes`` so the jitted
+    predict fn compiles once per size, not once per request count —
+    the TF-Serving batching-parameters idea, TPU-shaped.
+    """
+
+    def __init__(
+        self,
+        predict: Callable[[Dict[str, Any]], Dict[str, Any]],
+        *,
+        max_batch_size: int = 8,
+        batch_timeout_s: float = 0.005,
+        allowed_batch_sizes: Optional[List[int]] = None,
+    ):
+        self._predict = predict
+        self.max_batch_size = max_batch_size
+        self.batch_timeout_s = batch_timeout_s
+        self.allowed = sorted(allowed_batch_sizes or [1, 2, 4, 8])
+        self._lock = threading.Lock()
+        self._pending: List[dict] = []
+        self._flusher = threading.Condition(self._lock)
+        self._runner = threading.Thread(target=self._run, daemon=True,
+                                        name="microbatcher")
+        self._stopped = False
+        self._runner.start()
+
+    def submit(self, inputs: Dict[str, Any]) -> Dict[str, Any]:
+        """One logical request of batch-dim 1 (or [1, ...] rows)."""
+        entry = {"inputs": inputs, "event": threading.Event(), "out": None,
+                 "err": None}
+        with self._lock:
+            self._pending.append(entry)
+            self._flusher.notify()
+        entry["event"].wait()
+        if entry["err"] is not None:
+            raise entry["err"]
+        return entry["out"]
+
+    def close(self) -> None:
+        with self._lock:
+            self._stopped = True
+            self._flusher.notify()
+        self._runner.join(timeout=5)
+
+    def _run(self) -> None:
+        while True:
+            with self._lock:
+                while not self._pending and not self._stopped:
+                    self._flusher.wait()
+                if self._stopped and not self._pending:
+                    return
+                deadline = time.monotonic() + self.batch_timeout_s
+                while (len(self._pending) < self.max_batch_size
+                       and not self._stopped):
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    self._flusher.wait(timeout=remaining)
+                batch = self._pending[:self.max_batch_size]
+                del self._pending[:len(batch)]
+            self._process(batch)
+
+    def _pad_size(self, n: int) -> int:
+        for size in self.allowed:
+            if n <= size:
+                return size
+        return self.allowed[-1]
+
+    def _process(self, batch: List[dict]) -> None:
+        try:
+            keys = batch[0]["inputs"].keys()
+            stacked = {
+                k: np.concatenate(
+                    [np.asarray(e["inputs"][k]) for e in batch], axis=0
+                )
+                for k in keys
+            }
+            n = len(batch)
+            size = self._pad_size(n)
+            if size > n:
+                stacked = {
+                    k: np.concatenate(
+                        [v] + [v[:1]] * (size - n), axis=0
+                    ) for k, v in stacked.items()
+                }
+            outputs = self._predict(stacked)
+            for i, e in enumerate(batch):
+                e["out"] = {k: np.asarray(v)[i:i + 1]
+                            for k, v in outputs.items()}
+                e["event"].set()
+        except Exception as exc:  # propagate to all waiters
+            for e in batch:
+                e["err"] = exc
+                e["event"].set()

@@ -1,0 +1,29 @@
+"""Inception-v3 structural tests (small spatial input to keep CPU cost sane)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubeflow_tpu.models.inception import InceptionV3
+
+
+def test_forward_shapes_and_dtype():
+    model = InceptionV3(num_classes=10)
+    x = jnp.zeros((1, 96, 96, 3))
+    variables = model.init(jax.random.key(0), x, train=False)
+    out = model.apply(variables, x, train=False)
+    assert out.shape == (1, 10)
+    assert out.dtype == jnp.float32
+
+
+def test_train_mode_updates_batch_stats():
+    model = InceptionV3(num_classes=4)
+    x = jnp.asarray(np.random.RandomState(0).randn(2, 96, 96, 3), jnp.float32)
+    variables = model.init(jax.random.key(0), x, train=False)
+    _, updated = model.apply(
+        variables, x, train=True, mutable=["batch_stats"],
+        rngs={"dropout": jax.random.key(1)},
+    )
+    before = jax.tree_util.tree_leaves(variables["batch_stats"])[0]
+    after = jax.tree_util.tree_leaves(updated["batch_stats"])[0]
+    assert not np.allclose(np.asarray(before), np.asarray(after))

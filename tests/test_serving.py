@@ -1,0 +1,225 @@
+"""Serving plane tests: export/load, version hot-swap, REST contract,
+micro-batching.  The REST wire format is checked against the reference
+proxy's shapes (instances/predictions, b64, metadata, classify)."""
+
+import base64
+import json
+import threading
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from kubeflow_tpu.models.resnet import ResNet18
+from kubeflow_tpu.serving.export import export, list_versions, load_version
+from kubeflow_tpu.serving.http import (
+    ServingAPI,
+    decode_b64_if_needed,
+    make_http_server,
+)
+from kubeflow_tpu.serving.model_server import MicroBatcher, ModelServer
+
+CLASSES, IMG = 4, 32
+
+
+@pytest.fixture(scope="module")
+def exported(tmp_path_factory):
+    base = tmp_path_factory.mktemp("models") / "tiny"
+    model = ResNet18(num_classes=CLASSES, num_filters=8)
+    variables = model.init(
+        jax.random.key(0), np.zeros((1, IMG, IMG, 3), np.float32),
+        train=False,
+    )
+    export(
+        base, 1, variables,
+        loader="kubeflow_tpu.serving.loaders:classifier",
+        config={"family": "resnet18", "num_classes": CLASSES, "top_k": 2,
+                "num_filters": 8},
+        signature={"inputs": ["image"],
+                   "outputs": ["scores", "top_k_scores", "top_k_classes"]},
+    )
+    return base, model, variables
+
+
+# The classifier loader must honor num_filters for the tiny test net.
+@pytest.fixture(autouse=True, scope="module")
+def _tiny_loader_support():
+    yield
+
+
+class TestExport:
+    def test_versions_listed(self, exported):
+        base, _, _ = exported
+        assert list_versions(base) == [1]
+
+    def test_load_and_predict_matches_direct(self, exported):
+        base, model, variables = exported
+        predict, meta = load_version(base, 1)
+        rng = np.random.RandomState(0)
+        img = rng.randn(2, IMG, IMG, 3).astype(np.float32)
+        out = predict({"image": img})
+        direct = model.apply(variables, img, train=False)
+        probs = np.asarray(jax.nn.softmax(direct, axis=-1))
+        np.testing.assert_allclose(
+            np.asarray(out["scores"]), probs, atol=1e-5
+        )
+        assert meta["version"] == 1
+
+    def test_duplicate_version_rejected(self, exported):
+        base, _, variables = exported
+        with pytest.raises(FileExistsError):
+            export(base, 1, variables, loader="x:y")
+
+
+class TestModelServer:
+    def test_serves_latest_and_hot_swaps(self, exported, tmp_path):
+        src, model, variables = exported
+        import shutil
+
+        base = tmp_path / "tiny"
+        shutil.copytree(src, base)
+        srv = ModelServer()
+        srv.add_model("tiny", str(base))
+        assert srv.get("tiny").version == 1
+
+        export(
+            base, 2, variables,
+            loader="kubeflow_tpu.serving.loaders:classifier",
+            config={"family": "resnet18", "num_classes": CLASSES,
+                    "top_k": 2, "num_filters": 8},
+        )
+        changed = srv.reload("tiny")
+        assert changed and srv.get("tiny").version == 2
+        # Old version unloaded (latest-only policy).
+        with pytest.raises(KeyError):
+            srv.get("tiny", version=1)
+
+    def test_unknown_model(self):
+        srv = ModelServer()
+        with pytest.raises(KeyError):
+            srv.get("nope")
+
+
+class TestRESTContract:
+    @pytest.fixture(scope="class")
+    def api(self, exported):
+        base, _, _ = exported
+        srv = ModelServer()
+        srv.add_model("tiny", str(base))
+        return ServingAPI(srv)
+
+    def test_predict_instances_to_predictions(self, api):
+        rng = np.random.RandomState(1)
+        instances = [
+            {"image": rng.randn(IMG, IMG, 3).astype(np.float32).tolist()}
+            for _ in range(3)
+        ]
+        out = api.predict("tiny", {"instances": instances})
+        assert len(out["predictions"]) == 3
+        row = out["predictions"][0]
+        assert set(row) == {"scores", "top_k_scores", "top_k_classes"}
+        assert len(row["scores"]) == CLASSES
+
+    def test_predict_missing_instances_is_400(self, api):
+        with pytest.raises(ValueError, match="instances"):
+            api.predict("tiny", {"inputs": []})
+
+    def test_classify_shape(self, api):
+        rng = np.random.RandomState(2)
+        instances = [
+            {"image": rng.randn(IMG, IMG, 3).astype(np.float32).tolist()}
+        ]
+        out = api.classify("tiny", {"instances": instances})
+        pairs = out["result"]["classifications"][0]
+        assert len(pairs) == 2  # top_k
+        assert isinstance(pairs[0][0], str) and isinstance(pairs[0][1], float)
+
+    def test_metadata(self, api):
+        meta = api.metadata("tiny")
+        assert meta["model_spec"]["name"] == "tiny"
+        assert meta["metadata"]["signature"]["inputs"] == ["image"]
+
+    def test_b64_decode(self):
+        raw = np.arange(4, dtype=np.uint8).tobytes()
+        decoded = decode_b64_if_needed(
+            [{"b64": base64.b64encode(raw).decode()}]
+        )
+        np.testing.assert_array_equal(decoded[0], np.arange(4, dtype=np.uint8))
+
+
+class TestHTTPEndToEnd:
+    def test_full_http_roundtrip(self, exported):
+        base, _, _ = exported
+        srv = ModelServer()
+        srv.add_model("tiny", str(base))
+        httpd, thread = make_http_server(srv, port=0, host="127.0.0.1")
+        port = httpd.server_address[1]
+        try:
+            rng = np.random.RandomState(3)
+            body = json.dumps({
+                "instances": [
+                    {"image": rng.randn(IMG, IMG, 3).astype(
+                        np.float32).tolist()}
+                ]
+            }).encode()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/model/tiny:predict",
+                data=body, headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                out = json.loads(resp.read())
+            assert len(out["predictions"]) == 1
+
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/model/tiny:metadata", timeout=30
+            ) as resp:
+                meta = json.loads(resp.read())
+            assert meta["model_spec"]["version"] == "1"
+
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=30
+            ) as resp:
+                health = json.loads(resp.read())
+            assert health["models"] == {"tiny": [1]}
+        finally:
+            httpd.shutdown()
+
+
+class TestMicroBatcher:
+    def test_batches_concurrent_requests(self):
+        calls = []
+
+        def predict(inputs):
+            calls.append(inputs["x"].shape[0])
+            return {"y": inputs["x"] * 2}
+
+        mb = MicroBatcher(predict, max_batch_size=4, batch_timeout_s=0.05,
+                          allowed_batch_sizes=[1, 2, 4])
+        results = {}
+
+        def worker(i):
+            results[i] = mb.submit({"x": np.full((1, 2), float(i))})
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        mb.close()
+        for i in range(4):
+            np.testing.assert_allclose(
+                results[i]["y"], np.full((1, 2), 2.0 * i)
+            )
+        # Requests were coalesced: fewer device calls than requests.
+        assert sum(calls) >= 4 and len(calls) < 4
+
+    def test_error_propagates(self):
+        def predict(inputs):
+            raise RuntimeError("boom")
+
+        mb = MicroBatcher(predict, batch_timeout_s=0.01)
+        with pytest.raises(RuntimeError, match="boom"):
+            mb.submit({"x": np.zeros((1,))})
+        mb.close()
