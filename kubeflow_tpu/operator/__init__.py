@@ -1,0 +1,37 @@
+"""Control plane: TPUJob CRD model, gang scheduler, reconciler.
+
+First-party heir of the reference's L3 operators (SURVEY.md §1) — the
+external tf-operator/pytorch-operator Go binaries become an in-tree
+controller with all-or-nothing slice admission and
+restart-the-gang-from-checkpoint failure semantics.
+"""
+
+from kubeflow_tpu.operator.crd import (
+    GROUP,
+    KIND,
+    VERSION,
+    MeshSpec,
+    RestartPolicy,
+    SpecError,
+    StorageSpec,
+    TPUJobSpec,
+    WorkerSpec,
+)
+from kubeflow_tpu.operator.gang import GangScheduler
+from kubeflow_tpu.operator.kube import FakeKube
+from kubeflow_tpu.operator.reconciler import TPUJobController
+
+__all__ = [
+    "GROUP",
+    "KIND",
+    "VERSION",
+    "MeshSpec",
+    "RestartPolicy",
+    "SpecError",
+    "StorageSpec",
+    "TPUJobSpec",
+    "WorkerSpec",
+    "GangScheduler",
+    "FakeKube",
+    "TPUJobController",
+]

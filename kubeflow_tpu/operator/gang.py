@@ -1,0 +1,123 @@
+"""Gang scheduler: all-or-nothing admission of jobs onto slice inventory.
+
+The hard part the reference never solved (SURVEY.md §7): it created plain
+pods and let the default scheduler place them one by one
+(kubeflow/openmpi/workloads.libsonnet:10-26 with an optional
+``schedulerName`` param) — partial placement of an MPI gang deadlocked
+until timeout.  A TPU pod slice makes partial placement *meaningless*:
+the slice is one indivisible machine.  This scheduler therefore admits a
+job only when its full slice demand is free, holds FIFO order per queue
+(no starvation by smaller later jobs), and records the
+gang-schedule-to-running latency that BASELINE.md tracks as a north-star
+metric.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Dict, List, Optional
+
+
+@dataclasses.dataclass
+class SliceClaim:
+    job: str
+    slice_type: str
+    count: int
+    admitted_at: float
+
+
+class GangScheduler:
+    """Inventory-based admission over {slice_type: capacity}.
+
+    The inventory abstracts GKE node-pools of TPU slices: capacity is how
+    many whole slices of each shape exist.  ``offer`` either admits the
+    job (claiming all its slices atomically) or queues it.
+    """
+
+    def __init__(self, inventory: Dict[str, int]):
+        self._lock = threading.RLock()
+        self.capacity = dict(inventory)
+        self.claims: Dict[str, SliceClaim] = {}
+        self.queue: List[dict] = []  # FIFO of pending offers
+        self.metrics: List[dict] = []
+
+    def free(self, slice_type: str) -> int:
+        with self._lock:
+            used = sum(c.count for c in self.claims.values()
+                       if c.slice_type == slice_type)
+            return self.capacity.get(slice_type, 0) - used
+
+    def offer(self, job: str, slice_type: str, count: int = 1,
+              queue: str = "default") -> bool:
+        """Try to admit `job`; returns True if admitted now.
+
+        FIFO per queue: a job behind an unsatisfiable head waits even if
+        it would fit — the same head-of-line rule volcano/kueue use by
+        default, preventing large-job starvation.
+        """
+        with self._lock:
+            if job in self.claims:
+                return True
+            entry = {"job": job, "slice_type": slice_type, "count": count,
+                     "queue": queue, "enqueued_at": time.monotonic()}
+            if not any(e["job"] == job for e in self.queue):
+                self.queue.append(entry)
+            self._drain()
+            return job in self.claims
+
+    def release(self, job: str) -> None:
+        with self._lock:
+            self.claims.pop(job, None)
+            self.queue = [e for e in self.queue if e["job"] != job]
+            self._drain()
+
+    def admitted(self, job: str) -> bool:
+        with self._lock:
+            return job in self.claims
+
+    def position(self, job: str) -> Optional[int]:
+        with self._lock:
+            for i, e in enumerate(self.queue):
+                if e["job"] == job:
+                    return i
+            return None
+
+    def _drain(self) -> None:
+        """Admit queue heads while capacity allows (per-queue FIFO)."""
+        blocked_queues = set()
+        remaining = []
+        for entry in self.queue:
+            q = entry["queue"]
+            if q in blocked_queues:
+                remaining.append(entry)
+                continue
+            if self.capacity.get(entry["slice_type"], 0) < entry["count"]:
+                # Can never fit: fail fast by leaving it queued but flagged.
+                entry["unsatisfiable"] = True
+                blocked_queues.add(q)
+                remaining.append(entry)
+                continue
+            if self.free(entry["slice_type"]) >= entry["count"]:
+                now = time.monotonic()
+                self.claims[entry["job"]] = SliceClaim(
+                    job=entry["job"], slice_type=entry["slice_type"],
+                    count=entry["count"], admitted_at=now,
+                )
+                self.metrics.append({
+                    "event": "gang_admitted",
+                    "job": entry["job"],
+                    "queue_wait_s": now - entry["enqueued_at"],
+                })
+            else:
+                blocked_queues.add(q)
+                remaining.append(entry)
+        self.queue = remaining
+
+    def queue_wait_p50_s(self) -> Optional[float]:
+        with self._lock:
+            waits = sorted(m["queue_wait_s"] for m in self.metrics)
+            if not waits:
+                return None
+            return waits[len(waits) // 2]

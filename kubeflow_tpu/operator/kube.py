@@ -1,0 +1,157 @@
+"""Kubernetes API abstraction + in-memory fake.
+
+The reference's operators were external Go binaries talking to a real API
+server, testable only on rented clusters (SURVEY.md §4: "no fake k8s API
+server").  Here the reconciler is written against this minimal interface,
+and FakeKube gives CI a complete in-memory cluster: pods with controllable
+phases, events, CR status updates — so gang semantics and failure recovery
+are unit-testable.
+
+A production deployment backs the same interface with the official
+``kubernetes`` python client (operator/kube_real.py builds it lazily so
+the package never hard-depends on cluster credentials).
+"""
+
+from __future__ import annotations
+
+import copy
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+ObjectDict = Dict[str, Any]
+
+# Pod phases (k8s core/v1 semantics).
+PENDING = "Pending"
+RUNNING = "Running"
+SUCCEEDED = "Succeeded"
+FAILED = "Failed"
+
+
+class Conflict(Exception):
+    """Create of an object that already exists."""
+
+
+class NotFound(Exception):
+    """Get/delete of a missing object."""
+
+
+def _key(namespace: str, name: str) -> Tuple[str, str]:
+    return (namespace, name)
+
+
+class FakeKube:
+    """In-memory cluster state. Thread-safe; no watches — the reconciler
+    polls (level-triggered reconciliation, the controller-runtime model)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self.pods: Dict[Tuple[str, str], ObjectDict] = {}
+        self.services: Dict[Tuple[str, str], ObjectDict] = {}
+        self.custom: Dict[Tuple[str, str], ObjectDict] = {}
+        self.events: List[ObjectDict] = []
+        self.deleted_pods: List[str] = []
+
+    # -- pods -------------------------------------------------------------
+
+    def create_pod(self, pod: ObjectDict) -> ObjectDict:
+        with self._lock:
+            key = _key(pod["metadata"]["namespace"], pod["metadata"]["name"])
+            if key in self.pods:
+                raise Conflict(f"pod {key} exists")
+            pod = copy.deepcopy(pod)
+            pod.setdefault("status", {})["phase"] = PENDING
+            self.pods[key] = pod
+            return copy.deepcopy(pod)
+
+    def get_pod(self, namespace: str, name: str) -> ObjectDict:
+        with self._lock:
+            try:
+                return copy.deepcopy(self.pods[_key(namespace, name)])
+            except KeyError:
+                raise NotFound(f"pod {namespace}/{name}") from None
+
+    def list_pods(self, namespace: str,
+                  labels: Optional[Dict[str, str]] = None) -> List[ObjectDict]:
+        with self._lock:
+            out = []
+            for (ns, _), pod in self.pods.items():
+                if ns != namespace:
+                    continue
+                pod_labels = pod["metadata"].get("labels", {})
+                if labels and any(pod_labels.get(k) != v
+                                  for k, v in labels.items()):
+                    continue
+                out.append(copy.deepcopy(pod))
+            return out
+
+    def delete_pod(self, namespace: str, name: str) -> None:
+        with self._lock:
+            if _key(namespace, name) not in self.pods:
+                raise NotFound(f"pod {namespace}/{name}")
+            del self.pods[_key(namespace, name)]
+            self.deleted_pods.append(f"{namespace}/{name}")
+
+    def set_pod_phase(self, namespace: str, name: str, phase: str) -> None:
+        """Test hook: simulate kubelet/scheduler state transitions."""
+        with self._lock:
+            self.pods[_key(namespace, name)]["status"]["phase"] = phase
+
+    # -- services ---------------------------------------------------------
+
+    def create_service(self, svc: ObjectDict) -> ObjectDict:
+        with self._lock:
+            key = _key(svc["metadata"]["namespace"], svc["metadata"]["name"])
+            if key in self.services:
+                raise Conflict(f"service {key} exists")
+            self.services[key] = copy.deepcopy(svc)
+            return copy.deepcopy(svc)
+
+    def delete_service(self, namespace: str, name: str) -> None:
+        with self._lock:
+            self.services.pop(_key(namespace, name), None)
+
+    # -- custom resources -------------------------------------------------
+
+    def create_custom(self, cr: ObjectDict) -> ObjectDict:
+        with self._lock:
+            key = _key(cr["metadata"].get("namespace", "default"),
+                       cr["metadata"]["name"])
+            if key in self.custom:
+                raise Conflict(f"cr {key} exists")
+            self.custom[key] = copy.deepcopy(cr)
+            return copy.deepcopy(cr)
+
+    def list_custom(self, namespace: Optional[str] = None) -> List[ObjectDict]:
+        with self._lock:
+            return [copy.deepcopy(cr) for (ns, _), cr in self.custom.items()
+                    if namespace is None or ns == namespace]
+
+    def get_custom(self, namespace: str, name: str) -> ObjectDict:
+        with self._lock:
+            try:
+                return copy.deepcopy(self.custom[_key(namespace, name)])
+            except KeyError:
+                raise NotFound(f"cr {namespace}/{name}") from None
+
+    def update_custom_status(self, namespace: str, name: str,
+                             status: ObjectDict) -> None:
+        with self._lock:
+            if _key(namespace, name) not in self.custom:
+                raise NotFound(f"cr {namespace}/{name}")
+            self.custom[_key(namespace, name)]["status"] = copy.deepcopy(status)
+
+    def delete_custom(self, namespace: str, name: str) -> None:
+        with self._lock:
+            self.custom.pop(_key(namespace, name), None)
+
+    # -- events -----------------------------------------------------------
+
+    def record_event(self, namespace: str, involved: str, reason: str,
+                     message: str, type_: str = "Normal") -> None:
+        with self._lock:
+            self.events.append({
+                "namespace": namespace, "involvedObject": involved,
+                "reason": reason, "message": message, "type": type_,
+                "ts": time.time(),
+            })

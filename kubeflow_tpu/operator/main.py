@@ -1,0 +1,65 @@
+"""Operator daemon entrypoint.
+
+Deployable heir of the tf-operator Deployment the reference's manifests
+created (kubeflow/core/tf-job-operator.libsonnet:61-125): watches TPUJob
+CRs and reconciles gangs.  Slice inventory comes from --inventory
+(type=count pairs) or, on a real cluster, from node-pool discovery via the
+kubernetes client (operator/kube_real.py, used when --kubeconfig is
+given or in-cluster config is present).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import sys
+
+
+def parse_inventory(pairs) -> dict:
+    out = {}
+    for pair in pairs:
+        slice_type, _, count = pair.partition("=")
+        out[slice_type] = int(count or "1")
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="kubeflow-tpu-operator")
+    ap.add_argument("--inventory", nargs="*", default=["v5e-8=4"],
+                    help="slice capacity, e.g. v5p-32=2 v5e-8=4")
+    ap.add_argument("--poll-interval-s", type=float, default=2.0)
+    ap.add_argument("--max-iterations", type=int, default=0,
+                    help="stop after N reconcile passes (0 = forever)")
+    ap.add_argument("--fake-kube", action="store_true",
+                    help="run against the in-memory cluster (demo/tests)")
+    args = ap.parse_args(argv)
+
+    logging.basicConfig(level=logging.INFO, stream=sys.stderr)
+    from kubeflow_tpu.operator.gang import GangScheduler
+    from kubeflow_tpu.operator.kube import FakeKube
+    from kubeflow_tpu.operator.reconciler import TPUJobController
+
+    if args.fake_kube:
+        kube = FakeKube()
+    else:
+        try:
+            from kubeflow_tpu.operator.kube_real import RealKube
+
+            kube = RealKube()
+        except Exception as e:  # no cluster creds / client
+            logging.error(
+                "no cluster access (%s); use --fake-kube for local runs", e
+            )
+            return 1
+    controller = TPUJobController(
+        kube, GangScheduler(parse_inventory(args.inventory))
+    )
+    logging.info("operator up; inventory=%s",
+                 parse_inventory(args.inventory))
+    controller.run(poll_interval_s=args.poll_interval_s,
+                   max_iterations=args.max_iterations)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

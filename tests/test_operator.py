@@ -1,0 +1,205 @@
+"""Operator tests: gang admission, pod materialization, env contract,
+failure -> gang restart from checkpoint, preemption, queue FIFO.
+
+The reference could only test its operator E2E on rented clusters
+(SURVEY.md §4); the FakeKube makes the full lifecycle hermetic.
+"""
+
+import pytest
+
+from kubeflow_tpu.operator import crd
+from kubeflow_tpu.operator.gang import GangScheduler
+from kubeflow_tpu.operator.kube import FAILED, RUNNING, SUCCEEDED, FakeKube
+from kubeflow_tpu.operator.reconciler import (
+    JOB_FAILED,
+    JOB_RUNNING,
+    JOB_SUCCEEDED,
+    QUEUED,
+    STARTING,
+    TPUJobController,
+    coordinator_address,
+    worker_name,
+)
+from kubeflow_tpu.runtime import bootstrap
+
+
+def make_cr(name="train", slice_type="v5e-16", **spec_overrides):
+    job = crd.TPUJobSpec(name=name, slice_type=slice_type, **spec_overrides)
+    return job.to_custom_resource()
+
+
+@pytest.fixture()
+def cluster():
+    kube = FakeKube()
+    sched = GangScheduler({"v5e-16": 2, "v5p-32": 1})
+    return kube, sched, TPUJobController(kube, sched)
+
+
+def set_all_pods(kube, ns, phase):
+    for pod in kube.list_pods(ns):
+        kube.set_pod_phase(ns, pod["metadata"]["name"], phase)
+
+
+class TestHappyPath:
+    def test_full_lifecycle(self, cluster):
+        kube, sched, ctl = cluster
+        kube.create_custom(make_cr())
+        cr = kube.list_custom()[0]
+
+        # First pass: admitted, pods created. v5e-16 has 4 hosts.
+        assert ctl.reconcile_once(cr) == STARTING
+        pods = kube.list_pods("kubeflow")
+        assert len(pods) == 4
+        assert ("kubeflow", "train") in kube.services
+
+        # Kubelet "starts" the pods.
+        set_all_pods(kube, "kubeflow", RUNNING)
+        assert ctl.reconcile_once(cr) == JOB_RUNNING
+        assert any(m["event"] == "gang_running" for m in ctl.metrics)
+
+        set_all_pods(kube, "kubeflow", SUCCEEDED)
+        assert ctl.reconcile_once(cr) == JOB_SUCCEEDED
+        # Slices released for the next job.
+        assert sched.free("v5e-16") == 2
+
+    def test_env_contract(self, cluster):
+        kube, _, ctl = cluster
+        kube.create_custom(make_cr())
+        ctl.reconcile_once(kube.list_custom()[0])
+        pod = kube.get_pod("kubeflow", "train-worker-2")
+        env = {e["name"]: e["value"]
+               for e in pod["spec"]["containers"][0]["env"]}
+        assert env[bootstrap.ENV_PROCESS_ID] == "2"
+        assert env[bootstrap.ENV_NUM_PROCESSES] == "4"
+        assert env[bootstrap.ENV_COORDINATOR] == \
+            "train-worker-0.train.kubeflow:8476"
+        # The bootstrap module can consume exactly this env.
+        wenv = bootstrap.worker_env(env)
+        assert wenv.process_id == 2 and wenv.num_processes == 4
+
+    def test_pod_shape(self, cluster):
+        kube, _, ctl = cluster
+        kube.create_custom(make_cr())
+        ctl.reconcile_once(kube.list_custom()[0])
+        pod = kube.get_pod("kubeflow", "train-worker-0")
+        container = pod["spec"]["containers"][0]
+        # v5e-16: 16 chips / 4 hosts = 4 chips per pod; no nvidia.com/gpu.
+        assert container["resources"]["limits"] == {"google.com/tpu": "4"}
+        assert pod["spec"]["restartPolicy"] == "Never"
+        assert pod["spec"]["subdomain"] == "train"
+        sel = pod["spec"]["nodeSelector"]
+        assert sel["cloud.google.com/gke-tpu-accelerator"] == \
+            "tpu-v5-lite-podslice"
+
+
+class TestGangSemantics:
+    def test_all_or_nothing_admission(self, cluster):
+        kube, sched, ctl = cluster
+        kube.create_custom(make_cr("a", slice_type="v5p-32"))
+        kube.create_custom(make_cr("b", slice_type="v5p-32"))
+        a, b = kube.list_custom()
+        assert ctl.reconcile_once(a) == STARTING
+        # Only one v5p-32 slice exists: b queues, creates NO pods.
+        assert ctl.reconcile_once(b) == QUEUED
+        assert all(p["metadata"]["name"].startswith("a-")
+                   for p in kube.list_pods("kubeflow"))
+
+        # a completes -> b admitted on next pass.
+        set_all_pods(kube, "kubeflow", SUCCEEDED)
+        assert ctl.reconcile_once(a) == JOB_SUCCEEDED
+        assert ctl.reconcile_once(b) == STARTING
+
+    def test_worker_failure_restarts_whole_gang(self, cluster):
+        kube, _, ctl = cluster
+        kube.create_custom(make_cr())
+        cr = kube.list_custom()[0]
+        ctl.reconcile_once(cr)
+        set_all_pods(kube, "kubeflow", RUNNING)
+        ctl.reconcile_once(cr)
+
+        kube.set_pod_phase("kubeflow", "train-worker-1", FAILED)
+        assert ctl.reconcile_once(cr) == STARTING
+        assert cr["status"]["restarts"] == 1
+        # ALL pods were torn down, not just the failed one.
+        assert len(kube.deleted_pods) == 4
+        # Next pass recreates the full gang.
+        ctl.reconcile_once(cr)
+        assert len(kube.list_pods("kubeflow")) == 4
+
+    def test_preempted_pod_is_gang_failure(self, cluster):
+        kube, _, ctl = cluster
+        kube.create_custom(make_cr())
+        cr = kube.list_custom()[0]
+        ctl.reconcile_once(cr)
+        set_all_pods(kube, "kubeflow", RUNNING)
+        ctl.reconcile_once(cr)
+
+        # Preemption: pod object disappears entirely.
+        kube.delete_pod("kubeflow", "train-worker-3")
+        assert ctl.reconcile_once(cr) == STARTING
+        assert cr["status"]["restarts"] == 1
+
+    def test_max_restarts_fails_job(self, cluster):
+        kube, sched, ctl = cluster
+        kube.create_custom(make_cr(
+            restart=crd.RestartPolicy(max_restarts=1)))
+        cr = kube.list_custom()[0]
+        for expected_restarts in (1,):
+            ctl.reconcile_once(cr)
+            set_all_pods(kube, "kubeflow", RUNNING)
+            ctl.reconcile_once(cr)
+            kube.set_pod_phase("kubeflow", "train-worker-0", FAILED)
+            assert ctl.reconcile_once(cr) == STARTING
+            assert cr["status"]["restarts"] == expected_restarts
+        ctl.reconcile_once(cr)
+        set_all_pods(kube, "kubeflow", RUNNING)
+        ctl.reconcile_once(cr)
+        kube.set_pod_phase("kubeflow", "train-worker-0", FAILED)
+        assert ctl.reconcile_once(cr) == JOB_FAILED
+        assert sched.free("v5e-16") == 2  # slices released
+
+    def test_invalid_spec_fails_cleanly(self, cluster):
+        kube, _, ctl = cluster
+        cr = make_cr()
+        cr["spec"]["sliceType"] = "v99-1024"
+        kube.create_custom(cr)
+        ctl.reconcile_all()
+        status = kube.get_custom("kubeflow", "train")["status"]
+        assert status["phase"] == JOB_FAILED
+        assert status["reason"] == "InvalidSpec"
+
+
+class TestSchedulerQueue:
+    def test_fifo_no_starvation(self):
+        sched = GangScheduler({"v5e-16": 2})
+        assert sched.offer("big", "v5e-16", 2)
+        # head-of-line: small fits capacity-wise but big2 is ahead.
+        assert not sched.offer("big2", "v5e-16", 2)
+        assert not sched.offer("small", "v5e-16", 1)
+        sched.release("big")
+        assert sched.admitted("big2")
+        assert not sched.admitted("small")
+
+    def test_unsatisfiable_flagged(self):
+        sched = GangScheduler({"v5e-16": 1})
+        assert not sched.offer("huge", "v5e-16", 5)
+        assert sched.queue[0].get("unsatisfiable")
+
+    def test_metrics_recorded(self):
+        sched = GangScheduler({"v5e-16": 1})
+        sched.offer("j", "v5e-16", 1)
+        assert sched.queue_wait_p50_s() is not None
+
+
+class TestMultiSlice:
+    def test_megascale_env(self, cluster):
+        kube, _, ctl = cluster
+        kube.create_custom(make_cr(num_slices=2))
+        ctl.reconcile_once(kube.list_custom()[0])
+        pods = kube.list_pods("kubeflow")
+        assert len(pods) == 8  # 2 slices x 4 hosts
+        env = {e["name"]: e["value"]
+               for e in kube.get_pod("kubeflow", "train-worker-5")
+               ["spec"]["containers"][0]["env"]}
+        assert env["MEGASCALE_NUM_SLICES"] == "2"
+        assert env["MEGASCALE_SLICE_ID"] == "1"
