@@ -4,23 +4,29 @@ Importing this package registers every component prototype into
 ``kubeflow_tpu.config.default_registry`` (the way `ks pkg install` made
 prototypes available).  Sub-modules map to reference packages:
 
-  base        k8s object builders (shared idioms of all *.libsonnet files)
-  core        kubeflow-core aggregate (kubeflow/core/all.libsonnet)
-  tpujob      tf-job + tf-job-operator heirs
-  jupyterhub  kubeflow/core/jupyterhub.libsonnet + kubeform_spawner.py
-  serving     kubeflow/tf-serving (added in the serving milestone)
-  gangjob     kubeflow/openmpi heir (generic SPMD gang job)
-  pytorch     kubeflow/pytorch-job heir
-  argo        kubeflow/argo heir
+  base         k8s object builders (shared idioms of all *.libsonnet files)
+  core         kubeflow-core aggregate (kubeflow/core/all.libsonnet)
+  tpujob       tf-job + tf-job-operator heirs
+  jupyterhub   kubeflow/core/jupyterhub.libsonnet + kubeform_spawner.py
+  serving      kubeflow/tf-serving heir (tpu-serving)
+  tensorboard  kubeflow/core/tensorboard.libsonnet heir
+  iap          kubeflow/core/iap + cloud-endpoints + cert-manager heir
+  torch        kubeflow/pytorch-job heir (torch-xla-job)
+  addons       kubeflow/argo, seldon, pachyderm, credentials-pod-preset
+  examples     kubeflow/examples heirs (tpu-job-simple, tpu-serving-simple)
 """
 
 from kubeflow_tpu.manifests import base  # noqa: F401
 
-# Import for the side effect of registering prototypes.
-from kubeflow_tpu.manifests import core, jupyterhub, tpujob  # noqa: F401
-
-for _optional in ("serving", "gangjob", "pytorch", "argo", "ingress"):
-    try:  # pragma: no cover - exercised once modules land
-        __import__(f"kubeflow_tpu.manifests.{_optional}")
-    except ImportError:
-        pass
+# Import order matters only for examples (it references tpu-serving).
+from kubeflow_tpu.manifests import (  # noqa: F401
+    addons,
+    core,
+    iap,
+    jupyterhub,
+    serving,
+    tensorboard,
+    torch,
+    tpujob,
+)
+from kubeflow_tpu.manifests import examples  # noqa: F401  (needs serving)

@@ -1,0 +1,58 @@
+"""Examples package — heir of kubeflow/examples prototypes
+(tf-job-simple, tf-serving-simple, tf-serving-with-istio).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List
+
+from kubeflow_tpu.config.params import Prototype, param
+from kubeflow_tpu.config.registry import default_registry
+
+
+def _generate_job_simple(component_name: str, **p: Any) -> List[dict]:
+    from kubeflow_tpu.operator.crd import TPUJobSpec, WorkerSpec
+
+    job = TPUJobSpec(
+        name=component_name,
+        namespace=p["namespace"],
+        slice_type="v5e-1",
+        worker=WorkerSpec(
+            image="ghcr.io/kubeflow-tpu/worker:latest",
+            command=["python", "-m", "kubeflow_tpu.tools.train_cnn"],
+            args=["--model=resnet18", "--steps=10", "--synthetic-data"],
+        ),
+    )
+    return [job.to_custom_resource()]
+
+
+job_simple_prototype = default_registry.register(Prototype(
+    name="tpu-job-simple",
+    doc="Smallest runnable TPUJob (heir of examples/tf-job-simple): "
+        "ResNet-18, 10 steps, one v5e chip, synthetic data",
+    params=[param("namespace", str, "kubeflow", "target namespace")],
+    generate=_generate_job_simple,
+))
+
+
+def _generate_serving_simple(component_name: str, **p: Any) -> List[dict]:
+    proto = default_registry.get("tpu-serving")
+    return proto.generate(
+        component_name,
+        namespace=p["namespace"],
+        model_name=component_name,
+        model_base_path=p["model_base_path"],
+    )
+
+
+serving_simple_prototype = default_registry.register(Prototype(
+    name="tpu-serving-simple",
+    doc="Minimal model server (heir of examples/tf-serving-simple, "
+        "kubeflow/examples/prototypes/tf-serving-simple.jsonnet:1-50)",
+    params=[
+        param("namespace", str, "kubeflow", "target namespace"),
+        param("model_base_path", str, "gs://kubeflow-examples/inception",
+              "versioned model directory"),
+    ],
+    generate=_generate_serving_simple,
+))

@@ -1,0 +1,153 @@
+"""tpu-serving manifest package — heir of kubeflow/tf-serving.
+
+Re-provides the reference package's full parameter surface
+(kubeflow/tf-serving/tf-serving.libsonnet): model server Deployment +
+Service (gRPC-era :9000 folded into the one REST port :8000 our server
+exposes), Ambassador route annotations (:247-267), and the storage
+credential mixins — GCS service-account secret mount (:342-382), S3 env
+plumbing (:310-339), NFS PVC mount (:151-155).  The C++
+tensorflow_model_server + proxy sidecar pair is replaced by the single
+first-party serving container (serving/main.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from kubeflow_tpu.config.params import Prototype, param
+from kubeflow_tpu.config.registry import default_registry
+from kubeflow_tpu.manifests import base
+
+SERVE_PORT = 8000
+
+
+def s3_env(params: Dict[str, Any]) -> List[dict]:
+    """The reference's 7-variable S3 contract (tf-serving.libsonnet:310-339)."""
+    secret = params["s3_secret_name"]
+    env = [
+        {"name": "AWS_ACCESS_KEY_ID", "valueFrom": {"secretKeyRef": {
+            "name": secret, "key": params["s3_secret_accesskeyid_key_name"]}}},
+        {"name": "AWS_SECRET_ACCESS_KEY", "valueFrom": {"secretKeyRef": {
+            "name": secret,
+            "key": params["s3_secret_secretaccesskey_key_name"]}}},
+        {"name": "AWS_REGION", "value": params["s3_aws_region"]},
+        {"name": "S3_USE_HTTPS", "value": str(params["s3_use_https"])},
+        {"name": "S3_VERIFY_SSL", "value": str(params["s3_verify_ssl"])},
+        {"name": "S3_ENDPOINT", "value": params["s3_endpoint"]},
+    ]
+    return env
+
+
+def gcp_volume_mixin(secret_name: str, mount_path: str = "/secret/gcp-credentials"):
+    volume = {"name": "gcp-credentials",
+              "secret": {"secretName": secret_name}}
+    mount = {"name": "gcp-credentials", "mountPath": mount_path,
+             "readOnly": True}
+    env = [{"name": "GOOGLE_APPLICATION_CREDENTIALS",
+            "value": f"{mount_path}/key.json"}]
+    return volume, mount, env
+
+
+def _generate_serving(component_name: str, **p: Any) -> List[dict]:
+    namespace = p["namespace"]
+    name = component_name
+    labels = {"app": name, "kubeflow-tpu.org/component": "model-server"}
+
+    env: List[dict] = []
+    volumes: List[dict] = []
+    mounts: List[dict] = []
+    if p["storage_type"] == "s3":
+        env.extend(s3_env(p))
+    elif p["storage_type"] == "gcp":
+        volume, mount, genv = gcp_volume_mixin(p["gcp_secret_name"])
+        volumes.append(volume)
+        mounts.append(mount)
+        env.extend(genv)
+    elif p["storage_type"] == "nfs":
+        volumes.append({"name": "nfs", "persistentVolumeClaim":
+                        {"claimName": p["nfs_pvc"]}})
+        mounts.append({"name": "nfs", "mountPath": "/mnt"})
+
+    serving_container = {
+        "name": name,
+        "image": p["model_server_image"],
+        "args": [
+            f"--model_name={p['model_name']}",
+            f"--model_base_path={p['model_base_path']}",
+            f"--port={SERVE_PORT}",
+        ],
+        "ports": [{"containerPort": SERVE_PORT}],
+        "env": env,  # may contain valueFrom secretKeyRef entries
+        "resources": {
+            "limits": base.tpu_resource_limits(p["slice_type"])["limits"]
+            if p["slice_type"] else {"cpu": "4", "memory": "4Gi"},
+            "requests": {"cpu": "1", "memory": "1Gi"},
+        },
+        "volumeMounts": mounts,
+    }
+    if not mounts:
+        serving_container.pop("volumeMounts")
+    if not env:
+        serving_container.pop("env")
+    deploy = base.deployment(
+        name=name, namespace=namespace, labels=labels,
+        replicas=p["replicas"],
+        spec=base.pod_spec([serving_container], volumes=volumes),
+    )
+    if p["slice_type"]:
+        from kubeflow_tpu.runtime.topology import parse_slice_type
+
+        deploy["spec"]["template"]["spec"]["nodeSelector"] = \
+            parse_slice_type(p["slice_type"]).k8s_node_selector()
+
+    annotations = None
+    if p["ambassador_route"]:
+        # Same prefix scheme as the reference proxy route
+        # (tf-serving.libsonnet:247-267): /models/NAME/ -> service:8000.
+        annotations = {"getambassador.io/config": base.ambassador_route(
+            name, f"/models/{p['model_name']}/", name, SERVE_PORT,
+        )}
+    svc = base.service(
+        name=name, namespace=namespace, selector=labels,
+        ports=[base.port(SERVE_PORT, "http")],
+        annotations=annotations,
+        labels=labels,
+    )
+    return [deploy, svc]
+
+
+serving_prototype = default_registry.register(Prototype(
+    name="tpu-serving",
+    doc="TPU model server (heir of kubeflow/tf-serving): versioned "
+                "model loading, REST predict/classify/metadata contract",
+    params=[
+        param("namespace", str, "kubeflow", "target namespace"),
+        param("model_name", str, "model", "served model name"),
+        param("model_base_path", str, "/models/model",
+              "versioned model directory (gs://, s3://, or mounted path)"),
+        param("model_server_image", str,
+              "ghcr.io/kubeflow-tpu/model-server:latest",
+              "serving container image"),
+        param("replicas", int, 1, "server replicas"),
+        param("slice_type", str, "",
+              "TPU slice for inference ('' = CPU serving)"),
+        param("ambassador_route", bool, True,
+              "annotate Service with an Ambassador route"),
+        param("storage_type", str, "",
+              "credential mixin: '', 'gcp', 's3', or 'nfs'"),
+        param("gcp_secret_name", str, "user-gcp-sa",
+              "GCP SA key secret (GOOGLE_APPLICATION_CREDENTIALS mount)"),
+        param("s3_secret_name", str, "s3-credentials", "S3 secret name"),
+        param("s3_secret_accesskeyid_key_name", str, "accessKeyID",
+              "key within the S3 secret"),
+        param("s3_secret_secretaccesskey_key_name", str, "secretAccessKey",
+              "key within the S3 secret"),
+        param("s3_aws_region", str, "us-west-1", "AWS region"),
+        param("s3_use_https", str, "true", "S3 over TLS"),
+        param("s3_verify_ssl", str, "true", "verify S3 TLS certs"),
+        param("s3_endpoint", str, "s3.us-west-1.amazonaws.com",
+              "S3 endpoint"),
+        param("nfs_pvc", str, "nfs-external", "NFS PVC to mount at /mnt"),
+    ],
+    generate=_generate_serving,
+))

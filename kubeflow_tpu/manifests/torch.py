@@ -1,0 +1,63 @@
+"""torch-xla job profile — heir of kubeflow/pytorch-job.
+
+The reference ran a separate pytorch-operator binary with its own CRD
+(kubeflow/pytorch-job/pytorch-operator.libsonnet:30-80).  Here PyTorch is
+a *worker profile* of the same TPUJob gang (SURVEY.md §2.3 "same gang-job,
+different worker bootstrap"): the prototype emits a TPUJob whose pods set
+the PJRT/XLA env (PJRT_DEVICE=TPU) and launch via torch_xla's SPMD
+runner, so MASTER_ADDR-style DDP rendezvous is replaced by the same
+headless-Service coordinator every other job kind uses.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List
+
+from kubeflow_tpu.config.params import Prototype, param
+from kubeflow_tpu.config.registry import default_registry
+from kubeflow_tpu.operator.crd import (
+    RestartPolicy,
+    TPUJobSpec,
+    WorkerSpec,
+)
+
+
+def _generate_torch_job(component_name: str, **p: Any) -> List[dict]:
+    env = {
+        "PJRT_DEVICE": "TPU",
+        # torch_xla SPMD: one process per host, all chips visible.
+        "XLA_USE_SPMD": "1",
+    }
+    job = TPUJobSpec(
+        name=component_name,
+        namespace=p["namespace"],
+        slice_type=p["slice_type"],
+        num_slices=p["num_slices"],
+        worker=WorkerSpec(
+            image=p["image"],
+            command=list(p["command"]) or ["python"],
+            args=list(p["args"]),
+            env=env,
+        ),
+        restart=RestartPolicy(max_restarts=p["max_restarts"]),
+    )
+    return [job.to_custom_resource()]
+
+
+torch_job_prototype = default_registry.register(Prototype(
+    name="torch-xla-job",
+    doc="PyTorch/XLA gang job on a TPU slice (heir of kubeflow/pytorch-job "
+        "prototypes; pytorch-job.libsonnet:4-77) — same TPUJob CR, torch "
+        "worker profile",
+    params=[
+        param("namespace", str, "kubeflow", "target namespace"),
+        param("slice_type", str, "v5e-8", "TPU slice topology"),
+        param("num_slices", int, 1, "number of slices"),
+        param("image", str, "ghcr.io/kubeflow-tpu/torch-xla:latest",
+              "image with torch + torch_xla"),
+        param("command", list, [], "container command"),
+        param("args", list, [], "container args"),
+        param("max_restarts", int, 3, "gang restarts before giving up"),
+    ],
+    generate=_generate_torch_job,
+))

@@ -1,0 +1,90 @@
+"""In-container CNN training entrypoint — heir of tf_cnn_benchmarks as
+driven by the reference's prototypes (kubeflow/tf-job/prototypes/
+tf-cnn-benchmarks.jsonnet:40-62) and launcher
+(tf-controller-examples/tf-cnn/launcher.py).
+
+Where the reference translated TF_CONFIG into --ps_hosts/--worker_hosts
+PS-mode flags, this entrypoint reads the KFT_* env (runtime/bootstrap.py),
+joins the gang via jax.distributed, and runs the SPMD data-parallel
+trainer.  Synthetic data by default (as tf_cnn_benchmarks offered), real
+input via the data/ pipeline.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import sys
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="kubeflow-tpu-train-cnn")
+    ap.add_argument("--model", default="resnet50")
+    ap.add_argument("--batch-size-per-device", type=int, default=128)
+    ap.add_argument("--steps", type=int, default=100)
+    ap.add_argument("--image-size", type=int, default=224)
+    ap.add_argument("--num-classes", type=int, default=1000)
+    ap.add_argument("--dtype", default="bfloat16")
+    ap.add_argument("--synthetic-data", action="store_true")
+    ap.add_argument("--checkpoint-dir", default="")
+    ap.add_argument("--checkpoint-every", type=int, default=100)
+    ap.add_argument("--learning-rate", type=float, default=0.1)
+    ap.add_argument("--log-every", type=int, default=10)
+    args = ap.parse_args(argv)
+
+    logging.basicConfig(level=logging.INFO, stream=sys.stderr)
+    from kubeflow_tpu.runtime import bootstrap
+
+    env = bootstrap.initialize()
+
+    import jax
+    import numpy as np
+    import optax
+
+    from kubeflow_tpu.models.classification import classification_task
+    from kubeflow_tpu.models.resnet import ResNetConfig
+    from kubeflow_tpu.parallel import MeshSpec
+    from kubeflow_tpu.runtime.checkpoint import CheckpointManager
+    from kubeflow_tpu.runtime.metrics import MetricsLogger
+    from kubeflow_tpu.runtime.train import Trainer
+    from kubeflow_tpu.runtime.topology import parse_slice_type
+
+    n = jax.device_count()
+    batch = args.batch_size_per_device * n
+    size = args.image_size
+    cfg = ResNetConfig(name=args.model, num_classes=args.num_classes)
+    init_fn, loss_fn = classification_task(
+        cfg.build(), (1, size, size, 3))
+    mesh = MeshSpec(data=n).build()
+    peak = 0.0
+    if env.slice_type:
+        peak = parse_slice_type(env.slice_type).bf16_tflops_per_chip * 1e12
+    ckpt = (CheckpointManager(args.checkpoint_dir)
+            if args.checkpoint_dir else None)
+    trainer = Trainer(
+        init_fn=init_fn, loss_fn=loss_fn,
+        tx=optax.sgd(args.learning_rate, momentum=0.9), mesh=mesh,
+        checkpoints=ckpt, checkpoint_every=args.checkpoint_every,
+        metrics=MetricsLogger(static={"job": env.job_name,
+                                      "process": env.process_id}),
+        flops_per_example=cfg.fwd_flops_per_image * (size / 224) ** 2,
+        peak_flops_per_chip=peak,
+    )
+
+    rng = np.random.RandomState(env.process_id)
+
+    def synthetic():
+        while True:
+            yield {
+                "image": rng.randn(batch, size, size, 3).astype(np.float32),
+                "label": rng.randint(0, args.num_classes, size=(batch,)),
+            }
+
+    trainer.fit(synthetic(), num_steps=args.steps,
+                examples_per_step=batch, log_every=args.log_every)
+    logging.info("training done: %s", trainer._last_metrics)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,152 @@
+"""Golden-style tests for the widened manifest packages (serving,
+tensorboard, iap, addons, examples, torch) — heir of the reference's
+jsonnet assertion suites (kubeflow/core/tests/*.jsonnet, SURVEY.md §4)."""
+
+import pytest
+import yaml
+
+import kubeflow_tpu.manifests  # noqa: F401 — registers prototypes
+from kubeflow_tpu.config.registry import App, default_registry
+from kubeflow_tpu.manifests.base import to_yaml
+from kubeflow_tpu.manifests.iap import is_cloud_endpoint
+
+
+EXPECTED_PROTOTYPES = {
+    "argo", "gcp-credentials-pod-preset", "iap-ingress", "jupyterhub",
+    "kubeflow-core", "pachyderm", "seldon", "tensorboard", "torch-xla-job",
+    "tpu-cnn-benchmark", "tpu-job", "tpu-job-simple", "tpu-serving",
+    "tpu-serving-simple", "tpujob-operator",
+}
+
+
+def test_registry_has_all_packages():
+    assert EXPECTED_PROTOTYPES <= set(default_registry.names())
+
+
+def kinds(objs):
+    return [o["kind"] for o in objs]
+
+
+class TestServing:
+    def test_default_render(self):
+        objs = default_registry.generate("tpu-serving", "mnist",
+                                         model_name="mnist")
+        assert kinds(objs) == ["Deployment", "Service"]
+        deploy, svc = objs
+        args = deploy["spec"]["template"]["spec"]["containers"][0]["args"]
+        assert "--model_name=mnist" in args
+        assert "getambassador.io/config" in svc["metadata"]["annotations"]
+        route = svc["metadata"]["annotations"]["getambassador.io/config"]
+        assert "/models/mnist/" in route
+
+    def test_s3_mixin_env(self):
+        objs = default_registry.generate(
+            "tpu-serving", "m", storage_type="s3")
+        env = objs[0]["spec"]["template"]["spec"]["containers"][0]["env"]
+        names = {e["name"] for e in env}
+        assert {"AWS_ACCESS_KEY_ID", "AWS_SECRET_ACCESS_KEY", "AWS_REGION",
+                "S3_USE_HTTPS", "S3_VERIFY_SSL", "S3_ENDPOINT"} <= names
+        keyed = [e for e in env if e["name"] == "AWS_ACCESS_KEY_ID"][0]
+        assert keyed["valueFrom"]["secretKeyRef"]["name"] == "s3-credentials"
+
+    def test_gcp_mixin_mount(self):
+        objs = default_registry.generate(
+            "tpu-serving", "m", storage_type="gcp")
+        tmpl = objs[0]["spec"]["template"]["spec"]
+        env = tmpl["containers"][0]["env"]
+        assert any(e["name"] == "GOOGLE_APPLICATION_CREDENTIALS"
+                   for e in env)
+        assert tmpl["volumes"][0]["secret"]["secretName"] == "user-gcp-sa"
+
+    def test_tpu_serving_gets_tpu_resources(self):
+        objs = default_registry.generate(
+            "tpu-serving", "m", slice_type="v5e-1")
+        limits = objs[0]["spec"]["template"]["spec"]["containers"][0][
+            "resources"]["limits"]
+        assert limits == {"google.com/tpu": 1}
+
+    def test_no_nvidia_gpu_anywhere(self):
+        """BASELINE north-star: zero nvidia.com/gpu requests."""
+        app = App()
+        for proto in sorted(EXPECTED_PROTOTYPES):
+            app.add(proto, f"x-{proto}")
+        rendered = to_yaml(app.render())
+        assert "nvidia.com/gpu" not in rendered
+
+
+class TestTensorboard:
+    def test_render(self):
+        objs = default_registry.generate("tensorboard", "tb",
+                                         log_dir="gs://bucket/logs",
+                                         storage_type="gcp")
+        deploy, svc = objs
+        cmd = deploy["spec"]["template"]["spec"]["containers"][0]["command"]
+        assert "--logdir=gs://bucket/logs" in cmd
+        assert "/tensorboard/tb/" in \
+            svc["metadata"]["annotations"]["getambassador.io/config"]
+
+
+class TestIAP:
+    def test_cloud_endpoint_detection(self):
+        assert is_cloud_endpoint("kf.endpoints.proj.cloud.goog")
+        assert not is_cloud_endpoint("kubeflow.example.com")
+
+    def test_render_kinds(self):
+        objs = default_registry.generate("iap-ingress", "platform")
+        assert set(kinds(objs)) == {
+            "BackendConfig", "ManagedCertificate", "Service", "Ingress",
+            "Deployment",
+        }
+        ingress = [o for o in objs if o["kind"] == "Ingress"][0]
+        assert ingress["spec"]["rules"][0]["host"].endswith("cloud.goog")
+
+
+class TestAddons:
+    def test_argo(self):
+        objs = default_registry.generate("argo", "argo")
+        assert "CustomResourceDefinition" in kinds(objs)
+        crd = [o for o in objs if o["kind"] == "CustomResourceDefinition"][0]
+        assert crd["spec"]["group"] == "argoproj.io"
+
+    def test_seldon_and_pachyderm_render(self):
+        for proto in ("seldon", "pachyderm"):
+            objs = default_registry.generate(proto, proto)
+            assert len(objs) >= 4
+
+    def test_credentials_preset(self):
+        objs = default_registry.generate(
+            "gcp-credentials-pod-preset", "creds")
+        assert objs[0]["kind"] == "PodPreset"
+        env = objs[0]["spec"]["env"]
+        assert env[0]["name"] == "GOOGLE_APPLICATION_CREDENTIALS"
+
+
+class TestTorchProfile:
+    def test_torch_job_is_tpujob_with_pjrt_env(self):
+        objs = default_registry.generate("torch-xla-job", "bert")
+        cr = objs[0]
+        assert cr["kind"] == "TPUJob"
+        env = cr["spec"]["worker"]["env"]
+        assert env["PJRT_DEVICE"] == "TPU"
+        assert env["XLA_USE_SPMD"] == "1"
+
+
+class TestExamples:
+    def test_job_simple(self):
+        objs = default_registry.generate("tpu-job-simple", "hello")
+        assert objs[0]["spec"]["sliceType"] == "v5e-1"
+
+    def test_serving_simple_delegates(self):
+        objs = default_registry.generate("tpu-serving-simple", "inception")
+        assert kinds(objs) == ["Deployment", "Service"]
+
+
+class TestWholeAppRenders:
+    def test_everything_is_valid_yaml(self):
+        app = App()
+        for proto in sorted(EXPECTED_PROTOTYPES):
+            app.add(proto, f"c-{proto}")
+        docs = list(yaml.safe_load_all(to_yaml(app.render())))
+        assert len(docs) >= 30
+        for doc in docs:
+            assert "kind" in doc and "apiVersion" in doc
