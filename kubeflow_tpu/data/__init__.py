@@ -1,0 +1,26 @@
+"""Input pipeline: KFTR record format + native (C++) prefetch core.
+
+See data/loader.py; the hot path (threaded read, ring buffer, shuffle)
+lives in data/native/kft_data.cc, compiled on first use and loaded via
+ctypes with a pure-python fallback.
+"""
+
+from kubeflow_tpu.data.loader import (
+    RecordDataset,
+    RecordWriter,
+    decode_example,
+    encode_example,
+    read_records,
+    tensor_batches,
+    write_example_shards,
+)
+
+__all__ = [
+    "RecordDataset",
+    "RecordWriter",
+    "decode_example",
+    "encode_example",
+    "read_records",
+    "tensor_batches",
+    "write_example_shards",
+]

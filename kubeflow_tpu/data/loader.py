@@ -1,0 +1,285 @@
+"""Input pipeline: KFTR record format, native prefetch core, batching.
+
+The reference had no first-party data path — input pipelines lived inside
+the external TF images it orchestrated (SURVEY.md §2.2).  Here the host
+data path is first-party with the weight in native code where it matters:
+
+  - ``RecordWriter`` / ``read_records``: the KFTR on-disk format
+    (magic + length-prefixed payloads) — python, it's not hot.
+  - ``RecordDataset``: iterates records through the C++ core
+    (native/kft_data.cc): N reader threads, bounded ring buffer
+    (backpressure), reservoir shuffle — compiled on first use with g++
+    into a per-build cache; a pure-python fallback keeps every feature
+    working (slower) when no toolchain is present.
+  - ``tensor_batches``: decode + stack into the {name: np.ndarray} batches
+    Trainer.shard_batch consumes; per-process file sharding mirrors the
+    operator's gang layout (process i of n reads files i::n).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import io
+import logging
+import os
+import struct
+import subprocess
+import threading
+from pathlib import Path
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+MAGIC = b"KFTR\x01"
+_NATIVE_SRC = Path(__file__).parent / "native" / "kft_data.cc"
+_build_lock = threading.Lock()
+_lib = None
+_lib_failed = False
+
+
+# ---------------------------------------------------------------------------
+# Format
+# ---------------------------------------------------------------------------
+
+class RecordWriter:
+    """Writes the KFTR v1 format: 'KFTR'+version byte, then
+    [u32le length][payload] per record."""
+
+    def __init__(self, path: str | Path):
+        self._f = open(path, "wb")
+        self._f.write(MAGIC)
+
+    def write(self, payload: bytes) -> None:
+        self._f.write(struct.pack("<I", len(payload)))
+        self._f.write(payload)
+
+    def close(self) -> None:
+        self._f.close()
+
+    def __enter__(self) -> "RecordWriter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def read_records(path: str | Path) -> Iterator[bytes]:
+    """Pure-python sequential reader (also the no-toolchain fallback)."""
+    with open(path, "rb") as f:
+        if f.read(5) != MAGIC:
+            raise ValueError(f"{path}: bad magic (want KFTR v1)")
+        while True:
+            header = f.read(4)
+            if not header:
+                return
+            if len(header) != 4:
+                raise ValueError(f"{path}: truncated length")
+            (length,) = struct.unpack("<I", header)
+            payload = f.read(length)
+            if len(payload) != length:
+                raise ValueError(f"{path}: truncated payload")
+            yield payload
+
+
+# ---------------------------------------------------------------------------
+# Native core
+# ---------------------------------------------------------------------------
+
+def _native_lib():
+    """Compile (once) and load the C++ core; None if unavailable."""
+    global _lib, _lib_failed
+    if _lib is not None or _lib_failed:
+        return _lib
+    with _build_lock:
+        if _lib is not None or _lib_failed:
+            return _lib
+        cache = Path(
+            os.environ.get("KFT_NATIVE_CACHE",
+                           Path.home() / ".cache" / "kubeflow_tpu")
+        )
+        cache.mkdir(parents=True, exist_ok=True)
+        so_path = cache / "libkft_data.so"
+        try:
+            if (not so_path.exists()
+                    or so_path.stat().st_mtime < _NATIVE_SRC.stat().st_mtime):
+                cmd = ["g++", "-O3", "-shared", "-fPIC", "-pthread",
+                       "-std=c++17", str(_NATIVE_SRC), "-o", str(so_path)]
+                subprocess.run(cmd, check=True, capture_output=True)
+                log.info("built native data core -> %s", so_path)
+            lib = ctypes.CDLL(str(so_path))
+            lib.kft_loader_create.restype = ctypes.c_void_p
+            lib.kft_loader_create.argtypes = [
+                ctypes.POINTER(ctypes.c_char_p), ctypes.c_int, ctypes.c_int,
+                ctypes.c_int, ctypes.c_int, ctypes.c_uint64, ctypes.c_int,
+            ]
+            lib.kft_loader_next.restype = ctypes.c_int
+            lib.kft_loader_next.argtypes = [
+                ctypes.c_void_p, ctypes.POINTER(ctypes.c_void_p),
+                ctypes.POINTER(ctypes.c_uint64),
+            ]
+            lib.kft_loader_error.restype = ctypes.c_char_p
+            lib.kft_loader_error.argtypes = [ctypes.c_void_p]
+            lib.kft_loader_destroy.argtypes = [ctypes.c_void_p]
+            lib.kft_free.argtypes = [ctypes.c_void_p]
+            _lib = lib
+        except Exception as e:  # no g++ / unwritable cache
+            log.warning("native data core unavailable (%s); "
+                        "using python reader", e)
+            _lib_failed = True
+    return _lib
+
+
+class RecordDataset:
+    """Iterate raw record payloads from KFTR files.
+
+    shard(process_id, num_processes): file-level sharding — the gang
+    analogue of the reference's per-worker data split (each worker i of n
+    reads files i::n), matching KFT_PROCESS_ID from the operator env.
+    """
+
+    def __init__(
+        self,
+        paths: Sequence[str | Path],
+        *,
+        num_threads: int = 4,
+        prefetch: int = 256,
+        shuffle_buffer: int = 0,
+        seed: int = 0,
+        repeat: int = 1,
+        force_python: bool = False,
+    ):
+        if not paths:
+            raise ValueError("RecordDataset needs at least one file")
+        self.paths = [str(p) for p in paths]
+        self.num_threads = num_threads
+        self.prefetch = prefetch
+        self.shuffle_buffer = shuffle_buffer
+        self.seed = seed
+        self.repeat = repeat
+        self.force_python = force_python
+
+    def shard(self, process_id: int, num_processes: int) -> "RecordDataset":
+        mine = self.paths[process_id::num_processes]
+        if not mine:
+            raise ValueError(
+                f"process {process_id}/{num_processes}: no files "
+                f"(have {len(self.paths)} total — write more shards)"
+            )
+        return RecordDataset(
+            mine, num_threads=self.num_threads, prefetch=self.prefetch,
+            shuffle_buffer=self.shuffle_buffer, seed=self.seed + process_id,
+            repeat=self.repeat, force_python=self.force_python,
+        )
+
+    def __iter__(self) -> Iterator[bytes]:
+        lib = None if self.force_python else _native_lib()
+        if lib is None:
+            yield from self._python_iter()
+            return
+        arr = (ctypes.c_char_p * len(self.paths))(
+            *[p.encode() for p in self.paths]
+        )
+        handle = lib.kft_loader_create(
+            arr, len(self.paths), self.num_threads, self.prefetch,
+            self.shuffle_buffer, self.seed, self.repeat,
+        )
+        if not handle:
+            raise RuntimeError("kft_loader_create failed")
+        try:
+            data = ctypes.c_void_p()
+            length = ctypes.c_uint64()
+            while lib.kft_loader_next(
+                    handle, ctypes.byref(data), ctypes.byref(length)):
+                payload = ctypes.string_at(data.value, length.value)
+                lib.kft_free(data)
+                yield payload
+            err = lib.kft_loader_error(handle)
+            if err:
+                raise IOError(err.decode())
+        finally:
+            lib.kft_loader_destroy(handle)
+
+    def _python_iter(self) -> Iterator[bytes]:
+        rng = np.random.RandomState(self.seed)
+        reservoir: List[bytes] = []
+        epochs = range(self.repeat) if self.repeat > 0 else iter(int, 1)
+        for _ in epochs:
+            for path in self.paths:
+                for payload in read_records(path):
+                    if self.shuffle_buffer <= 1:
+                        yield payload
+                        continue
+                    if len(reservoir) < self.shuffle_buffer:
+                        reservoir.append(payload)
+                        continue
+                    idx = rng.randint(len(reservoir))
+                    out, reservoir[idx] = reservoir[idx], payload
+                    yield out
+        while reservoir:
+            idx = rng.randint(len(reservoir))
+            reservoir[idx], reservoir[-1] = reservoir[-1], reservoir[idx]
+            yield reservoir.pop()
+
+
+# ---------------------------------------------------------------------------
+# Tensor (de)serialization + batching
+# ---------------------------------------------------------------------------
+
+def encode_example(example: Dict[str, np.ndarray]) -> bytes:
+    """Dict of arrays -> npz bytes (the KFTR payload convention)."""
+    buf = io.BytesIO()
+    np.savez(buf, **example)
+    return buf.getvalue()
+
+
+def decode_example(payload: bytes) -> Dict[str, np.ndarray]:
+    with np.load(io.BytesIO(payload)) as npz:
+        return {k: npz[k] for k in npz.files}
+
+
+def tensor_batches(
+    dataset: Iterable[bytes],
+    batch_size: int,
+    *,
+    drop_remainder: bool = True,
+) -> Iterator[Dict[str, np.ndarray]]:
+    """Decode + stack payloads into Trainer-shaped batches."""
+    batch: List[Dict[str, np.ndarray]] = []
+    for payload in dataset:
+        batch.append(decode_example(payload))
+        if len(batch) == batch_size:
+            yield {
+                k: np.stack([ex[k] for ex in batch]) for k in batch[0]
+            }
+            batch = []
+    if batch and not drop_remainder:
+        yield {k: np.stack([ex[k] for ex in batch]) for k in batch[0]}
+
+
+def write_example_shards(
+    examples: Iterable[Dict[str, np.ndarray]],
+    directory: str | Path,
+    *,
+    prefix: str = "data",
+    examples_per_shard: int = 1024,
+) -> List[Path]:
+    """Utility (tests, tools): write examples into sharded KFTR files."""
+    directory = Path(directory)
+    directory.mkdir(parents=True, exist_ok=True)
+    paths: List[Path] = []
+    writer: Optional[RecordWriter] = None
+    count = 0
+    for example in examples:
+        if writer is None or count >= examples_per_shard:
+            if writer:
+                writer.close()
+            paths.append(directory / f"{prefix}-{len(paths):05d}.kftr")
+            writer = RecordWriter(paths[-1])
+            count = 0
+        writer.write(encode_example(example))
+        count += 1
+    if writer:
+        writer.close()
+    return paths

@@ -1,0 +1,229 @@
+// kft_data — native record-reading core of the input pipeline.
+//
+// Role in the stack: the host-side data path must keep a TPU chip fed
+// without stealing cycles from the python process that drives the device
+// (dispatch is async; input starvation shows up directly as step-time
+// jitter).  The reference framework had no first-party loader at all —
+// its input pipelines lived inside external TF binaries (SURVEY.md §2.2);
+// this file is the TPU-native equivalent of that C++ capability.
+//
+// Design: N reader threads pull files off a shared queue, stream
+// length-prefixed records, and push them into a bounded ring buffer
+// (backpressure = bounded memory).  The consumer side optionally applies
+// reservoir-style shuffle.  Records are returned as malloc'd buffers the
+// caller frees (kft_free), so Python can wrap them zero-copy via ctypes
+// -> numpy.frombuffer without the GIL held during reads.
+//
+// File format "KFTR1": [magic 'K''F''T''R'][u8 version=1][records...]
+// record: [u32 little-endian payload length][payload bytes].
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <condition_variable>
+#include <deque>
+#include <mutex>
+#include <random>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Record {
+  uint8_t* data;
+  uint64_t len;
+};
+
+struct Loader {
+  std::vector<std::string> paths;
+  size_t next_path = 0;
+  int repeat = 1;  // -1 = forever
+  int epoch = 0;
+
+  size_t capacity;
+  std::deque<Record> buffer;
+  std::mutex mu;
+  std::condition_variable not_full;
+  std::condition_variable not_empty;
+
+  std::vector<std::thread> readers;
+  int active_readers = 0;
+  bool stopped = false;
+  char error[256] = {0};
+
+  // Consumer-side shuffle reservoir.
+  std::vector<Record> reservoir;
+  size_t shuffle_buffer;
+  std::mt19937_64 rng;
+
+  ~Loader() {
+    {
+      std::lock_guard<std::mutex> lock(mu);
+      stopped = true;
+    }
+    not_full.notify_all();
+    not_empty.notify_all();
+    for (auto& t : readers) {
+      if (t.joinable()) t.join();
+    }
+    for (auto& r : buffer) free(r.data);
+    for (auto& r : reservoir) free(r.data);
+  }
+
+  bool take_path(std::string* out) {
+    std::lock_guard<std::mutex> lock(mu);
+    if (stopped) return false;
+    if (next_path >= paths.size()) {
+      if (repeat < 0 || ++epoch < repeat) {
+        next_path = 0;
+      } else {
+        return false;
+      }
+    }
+    *out = paths[next_path++];
+    return true;
+  }
+
+  void fail(const char* msg, const std::string& path) {
+    std::lock_guard<std::mutex> lock(mu);
+    if (!error[0]) {
+      snprintf(error, sizeof(error), "%s: %s", msg, path.c_str());
+    }
+  }
+
+  void push(Record r) {
+    std::unique_lock<std::mutex> lock(mu);
+    not_full.wait(lock, [&] { return buffer.size() < capacity || stopped; });
+    if (stopped) {
+      free(r.data);
+      return;
+    }
+    buffer.push_back(r);
+    lock.unlock();
+    not_empty.notify_one();
+  }
+
+  void read_file(const std::string& path) {
+    FILE* f = fopen(path.c_str(), "rb");
+    if (!f) {
+      fail("open failed", path);
+      return;
+    }
+    char magic[5] = {0};
+    if (fread(magic, 1, 5, f) != 5 || memcmp(magic, "KFTR\x01", 5) != 0) {
+      fail("bad magic (want KFTR v1)", path);
+      fclose(f);
+      return;
+    }
+    for (;;) {
+      uint32_t len_le;
+      size_t n = fread(&len_le, 1, 4, f);
+      if (n == 0) break;  // clean EOF
+      if (n != 4) {
+        fail("truncated length", path);
+        break;
+      }
+      uint64_t len = len_le;
+      uint8_t* data = static_cast<uint8_t*>(malloc(len ? len : 1));
+      if (len && fread(data, 1, len, f) != len) {
+        free(data);
+        fail("truncated payload", path);
+        break;
+      }
+      push(Record{data, len});
+      {
+        std::lock_guard<std::mutex> lock(mu);
+        if (stopped) break;
+      }
+    }
+    fclose(f);
+  }
+
+  void reader_main() {
+    std::string path;
+    while (take_path(&path)) read_file(path);
+    std::lock_guard<std::mutex> lock(mu);
+    if (--active_readers == 0) not_empty.notify_all();
+  }
+
+  // Pop one record from the ring (blocking); false on end-of-data.
+  bool pop(Record* out) {
+    std::unique_lock<std::mutex> lock(mu);
+    not_empty.wait(lock, [&] {
+      return !buffer.empty() || active_readers == 0 || stopped;
+    });
+    if (buffer.empty()) return false;
+    *out = buffer.front();
+    buffer.pop_front();
+    lock.unlock();
+    not_full.notify_one();
+    return true;
+  }
+
+  // Shuffled next: keep a reservoir topped up; emit a random element.
+  bool next(Record* out) {
+    if (shuffle_buffer <= 1) return pop(out);
+    Record r;
+    while (reservoir.size() < shuffle_buffer && pop(&r)) {
+      reservoir.push_back(r);
+    }
+    if (reservoir.empty()) return false;
+    size_t idx = rng() % reservoir.size();
+    *out = reservoir[idx];
+    if (pop(&r)) {
+      reservoir[idx] = r;
+    } else {
+      reservoir[idx] = reservoir.back();
+      reservoir.pop_back();
+    }
+    return true;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* kft_loader_create(const char** paths, int n_paths, int n_threads,
+                        int prefetch, int shuffle_buffer, uint64_t seed,
+                        int repeat) {
+  if (n_paths <= 0) return nullptr;
+  auto* loader = new Loader();
+  for (int i = 0; i < n_paths; ++i) loader->paths.emplace_back(paths[i]);
+  loader->capacity = prefetch > 0 ? prefetch : 64;
+  loader->shuffle_buffer = shuffle_buffer > 0 ? shuffle_buffer : 0;
+  loader->rng.seed(seed);
+  loader->repeat = repeat;
+  if (n_threads < 1) n_threads = 1;
+  loader->active_readers = n_threads;
+  for (int i = 0; i < n_threads; ++i) {
+    loader->readers.emplace_back([loader] { loader->reader_main(); });
+  }
+  return loader;
+}
+
+// Returns 1 and fills (*data, *len) on success; 0 on end-of-data.
+// The caller owns *data and must release it with kft_free.
+int kft_loader_next(void* handle, void** data, uint64_t* len) {
+  auto* loader = static_cast<Loader*>(handle);
+  Record r;
+  if (!loader->next(&r)) return 0;
+  *data = r.data;
+  *len = r.len;
+  return 1;
+}
+
+// Last error message ('' if none); valid until destroy.
+const char* kft_loader_error(void* handle) {
+  return static_cast<Loader*>(handle)->error;
+}
+
+void kft_loader_destroy(void* handle) {
+  delete static_cast<Loader*>(handle);
+}
+
+void kft_free(void* data) { free(data); }
+
+}  // extern "C"
