@@ -1,0 +1,120 @@
+"""Mixture-of-Experts MLP with expert parallelism.
+
+Absent from the reference (SURVEY.md §2.3 "Expert parallel: absent").
+GShard-style dense dispatch, shaped for the TPU:
+
+  - routing, dispatch and combine are einsums (MXU work, no gather/scatter
+    with dynamic shapes — XLA keeps static tiling);
+  - expert weight tensors carry the ("expert", ...) logical axis, so the
+    rule table places experts on the `expert` mesh axis and XLA inserts
+    the all-to-alls implied by the dispatch einsums;
+  - fixed expert capacity C = ceil(tokens/E * capacity_factor): tokens
+    over capacity are dropped (residual connection carries them), the
+    standard trade for static shapes;
+  - Switch-style load-balancing aux loss, sown into the "losses"
+    collection (models/transformer.py threads it into the train loss).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+kernel_init = nn.initializers.lecun_normal()
+
+
+class MoEMLP(nn.Module):
+    """Drop-in replacement for the dense SwiGLU MLP block."""
+
+    d_model: int
+    d_ff: int
+    num_experts: int
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    dtype: object = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        cfg_e, d, f = self.num_experts, self.d_model, self.d_ff
+        b, s, _ = x.shape
+        n_tokens = b * s
+        capacity = max(
+            self.top_k,
+            int(math.ceil(n_tokens / cfg_e * self.capacity_factor)),
+        )
+
+        wr = self.param(
+            "router",
+            nn.with_logical_partitioning(kernel_init, ("embed", "expert")),
+            (d, cfg_e), jnp.float32,
+        )
+        wi = self.param(
+            "wi",
+            nn.with_logical_partitioning(
+                kernel_init, ("expert", None, "embed", "mlp")),
+            (cfg_e, 2, d, f), jnp.float32,
+        )
+        wo = self.param(
+            "wo",
+            nn.with_logical_partitioning(
+                kernel_init, ("expert", "mlp", "embed")),
+            (cfg_e, f, d), jnp.float32,
+        )
+
+        tokens = x.reshape(n_tokens, d)
+        # Routing in fp32 (softmax stability matters more than MXU here).
+        logits = tokens.astype(jnp.float32) @ wr          # [N, E]
+        probs = jax.nn.softmax(logits, axis=-1)
+
+        # Top-k dispatch with capacity. Greedy per-choice cumsum positions.
+        gate_vals, gate_idx = jax.lax.top_k(probs, self.top_k)  # [N, k]
+        # Renormalise the kept gates.
+        gate_vals = gate_vals / jnp.maximum(
+            gate_vals.sum(-1, keepdims=True), 1e-9)
+
+        dispatch = jnp.zeros((n_tokens, cfg_e, capacity), jnp.bfloat16)
+        combine = jnp.zeros((n_tokens, cfg_e, capacity), jnp.float32)
+        counts = jnp.zeros((cfg_e,), jnp.int32)
+        for choice in range(self.top_k):
+            idx = gate_idx[:, choice]                      # [N]
+            onehot = jax.nn.one_hot(idx, cfg_e, dtype=jnp.int32)
+            pos = counts[None, :] + jnp.cumsum(onehot, axis=0) - 1
+            my_pos = jnp.take_along_axis(
+                pos, idx[:, None], axis=1)[:, 0]           # [N]
+            keep = my_pos < capacity
+            counts = counts + onehot.sum(0)
+            pos_onehot = jax.nn.one_hot(
+                jnp.where(keep, my_pos, capacity), capacity + 1,
+                dtype=jnp.float32)[:, :capacity]           # [N, C]
+            contrib = (onehot.astype(jnp.float32)[:, :, None]
+                       * pos_onehot[:, None, :])           # [N, E, C]
+            dispatch = dispatch + contrib.astype(jnp.bfloat16)
+            combine = combine + contrib * gate_vals[:, choice, None, None]
+
+        # Expert compute: [E, C, d] batched SwiGLU — one big MXU batch.
+        expert_in = jnp.einsum(
+            "nec,nd->ecd", dispatch, tokens.astype(jnp.bfloat16))
+        expert_in = nn.with_logical_constraint(
+            expert_in, ("expert", None, None))
+        dt = self.dtype
+        gate = jnp.einsum("ecd,edf->ecf", expert_in, wi[:, 0].astype(dt))
+        up = jnp.einsum("ecd,edf->ecf", expert_in, wi[:, 1].astype(dt))
+        h = nn.silu(gate) * up
+        h = nn.with_logical_constraint(h, ("expert", None, "mlp"))
+        expert_out = jnp.einsum("ecf,efd->ecd", h, wo.astype(dt))
+
+        out = jnp.einsum(
+            "nec,ecd->nd", combine.astype(dt), expert_out)
+
+        # Switch load-balance loss: E * sum_e (fraction of tokens routed
+        # to e) * (mean router prob of e); minimised by uniform routing.
+        top1 = jax.nn.one_hot(gate_idx[:, 0], cfg_e, dtype=jnp.float32)
+        fraction = top1.mean(0)
+        mean_prob = probs.mean(0)
+        aux = cfg_e * jnp.sum(fraction * mean_prob)
+        self.sow("losses", "moe_aux", aux)
+
+        return out.reshape(b, s, d).astype(self.dtype)

@@ -56,6 +56,13 @@ class TransformerConfig:
     attention: str = "dot"
     flash_block_q: int = 128
     flash_block_k: int = 128
+    # Mixture-of-Experts: 0 = dense MLP; >0 replaces every block's MLP
+    # with a MoE layer of that many experts (expert-parallel over the
+    # `expert` mesh axis; models/moe.py).
+    moe_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_aux_coef: float = 0.01
 
     def __post_init__(self):
         assert self.n_heads % self.n_kv_heads == 0
@@ -214,7 +221,17 @@ class Block(nn.Module):
                            deterministic=self.deterministic)(y)
         x = x + y
         y = RMSNorm(dtype=cfg.dtype, name="mlp_norm")(x)
-        y = MLP(cfg, name="mlp")(y)
+        if cfg.moe_experts > 0:
+            from kubeflow_tpu.models.moe import MoEMLP
+
+            y = MoEMLP(
+                d_model=cfg.d_model, d_ff=cfg.d_ff,
+                num_experts=cfg.moe_experts, top_k=cfg.moe_top_k,
+                capacity_factor=cfg.moe_capacity_factor, dtype=cfg.dtype,
+                name="moe",
+            )(y)
+        else:
+            y = MLP(cfg, name="mlp")(y)
         if cfg.dropout_rate:
             y = nn.Dropout(cfg.dropout_rate,
                            deterministic=self.deterministic)(y)
@@ -262,7 +279,7 @@ class Transformer(nn.Module):
         # dim (unsharded by default; a pipeline schedule maps it to `stage`).
         x, _ = nn.scan(
             block,
-            variable_axes={"params": 0},
+            variable_axes={"params": 0, "losses": 0},
             split_rngs={"params": True, "dropout": True},
             length=cfg.n_layers,
             metadata_params={nn.PARTITION_NAME: "layers"},
@@ -308,15 +325,29 @@ def lm_task(cfg: TransformerConfig, mesh=None):
     def loss_fn(params, mutable, batch, rng):
         del mutable
         tokens = batch["tokens"]
-        logits = model.apply(
-            {"params": params}, tokens,
-            deterministic=False,
-            rngs={"dropout": rng},
-        )
+        if cfg.moe_experts > 0:
+            logits, sown = model.apply(
+                {"params": params}, tokens,
+                deterministic=False,
+                rngs={"dropout": rng},
+                mutable=["losses"],
+            )
+        else:
+            logits = model.apply(
+                {"params": params}, tokens,
+                deterministic=False,
+                rngs={"dropout": rng},
+            )
         targets = tokens[:, 1:]
         loss = optax.softmax_cross_entropy_with_integer_labels(
             logits[:, :-1], targets
         ).mean()
-        return loss, ({"perplexity": jnp.exp(loss)}, {})
+        metrics = {"perplexity": jnp.exp(loss)}
+        if cfg.moe_experts > 0:
+            aux = sum(jnp.sum(v) for v in
+                      jax.tree_util.tree_leaves(sown["losses"]))
+            metrics["moe_aux"] = aux
+            loss = loss + cfg.moe_aux_coef * aux
+        return loss, (metrics, {})
 
     return init_fn, loss_fn

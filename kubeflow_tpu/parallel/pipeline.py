@@ -1,0 +1,99 @@
+"""Pipeline parallelism: GPipe schedule over the `pipeline` mesh axis.
+
+Absent from the reference in any form (SURVEY.md §2.3 "Pipeline parallel:
+absent").  TPU-native design: the layer dimension of a scanned model
+(params stacked [L, ...], see models/transformer.py nn.scan) is sharded
+over the `pipeline` axis — each device group holds L/S contiguous layers —
+and microbatches stream through the ring via ``ppermute``.  All control
+flow is a single ``lax.fori_loop`` (compiler-friendly: one trace, static
+shapes), and the bubble is the standard (S-1)/(M+S-1) GPipe overhead.
+
+The primitive is model-agnostic: ``pipelined_scan`` takes any per-layer
+body ``fn(layer_params, x) -> x``.  models/ wires the Transformer block
+through it when TransformerConfig.pipeline_microbatches > 0.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+from kubeflow_tpu.parallel.mesh import PIPELINE
+
+
+def pipelined_scan(
+    fn: Callable[[Any, jax.Array], jax.Array],
+    stacked_params: Any,
+    x: jax.Array,
+    *,
+    axis_name: str = PIPELINE,
+) -> jax.Array:
+    """Run x through L layers, pipeline-parallel.  Call inside shard_map.
+
+    fn: one layer body, fn(params_for_layer, activation) -> activation.
+    stacked_params: pytree with leading dim = layers-per-stage (the global
+      [L, ...] stack sharded over `axis_name`, so each stage holds L/S).
+    x: microbatched activations [M, mb, ...] (replicated across the
+      pipeline axis; the caller shards batch over data axes as usual).
+
+    Returns [M, mb, ...] outputs, replicated across the pipeline axis.
+    """
+    n_stages = jax.lax.psum(1, axis_name)
+    stage = jax.lax.axis_index(axis_name)
+    n_micro = x.shape[0]
+    total_steps = n_micro + n_stages - 1
+    # stage s -> s+1; the wrap link (S-1 -> 0) carries no live data.
+    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    def run_stage(act: jax.Array) -> jax.Array:
+        # Sequential local layers: lax.scan over this stage's param stack.
+        def body(carry, layer_params):
+            return fn(layer_params, carry), None
+
+        out, _ = jax.lax.scan(body, act, stacked_params)
+        return out
+
+    # Loop carries become varying over the pipeline axis (stage-dependent
+    # values flow through them) even when x enters replicated.
+    vma = tuple({*jax.typeof(x).vma, axis_name})
+    vary = lambda a: jax.lax.pcast(a, vma, to="varying")
+    zero_mb = vary(jnp.zeros_like(x[0]))
+    ys0 = vary(jnp.zeros(x.shape, x.dtype))
+
+    def step(t, carry):
+        recv, ys = carry
+        # Stage 0 injects microbatch t (clamped; masked out when t >= M).
+        mb_idx = jnp.clip(t, 0, n_micro - 1)
+        injected = jax.lax.dynamic_index_in_dim(x, mb_idx, keepdims=False)
+        inp = jnp.where(stage == 0, injected, recv)
+        out = run_stage(inp)
+        # The last stage owns microbatch t-(S-1) at step t.
+        out_idx = jnp.clip(t - (n_stages - 1), 0, n_micro - 1)
+        is_valid = (t >= n_stages - 1) & (stage == n_stages - 1)
+        updated = jax.lax.dynamic_update_index_in_dim(
+            ys, jnp.where(is_valid, out, ys[out_idx]), out_idx, axis=0
+        )
+        nxt = jax.lax.ppermute(out, axis_name, perm)
+        return nxt, updated
+
+    _, ys = jax.lax.fori_loop(0, total_steps, step, (zero_mb, ys0))
+    # Only the last stage holds real outputs; broadcast them to every
+    # stage so downstream (loss) code is stage-agnostic.
+    ys = jnp.where(stage == n_stages - 1, ys, jnp.zeros_like(ys))
+    return jax.lax.psum(ys, axis_name)
+
+
+def microbatch(x: jax.Array, n_micro: int) -> jax.Array:
+    """[B, ...] -> [M, B/M, ...]."""
+    if x.shape[0] % n_micro:
+        raise ValueError(
+            f"batch {x.shape[0]} not divisible by {n_micro} microbatches"
+        )
+    return x.reshape(n_micro, x.shape[0] // n_micro, *x.shape[1:])
+
+
+def unmicrobatch(x: jax.Array) -> jax.Array:
+    """[M, mb, ...] -> [B, ...]."""
+    return x.reshape(x.shape[0] * x.shape[1], *x.shape[2:])

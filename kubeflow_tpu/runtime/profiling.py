@@ -1,0 +1,92 @@
+"""Profiling / tracing subsystem.
+
+The reference had no tracing at all — observability was "point TensorBoard
+at a logDir" (SURVEY.md §5: kubeflow/core/tensorboard.libsonnet).  Here
+trace capture is first-class runtime capability: XPlane traces from
+``jax.profiler`` written where the tensorboard manifest component
+(manifests/tensorboard.py) can serve them, plus a lightweight step-marker
+API so device timelines line up with the trainer's step numbers.
+
+Three entry points:
+  - ``trace(logdir)``: context manager around a region of the train loop;
+  - ``ProfileSchedule``: capture steps [start, start+count) of a loop —
+    the skip-warmup-then-trace pattern every perf investigation wants;
+  - ``start_server(port)``: on-demand remote capture (the production mode:
+    always-on server, sample when needed — no overhead until then).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import logging
+from typing import Iterator, Optional
+
+import jax
+
+log = logging.getLogger(__name__)
+
+
+@contextlib.contextmanager
+def trace(logdir: str) -> Iterator[None]:
+    """Capture an XPlane trace of the enclosed region into ``logdir``
+    (viewable with TensorBoard/XProf; serve via the tensorboard
+    component)."""
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+        log.info("profiler trace written to %s", logdir)
+
+
+def step_marker(step: int):
+    """Annotate device timelines with the train-loop step; shows up as a
+    named range in XProf."""
+    return jax.profiler.StepTraceAnnotation("train_step", step_num=step)
+
+
+def start_server(port: int = 9999) -> object:
+    """Start the on-demand capture server (connect with
+    ``jax.profiler.trace`` from another process / the XProf UI)."""
+    server = jax.profiler.start_server(port)
+    log.info("profiler server on :%d", port)
+    return server
+
+
+@dataclasses.dataclass
+class ProfileSchedule:
+    """Trace exactly steps [start, start+count) of a training loop.
+
+    Usage:
+        sched = ProfileSchedule(logdir, start=10, count=3)
+        for i in range(steps):
+            sched.before_step(i)
+            ...
+            sched.after_step(i)
+    """
+
+    logdir: str
+    start: int = 10
+    count: int = 3
+    _active: bool = False
+    _done: bool = False
+
+    def before_step(self, step: int) -> None:
+        if (not self._done and not self._active and step == self.start):
+            jax.profiler.start_trace(self.logdir)
+            self._active = True
+
+    def after_step(self, step: int) -> None:
+        if self._active and step >= self.start + self.count - 1:
+            jax.profiler.stop_trace()
+            self._active = False
+            self._done = True
+            log.info("profiled steps [%d, %d) -> %s",
+                     self.start, self.start + self.count, self.logdir)
+
+    def close(self) -> None:
+        if self._active:
+            jax.profiler.stop_trace()
+            self._active = False
+            self._done = True

@@ -22,7 +22,7 @@ def classifier(config: Dict[str, Any]) -> Callable:
     """
     family = config.get("family", "resnet50")
     num_classes = int(config.get("num_classes", 1000))
-    top_k = int(config.get("top_k", 5))
+    top_k = min(int(config.get("top_k", 5)), num_classes)
     if family.startswith("resnet"):
         from kubeflow_tpu.models.resnet import ResNetConfig
 

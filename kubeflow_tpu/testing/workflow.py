@@ -1,0 +1,129 @@
+"""E2E workflow DAG builder — heir of the reference's Argo test pipeline
+(testing/workflows/components/workflows.libsonnet:174-310, SURVEY.md §3.6).
+
+Generates an Argo Workflow with the same structural ideas: a checkout
+step, platform deploy, a fan-out of test steps, an onExit teardown
+handler that copies JUnit artifacts — targeting the argo component the
+addons package deploys (manifests/addons.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+
+@dataclasses.dataclass
+class Step:
+    name: str
+    command: List[str]
+    image: str = "ghcr.io/kubeflow-tpu/worker:latest"
+    deps: List[str] = dataclasses.field(default_factory=list)
+    env: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+
+class E2EWorkflow:
+    """Build an Argo Workflow CR for a platform E2E run.
+
+    The canonical DAG (mirroring §3.6's shape, minus the minikube fork —
+    the fake-slice backend replaced rented clusters for correctness
+    tests; this DAG is the real-cluster smoke path):
+
+        checkout -> deploy-kubeflow -> {tpujob-test, serving-test,
+        notebook-test} -> (onExit) teardown + copy-artifacts
+    """
+
+    def __init__(self, name: str, namespace: str = "kubeflow-test",
+                 artifacts_gcs: str = ""):
+        self.name = name
+        self.namespace = namespace
+        self.artifacts_gcs = artifacts_gcs
+        self.steps: List[Step] = []
+        self.exit_steps: List[Step] = []
+
+    def add_step(self, step: Step) -> "E2EWorkflow":
+        self.steps.append(step)
+        return self
+
+    def add_exit_step(self, step: Step) -> "E2EWorkflow":
+        self.exit_steps.append(step)
+        return self
+
+    def _template(self, step: Step) -> dict:
+        container = {
+            "image": step.image,
+            "command": step.command,
+        }
+        if step.env:
+            container["env"] = [
+                {"name": k, "value": v} for k, v in sorted(step.env.items())
+            ]
+        return {"name": step.name, "container": container}
+
+    def to_custom_resource(self) -> dict:
+        dag_tasks = [
+            {
+                "name": s.name,
+                "template": s.name,
+                **({"dependencies": s.deps} if s.deps else {}),
+            }
+            for s in self.steps
+        ]
+        templates = [
+            {"name": "main", "dag": {"tasks": dag_tasks}},
+            *[self._template(s) for s in self.steps],
+        ]
+        spec = {
+            "entrypoint": "main",
+            "templates": templates,
+        }
+        if self.exit_steps:
+            spec["onExit"] = "exit-handler"
+            templates.append({
+                "name": "exit-handler",
+                "steps": [[{"name": s.name, "template": s.name}]
+                          for s in self.exit_steps],
+            })
+            templates.extend(self._template(s) for s in self.exit_steps)
+        return {
+            "apiVersion": "argoproj.io/v1alpha1",
+            "kind": "Workflow",
+            "metadata": {"generateName": f"{self.name}-",
+                         "namespace": self.namespace},
+            "spec": spec,
+        }
+
+
+def default_e2e(name: str = "e2e", namespace: str = "kubeflow-test",
+                image: str = "ghcr.io/kubeflow-tpu/worker:latest",
+                repo: str = "https://github.com/kubeflow-tpu/kubeflow-tpu",
+                artifacts_gcs: str = "") -> E2EWorkflow:
+    """The stock platform E2E DAG."""
+    wf = E2EWorkflow(name, namespace, artifacts_gcs)
+    wf.add_step(Step(
+        "checkout", ["git", "clone", repo, "/src"], image=image))
+    wf.add_step(Step(
+        "deploy-kubeflow",
+        ["kubeflow-tpu", "apply"],
+        image=image, deps=["checkout"]))
+    wf.add_step(Step(
+        "tpujob-test",
+        ["python", "-m", "kubeflow_tpu.testing.e2e", "tpujob",
+         "--namespace", namespace],
+        image=image, deps=["deploy-kubeflow"]))
+    wf.add_step(Step(
+        "serving-test",
+        ["python", "-m", "kubeflow_tpu.testing.e2e", "serving",
+         "--namespace", namespace],
+        image=image, deps=["deploy-kubeflow"]))
+    wf.add_exit_step(Step(
+        "teardown",
+        ["python", "-m", "kubeflow_tpu.testing.e2e", "teardown",
+         "--namespace", namespace],
+        image=image))
+    if artifacts_gcs:
+        wf.add_exit_step(Step(
+            "copy-artifacts",
+            ["gsutil", "-m", "cp", "-r", "/artifacts",
+             artifacts_gcs], image=image))
+    return wf

@@ -1,0 +1,77 @@
+"""MoE layer + expert-parallel transformer tests."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from flax import linen as nn
+
+from kubeflow_tpu.models.moe import MoEMLP
+from kubeflow_tpu.models.transformer import TransformerConfig, lm_task
+from kubeflow_tpu.parallel import EXPERT, MeshSpec
+from kubeflow_tpu.runtime.metrics import MetricsLogger
+from kubeflow_tpu.runtime.train import Trainer
+
+
+class TestMoELayer:
+    def test_shapes_and_aux(self):
+        layer = MoEMLP(d_model=16, d_ff=32, num_experts=4,
+                       capacity_factor=2.0)
+        x = jnp.asarray(np.random.RandomState(0).randn(2, 8, 16),
+                        jnp.bfloat16)
+        variables = layer.init(jax.random.key(0), x)
+        out, sown = layer.apply(variables, x, mutable=["losses"])
+        assert out.shape == (2, 8, 16)
+        aux = jax.tree_util.tree_leaves(sown["losses"])[0]
+        # Switch aux loss is >= 1 (equality at perfectly uniform routing).
+        assert float(aux) >= 0.99
+
+    def test_expert_params_annotated(self):
+        layer = MoEMLP(d_model=16, d_ff=32, num_experts=4)
+        x = jnp.zeros((1, 4, 16), jnp.bfloat16)
+        variables = layer.init(jax.random.key(0), x)
+        wi = variables["params"]["wi"]
+        assert wi.names[0] == "expert"
+
+    def test_capacity_drops_dont_nan(self):
+        # Tiny capacity: most tokens dropped; output must stay finite.
+        layer = MoEMLP(d_model=8, d_ff=16, num_experts=2,
+                       capacity_factor=0.1)
+        x = jnp.asarray(np.random.RandomState(1).randn(1, 32, 8),
+                        jnp.bfloat16)
+        variables = layer.init(jax.random.key(0), x)
+        out, _ = layer.apply(variables, x, mutable=["losses"])
+        assert np.isfinite(np.asarray(out, np.float32)).all()
+
+
+class TestMoETransformer:
+    CFG = TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=2, n_heads=4, n_kv_heads=4,
+        d_ff=64, head_dim=8, max_seq_len=32, moe_experts=4,
+    )
+
+    def test_train_on_expert_parallel_mesh(self, devices):
+        mesh = MeshSpec(data=2, expert=2, tensor=2).build(devices)
+        init_fn, loss_fn = lm_task(self.CFG)
+        tr = Trainer(
+            init_fn=init_fn, loss_fn=loss_fn, tx=optax.adam(3e-3),
+            mesh=mesh, metrics=MetricsLogger(stream=open("/dev/null", "w")),
+        )
+        state = tr.create_state()
+        # Expert dim of wi [layers, E, 2, d, f] sharded over `expert`.
+        wi = state.params["layers"]["moe"]["wi"]
+        assert EXPERT in tuple(wi.sharding.spec), wi.sharding.spec
+
+        rng = np.random.RandomState(0)
+
+        def data():
+            while True:
+                start = rng.randint(0, 8, size=(8, 1))
+                toks = (start + np.arange(16)[None, :]) % 16
+                yield {"tokens": toks.astype(np.int32)}
+
+        state = tr.fit(data(), num_steps=10, examples_per_step=8,
+                       log_every=0)
+        assert np.isfinite(tr._last_metrics["loss"])
+        assert "moe_aux" in tr._last_metrics

@@ -1,0 +1,60 @@
+"""Tests for the CI harness: JUnit emission, workflow DAG, e2e drivers."""
+
+import xml.etree.ElementTree as ET
+
+import pytest
+
+from kubeflow_tpu.testing.e2e import serving_smoke, tpujob_smoke
+from kubeflow_tpu.testing.junit import JUnitSuite
+from kubeflow_tpu.testing.workflow import Step, default_e2e
+
+
+class TestJUnit:
+    def test_pass_fail_error_classification(self, tmp_path):
+        suite = JUnitSuite("demo")
+        suite.run("ok", lambda: None)
+        suite.run("fails", lambda: (_ for _ in ()).throw(AssertionError("x")))
+        suite.run("errors", lambda: (_ for _ in ()).throw(RuntimeError("y")))
+        path = suite.write(tmp_path)
+        root = ET.parse(path).getroot()
+        assert root.get("tests") == "3"
+        assert root.get("failures") == "1"
+        assert root.get("errors") == "1"
+        assert not suite.ok
+
+    def test_xml_escaping(self, tmp_path):
+        suite = JUnitSuite("esc")
+        suite.run("bad<name>", lambda: None)
+        root = ET.parse(suite.write(tmp_path)).getroot()
+        assert root[0].get("name") == "bad<name>"
+
+
+class TestWorkflowDAG:
+    def test_default_dag_shape(self):
+        cr = default_e2e(artifacts_gcs="gs://bucket/artifacts")
+        assert cr.to_custom_resource()["kind"] == "Workflow"
+        spec = cr.to_custom_resource()["spec"]
+        dag = [t for t in spec["templates"] if t["name"] == "main"][0]["dag"]
+        by_name = {t["name"]: t for t in dag["tasks"]}
+        assert by_name["deploy-kubeflow"]["dependencies"] == ["checkout"]
+        assert by_name["tpujob-test"]["dependencies"] == ["deploy-kubeflow"]
+        assert spec["onExit"] == "exit-handler"
+        exit_tmpl = [t for t in spec["templates"]
+                     if t["name"] == "exit-handler"][0]
+        names = [s[0]["name"] for s in exit_tmpl["steps"]]
+        assert names == ["teardown", "copy-artifacts"]
+
+    def test_custom_step_env(self):
+        wf = default_e2e().add_step(
+            Step("extra", ["true"], env={"A": "1"}, deps=["checkout"]))
+        cr = wf.to_custom_resource()
+        tmpl = [t for t in cr["spec"]["templates"] if t["name"] == "extra"][0]
+        assert tmpl["container"]["env"] == [{"name": "A", "value": "1"}]
+
+
+class TestE2EDrivers:
+    def test_tpujob_smoke(self):
+        tpujob_smoke()
+
+    def test_serving_smoke(self):
+        serving_smoke()
