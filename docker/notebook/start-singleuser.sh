@@ -1,0 +1,11 @@
+#!/bin/bash
+# JupyterHub single-user entry — heir of the reference's
+# start-singleuser.sh (components/tensorflow-notebook-image/): ensure the
+# PVC-mounted home is usable, then exec the hub-managed server.
+set -e
+
+if [ ! -w "$HOME" ]; then
+  echo "warning: $HOME not writable (PVC mount problem?)" >&2
+fi
+
+exec jupyterhub-singleuser --ip=0.0.0.0 "$@"

@@ -163,6 +163,11 @@ def version_configmap(namespace: str) -> dict:
 def _generate_core(component_name: str, **p: Any) -> List[dict]:
     namespace = p["namespace"]
     objects: List[dict] = []
+    # Cloud hint (heir of the reference's `cloud` param,
+    # kubeflow/core/prototypes/all.jsonnet:4): gke exposes the gateway via
+    # LoadBalancer and iap-ready auth defaults; minikube keeps ClusterIP.
+    if p["cloud"] == "gke" and p["ambassador_service_type"] == "ClusterIP":
+        p = {**p, "ambassador_service_type": "LoadBalancer"}
     # When the in-cluster NFS stack is deployed, user notebook PVCs bind to
     # its StorageClass (the reference wired jupyterHubNotebookPVCMount to the
     # disks feature the same way, kubeflow/core/prototypes/all.jsonnet:14-16).
@@ -189,6 +194,8 @@ core_prototype = default_registry.register(Prototype(
         "dashboards (heir of kubeflow/core/prototypes/all.jsonnet:1-31).",
     params=[
         param("namespace", str, "kubeflow", "deployment namespace"),
+        param("cloud", str, "", "cloud provider hint",
+              choices=["", "gke", "aks", "minikube"]),
         param("notebook_image", str, jupyterhub.DEFAULT_NOTEBOOK_IMAGE,
               "default notebook image"),
         param("jupyter_hub_authenticator", str, "dummy",

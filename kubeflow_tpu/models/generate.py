@@ -1,0 +1,183 @@
+"""Autoregressive decoding for the Transformer LM.
+
+The reference's serving story was TF-Serving for classifiers; LMs are this
+framework's flagship, so decode is first-party.  TPU-shaped choices:
+
+  - the KV cache is a preallocated [layers, 2, b, max_len, h, d] buffer
+    carried through ``lax.scan`` — static shapes end to end, one compiled
+    program for the whole generation;
+  - prefill and decode are the same jitted function: the prompt is
+    processed in one batched forward (MXU-efficient), then tokens stream
+    one position at a time against the cache;
+  - greedy or temperature sampling under ``jax.random``.
+
+Kept outside the Flax module on purpose: the cache is explicit function
+state (scan carry), not module state — no mutable-collection plumbing,
+and the whole loop jits/shards like any other pure function.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from kubeflow_tpu.models.transformer import (
+    Transformer,
+    TransformerConfig,
+    rope,
+)
+from kubeflow_tpu.ops.attention import dot_product_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class DecodeConfig:
+    max_new_tokens: int = 64
+    temperature: float = 0.0   # 0 = greedy
+    eos_token: int = -1        # -1 = never stop early
+
+
+def _layer_step(cfg: TransformerConfig, layer_params, x, cache_kv,
+                cache_len, positions):
+    """One decoder block against the KV cache.
+
+    x: [b, t, e] new activations (t = prompt len at prefill, 1 at decode);
+    cache_kv: (k, v) each [b, max_len, hkv, d];
+    cache_len: number of valid cache positions before this call.
+    Mirrors models/transformer.py Block but with explicit cache state.
+    """
+    from kubeflow_tpu.models.transformer import MLP, RMSNorm
+
+    attn = layer_params["attn"]
+    dt = cfg.dtype
+
+    def norm(x, scale):
+        x32 = x.astype(jnp.float32)
+        normed = x32 * jax.lax.rsqrt(
+            jnp.mean(x32 * x32, axis=-1, keepdims=True) + 1e-6)
+        return (normed * scale).astype(dt)
+
+    y = norm(x, layer_params["attn_norm"]["scale"])
+    q = jnp.einsum("bse,ehd->bshd", y, attn["wq"].astype(dt))
+    k = jnp.einsum("bse,ehd->bshd", y, attn["wkv"][0].astype(dt))
+    v = jnp.einsum("bse,ehd->bshd", y, attn["wkv"][1].astype(dt))
+    q = rope(q, positions, cfg.rope_theta)
+    k = rope(k, positions, cfg.rope_theta)
+
+    ck, cv = cache_kv
+    t = x.shape[1]
+    ck = jax.lax.dynamic_update_slice_in_dim(ck, k.astype(ck.dtype),
+                                             cache_len, axis=1)
+    cv = jax.lax.dynamic_update_slice_in_dim(cv, v.astype(cv.dtype),
+                                             cache_len, axis=1)
+    # Attend over the whole buffer; positions beyond cache_len + t are
+    # masked by the causal rule (their k_pos > any live q_pos... they are
+    # zeros at positions >= cache_len+t, masked via kv_offset arithmetic).
+    out = dot_product_attention(
+        q, ck, cv, causal=True, kv_offset=cache_len,
+    )
+    y = jnp.einsum("bshd,hde->bse", out, attn["wo"].astype(dt))
+    x = x + y
+    y = norm(x, layer_params["mlp_norm"]["scale"])
+    mlp = layer_params["mlp"]
+    gate = jnp.einsum("bse,ef->bsf", y, mlp["wi"][0].astype(dt))
+    up = jnp.einsum("bse,ef->bsf", y, mlp["wi"][1].astype(dt))
+    h = jax.nn.silu(gate) * up
+    y = jnp.einsum("bsf,fe->bse", h, mlp["wo"].astype(dt))
+    return x + y, (ck, cv)
+
+
+def _forward_with_cache(cfg: TransformerConfig, params, tokens, cache,
+                        cache_len):
+    """tokens [b, t] -> (logits [b, t, v], new cache)."""
+    from flax import linen as nn
+
+    params = nn.unbox(params)  # accept raw model.init output
+    dt = cfg.dtype
+    embed = params["embed"]
+    x = embed.astype(dt)[tokens]
+    positions = cache_len + jnp.arange(tokens.shape[1])[None, :]
+    positions = jnp.broadcast_to(positions, tokens.shape)
+
+    layer_stack = params["layers"]
+    n_layers = cfg.n_layers
+
+    def body(carry, idx):
+        x, cache_k, cache_v = carry
+        layer_params = jax.tree_util.tree_map(lambda a: a[idx], layer_stack)
+        x, (ck, cv) = _layer_step(
+            cfg, layer_params, x,
+            (cache_k[idx], cache_v[idx]), cache_len, positions,
+        )
+        cache_k = cache_k.at[idx].set(ck)
+        cache_v = cache_v.at[idx].set(cv)
+        return (x, cache_k, cache_v), None
+
+    cache_k, cache_v = cache
+    (x, cache_k, cache_v), _ = jax.lax.scan(
+        body, (x, cache_k, cache_v), jnp.arange(n_layers))
+
+    scale = params["final_norm"]["scale"]
+    x32 = x.astype(jnp.float32)
+    x = (x32 * jax.lax.rsqrt(
+        jnp.mean(x32 * x32, axis=-1, keepdims=True) + 1e-6) * scale
+    ).astype(dt)
+    if cfg.tied_embeddings:
+        logits = jnp.einsum("bse,ve->bsv", x, embed.astype(dt))
+    else:
+        logits = jnp.einsum("bse,ev->bsv", x, params["w_out"].astype(dt))
+    return logits.astype(jnp.float32), (cache_k, cache_v)
+
+
+def init_cache(cfg: TransformerConfig, batch: int, max_len: int):
+    shape = (cfg.n_layers, batch, max_len, cfg.n_kv_heads, cfg.head_dim)
+    return (jnp.zeros(shape, cfg.dtype), jnp.zeros(shape, cfg.dtype))
+
+
+@partial(jax.jit, static_argnums=(0, 3))
+def generate(
+    cfg: TransformerConfig,
+    params,
+    prompt: jax.Array,
+    decode: DecodeConfig = DecodeConfig(),
+    rng: Optional[jax.Array] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """prompt [b, t] -> (tokens [b, t+max_new], logits_last [b, vocab]).
+
+    One jitted program: prefill the prompt, then scan max_new_tokens
+    single-token steps against the cache.
+    """
+    b, t = prompt.shape
+    max_len = t + decode.max_new_tokens
+    cache = init_cache(cfg, b, max_len)
+    if rng is None:
+        rng = jax.random.key(0)
+
+    logits, cache = _forward_with_cache(cfg, params, prompt, cache, 0)
+    last = logits[:, -1]
+
+    def sample(logits, key):
+        if decode.temperature <= 0.0:
+            return jnp.argmax(logits, axis=-1)
+        return jax.random.categorical(
+            key, logits / decode.temperature, axis=-1)
+
+    def step(carry, _):
+        cache, last_logits, cache_len, key, done = carry
+        key, sub = jax.random.split(key)
+        nxt = sample(last_logits, sub)
+        nxt = jnp.where(done, jnp.zeros_like(nxt), nxt)
+        logits, cache = _forward_with_cache(
+            cfg, params, nxt[:, None], cache, cache_len)
+        done = done | (nxt == decode.eos_token)
+        return (cache, logits[:, -1], cache_len + 1, key, done), nxt
+
+    done0 = jnp.zeros((b,), bool)
+    (_, final_logits, _, _, _), new_tokens = jax.lax.scan(
+        step, (cache, last, t, rng, done0), None,
+        length=decode.max_new_tokens)
+    tokens = jnp.concatenate([prompt, new_tokens.T], axis=1)
+    return tokens, final_logits

@@ -64,6 +64,36 @@ def classifier(config: Dict[str, Any]) -> Callable:
     return make_predict
 
 
+def lm_generate(config: Dict[str, Any]) -> Callable:
+    """Autoregressive generation loader.
+
+    config: {"model": TransformerConfig overrides,
+             "max_new_tokens": int, "temperature": float}
+    Signature: {"tokens": [b, t] int32} -> {"tokens": [b, t+new] int32}
+    """
+    from kubeflow_tpu.models.generate import DecodeConfig, generate
+    from kubeflow_tpu.models.transformer import TransformerConfig
+
+    cfg = TransformerConfig(**config.get("model", {}))
+    decode = DecodeConfig(
+        max_new_tokens=int(config.get("max_new_tokens", 64)),
+        temperature=float(config.get("temperature", 0.0)),
+        eos_token=int(config.get("eos_token", -1)),
+    )
+
+    def make_predict(variables):
+        params = variables["params"]
+
+        def predict(inputs: Dict[str, Any]) -> Dict[str, Any]:
+            tokens = jnp.asarray(inputs["tokens"], jnp.int32)
+            out, _ = generate(cfg, params, tokens, decode)
+            return {"tokens": out}
+
+        return predict
+
+    return make_predict
+
+
 def lm(config: Dict[str, Any]) -> Callable:
     """Transformer LM loader: next-token logits for a token batch.
 

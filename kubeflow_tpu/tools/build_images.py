@@ -1,0 +1,112 @@
+"""Versioned image builder — heir of components/build_image.py.
+
+The reference's builder read a ``version-config.json`` matrix and built
+tagged images per framework version/platform
+(components/build_image.py:1-50, version dirs like
+components/tensorflow-notebook-image/versions/*/version-config.json).
+Same contract here: docker/versions/<version>/version-config.json pins
+{python_version, jax_version, per-target build args}; this tool renders
+the docker build commands (and runs them with --push/--build).
+
+Also emits the nightly release workflow (heir of
+components/image-releaser/components/*-workflow.libsonnet) via
+--emit-release-workflow, reusing the testing/workflow.py DAG builder.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import subprocess
+import sys
+from pathlib import Path
+from typing import Dict, List
+
+REPO_ROOT = Path(__file__).resolve().parents[2]
+VERSIONS_DIR = REPO_ROOT / "docker" / "versions"
+TARGETS = ("worker", "model-server", "notebook", "operator")
+
+
+def load_version(version: str = "default") -> dict:
+    path = VERSIONS_DIR / version / "version-config.json"
+    return json.loads(path.read_text())
+
+
+def build_command(target: str, config: dict, registry: str,
+                  push: bool = False) -> List[str]:
+    platforms: Dict[str, dict] = config.get("platforms", {})
+    spec = platforms.get(target, {})
+    context_target = spec.get("image", target)
+    tag = f"{registry}/{target}:{config['tag_suffix']}"
+    cmd = [
+        "docker", "build",
+        "-f", str(REPO_ROOT / "docker" / context_target / "Dockerfile"),
+        "-t", tag,
+        "--build-arg", f"PYTHON_VERSION={config['python_version']}",
+        "--build-arg", f"JAX_VERSION={config['jax_version']}",
+    ]
+    for key, value in spec.items():
+        if key != "image":
+            cmd += ["--build-arg", f"{key}={value}"]
+    cmd.append(str(REPO_ROOT))
+    if push:
+        cmd = ["sh", "-c",
+               " ".join(cmd) + f" && docker push {tag}"]
+    return cmd
+
+
+def release_workflow(registry: str, config: dict) -> dict:
+    """Nightly build+test+push DAG (heir of the image-releaser argo
+    workflows; runs under the argo component from manifests/addons.py)."""
+    from kubeflow_tpu.testing.workflow import E2EWorkflow, Step
+
+    wf = E2EWorkflow("image-release", namespace="kubeflow-releasing")
+    wf.add_step(Step("checkout",
+                     ["git", "clone", "https://github.com/kubeflow-tpu/"
+                      "kubeflow-tpu", "/src"]))
+    for target in TARGETS:
+        wf.add_step(Step(
+            f"build-{target}",
+            ["python", "-m", "kubeflow_tpu.tools.build_images", target,
+             "--registry", registry, "--build", "--push"],
+            deps=["checkout"],
+            # DinD pattern, as the reference's releaser used
+            # (tf-notebook-workflow.libsonnet DinD sidecar).
+            env={"DOCKER_HOST": "tcp://localhost:2375"},
+        ))
+    wf.add_step(Step(
+        "smoke-test",
+        ["python", "-m", "kubeflow_tpu.testing.e2e", "train"],
+        deps=[f"build-{t}" for t in TARGETS]))
+    return wf.to_custom_resource()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="kubeflow-tpu-build-images")
+    ap.add_argument("targets", nargs="*", default=list(TARGETS),
+                    help=f"images to build (default: all of {TARGETS})")
+    ap.add_argument("--version", default="default",
+                    help="version dir under docker/versions/")
+    ap.add_argument("--registry", default="ghcr.io/kubeflow-tpu")
+    ap.add_argument("--build", action="store_true",
+                    help="actually run docker (default: print commands)")
+    ap.add_argument("--push", action="store_true")
+    ap.add_argument("--emit-release-workflow", action="store_true",
+                    help="print the nightly release Argo Workflow")
+    args = ap.parse_args(argv)
+
+    config = load_version(args.version)
+    if args.emit_release_workflow:
+        print(json.dumps(release_workflow(args.registry, config), indent=2))
+        return 0
+    rc = 0
+    for target in (args.targets or TARGETS):
+        cmd = build_command(target, config, args.registry, push=args.push)
+        print(" ".join(cmd), file=sys.stderr)
+        if args.build:
+            rc |= subprocess.run(cmd).returncode
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

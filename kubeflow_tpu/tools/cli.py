@@ -113,6 +113,19 @@ def cmd_prototype(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_bootstrap(args: argparse.Namespace) -> int:
+    from kubeflow_tpu.tools import bootstrap as boot
+
+    argv = []
+    if args.config:
+        argv += ["--config", args.config]
+    if args.apply:
+        argv += ["--apply"]
+    if args.namespace:
+        argv += ["--namespace", args.namespace]
+    return boot.main(argv)
+
+
 def cmd_version(args: argparse.Namespace) -> int:
     from kubeflow_tpu.version import version_info
 
@@ -162,6 +175,15 @@ def build_parser() -> argparse.ArgumentParser:
     pdesc = psub.add_parser("describe")
     pdesc.add_argument("prototype")
     pdesc.set_defaults(func=cmd_prototype, action="describe")
+
+    p = sub.add_parser(
+        "bootstrap",
+        help="one-shot platform install from a BootConfig YAML "
+             "(heir of the reference's bootstrapper)")
+    p.add_argument("--config", default=None)
+    p.add_argument("--apply", action="store_true")
+    p.add_argument("--namespace", default=None)
+    p.set_defaults(func=cmd_bootstrap)
 
     p = sub.add_parser("version", help="print version info")
     p.set_defaults(func=cmd_version)

@@ -1,0 +1,119 @@
+"""Data staging sidecar — heir of components/openmpi-controller.
+
+The reference's controller sidecar downloaded S3 data before the job,
+signalled the job container via files in a shared emptyDir, polled the
+master pod's phase through the k8s API, and uploaded results after
+(controller/controller.py:50-109, util.py:10-31 retries).
+
+The TPU-native split: download runs as an *initContainer* (k8s-native
+ordering replaces the SIGCONT file signal), upload runs as this sidecar
+after `wait-job` observes the TPUJob reach a terminal phase (the phase
+poll survives, aimed at the CR instead of the master pod — gangs have no
+master).  Retries with exponential backoff mirror util.py's policy.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import subprocess
+import sys
+import time
+from typing import Callable, List, Optional
+
+log = logging.getLogger(__name__)
+
+
+def retry(fn: Callable[[], None], max_attempts: int = 5,
+          base_delay_s: float = 1.0) -> None:
+    """Exponential backoff, heir of openmpi-controller util.py:10-31."""
+    for attempt in range(max_attempts):
+        try:
+            fn()
+            return
+        except Exception as e:
+            if attempt == max_attempts - 1:
+                raise
+            delay = base_delay_s * 2 ** attempt
+            log.warning("attempt %d failed (%s); retrying in %.0fs",
+                        attempt + 1, e, delay)
+            time.sleep(delay)
+
+
+def _copy_cmd(src: str, dest: str) -> List[str]:
+    if src.startswith("gs://") or dest.startswith("gs://"):
+        return ["gsutil", "-m", "cp", "-r", src, dest]
+    if src.startswith("s3://") or dest.startswith("s3://"):
+        return ["aws", "s3", "cp", "--recursive", src, dest]
+    return ["cp", "-r", src, dest]
+
+
+def transfer(src: str, dest: str) -> None:
+    cmd = _copy_cmd(src, dest)
+
+    def run():
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"{' '.join(cmd)} -> {proc.returncode}: "
+                f"{proc.stderr[-500:]}")
+
+    retry(run)
+
+
+def wait_job(name: str, namespace: str, timeout_s: float = 86_400,
+             poll_s: float = 10.0, kube=None) -> str:
+    """Poll the TPUJob CR until Succeeded/Failed; returns the phase.
+    (Heir of the master-phase poll, controller.py:87-97.)"""
+    if kube is None:
+        from kubeflow_tpu.operator.kube_real import RealKube
+
+        kube = RealKube()
+    deadline = time.monotonic() + timeout_s
+    while True:
+        cr = kube.get_custom(namespace, name)
+        phase = (cr.get("status") or {}).get("phase", "")
+        if phase in ("Succeeded", "Failed"):
+            return phase
+        if time.monotonic() > deadline:
+            raise TimeoutError(
+                f"TPUJob {namespace}/{name} still {phase!r} after "
+                f"{timeout_s}s")
+        time.sleep(poll_s)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="kubeflow-tpu-data-stager")
+    sub = ap.add_subparsers(dest="command", required=True)
+
+    p = sub.add_parser("download", help="stage input data (initContainer)")
+    p.add_argument("--src", required=True)
+    p.add_argument("--dest", required=True)
+
+    p = sub.add_parser("upload", help="ship results out")
+    p.add_argument("--src", required=True)
+    p.add_argument("--dest", required=True)
+
+    p = sub.add_parser(
+        "wait-job", help="block until the TPUJob reaches a terminal phase")
+    p.add_argument("--name", required=True)
+    p.add_argument("--namespace", default="kubeflow")
+    p.add_argument("--timeout-s", type=float, default=86_400)
+    p.add_argument("--then-upload-src")
+    p.add_argument("--then-upload-dest")
+
+    args = ap.parse_args(argv)
+    logging.basicConfig(level=logging.INFO, stream=sys.stderr)
+
+    if args.command in ("download", "upload"):
+        transfer(args.src, args.dest)
+        return 0
+    phase = wait_job(args.name, args.namespace, args.timeout_s)
+    log.info("job %s finished: %s", args.name, phase)
+    if args.then_upload_src and args.then_upload_dest:
+        transfer(args.then_upload_src, args.then_upload_dest)
+    return 0 if phase == "Succeeded" else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,115 @@
+"""In-container LM training entrypoint — the flagship Transformer under
+the full parallelism surface (dp/fsdp/sp/tp/ep via --mesh axes).
+
+No reference counterpart (its era had no LM workload); this is the
+entrypoint TPUJob LM prototypes launch.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import sys
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="kubeflow-tpu-train-lm")
+    ap.add_argument("--d-model", type=int, default=512)
+    ap.add_argument("--n-layers", type=int, default=8)
+    ap.add_argument("--n-heads", type=int, default=8)
+    ap.add_argument("--n-kv-heads", type=int, default=8)
+    ap.add_argument("--d-ff", type=int, default=1408)
+    ap.add_argument("--head-dim", type=int, default=64)
+    ap.add_argument("--vocab-size", type=int, default=32_000)
+    ap.add_argument("--seq-len", type=int, default=1024)
+    ap.add_argument("--moe-experts", type=int, default=0)
+    ap.add_argument("--attention", default="dot",
+                    choices=["dot", "flash", "ring"])
+    ap.add_argument("--remat", action="store_true")
+    ap.add_argument("--batch-size-per-device", type=int, default=8)
+    ap.add_argument("--steps", type=int, default=100)
+    ap.add_argument("--learning-rate", type=float, default=3e-4)
+    ap.add_argument("--mesh", default="",
+                    help="axis sizes, e.g. 'tensor=4,sequence=2' "
+                         "(data absorbs the rest)")
+    ap.add_argument("--data-files", nargs="*", default=[],
+                    help="KFTR shards with {'tokens': [s]} examples "
+                         "(synthetic stream if empty)")
+    ap.add_argument("--checkpoint-dir", default="")
+    ap.add_argument("--checkpoint-every", type=int, default=100)
+    ap.add_argument("--log-every", type=int, default=10)
+    args = ap.parse_args(argv)
+
+    logging.basicConfig(level=logging.INFO, stream=sys.stderr)
+    from kubeflow_tpu.runtime import bootstrap
+
+    env = bootstrap.initialize()
+
+    import jax
+    import numpy as np
+    import optax
+
+    from kubeflow_tpu.models.transformer import TransformerConfig, lm_task
+    from kubeflow_tpu.parallel import MeshSpec
+    from kubeflow_tpu.runtime.checkpoint import CheckpointManager
+    from kubeflow_tpu.runtime.metrics import MetricsLogger
+    from kubeflow_tpu.runtime.topology import parse_slice_type
+    from kubeflow_tpu.runtime.train import Trainer
+
+    mesh_axes = {}
+    if args.mesh:
+        for pair in args.mesh.split(","):
+            k, _, v = pair.partition("=")
+            mesh_axes[k.strip()] = int(v)
+    mesh = MeshSpec(**mesh_axes).build()
+
+    cfg = TransformerConfig(
+        vocab_size=args.vocab_size, d_model=args.d_model,
+        n_layers=args.n_layers, n_heads=args.n_heads,
+        n_kv_heads=args.n_kv_heads, d_ff=args.d_ff,
+        head_dim=args.head_dim, max_seq_len=args.seq_len,
+        moe_experts=args.moe_experts, attention=args.attention,
+        remat=args.remat,
+    )
+    init_fn, loss_fn = lm_task(cfg, mesh=mesh)
+    batch = args.batch_size_per_device * jax.device_count()
+    peak = (parse_slice_type(env.slice_type).bf16_tflops_per_chip * 1e12
+            if env.slice_type else 0.0)
+    trainer = Trainer(
+        init_fn=init_fn, loss_fn=loss_fn,
+        tx=optax.adamw(args.learning_rate), mesh=mesh,
+        checkpoints=(CheckpointManager(args.checkpoint_dir)
+                     if args.checkpoint_dir else None),
+        checkpoint_every=args.checkpoint_every,
+        metrics=MetricsLogger(static={"job": env.job_name,
+                                      "process": env.process_id}),
+        flops_per_example=cfg.flops_per_token() * args.seq_len,
+        peak_flops_per_chip=peak,
+    )
+
+    if args.data_files:
+        from kubeflow_tpu.data import RecordDataset, tensor_batches
+
+        ds = RecordDataset(
+            args.data_files, shuffle_buffer=1024, repeat=-1,
+        ).shard(env.process_id, max(env.num_processes, 1))
+        data = tensor_batches(ds, batch)
+    else:
+        rng = np.random.RandomState(env.process_id)
+
+        def synthetic():
+            while True:
+                yield {"tokens": rng.randint(
+                    0, args.vocab_size,
+                    size=(batch, args.seq_len)).astype(np.int32)}
+
+        data = synthetic()
+
+    trainer.fit(data, num_steps=args.steps, examples_per_step=batch,
+                log_every=args.log_every)
+    logging.info("training done: %s", trainer._last_metrics)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,42 @@
+"""Bootstrap installer tests (heir of bootstrap/.../server_test.go)."""
+
+import yaml
+
+from kubeflow_tpu.tools.bootstrap import BootConfig, render
+
+
+def test_default_config_renders_platform():
+    cfg = BootConfig(platform="generic")
+    objs = render(cfg)
+    kinds = [o["kind"] for o in objs]
+    assert kinds[0] == "Namespace"
+    assert "CustomResourceDefinition" in kinds  # operator CRD
+    assert kinds.count("Deployment") >= 2
+
+
+def test_gke_platform_adds_admin_binding_and_cloud_param():
+    cfg = BootConfig(platform="gke")
+    objs = render(cfg)
+    assert objs[-1]["kind"] == "ClusterRoleBinding"
+    assert objs[-1]["roleRef"]["name"] == "cluster-admin"
+
+
+def test_yaml_config_roundtrip(tmp_path):
+    path = tmp_path / "boot.yaml"
+    path.write_text(yaml.safe_dump({
+        "bootstrap": {
+            "namespace": "ml",
+            "platform": "generic",
+            "components": [
+                {"prototype": "tpujob-operator", "name": "op"},
+                {"prototype": "tpu-job", "name": "train",
+                 "params": {"slice_type": "v5p-32"}},
+            ],
+        },
+    }))
+    cfg = BootConfig.load(path)
+    assert cfg.namespace == "ml"
+    objs = render(cfg)
+    assert objs[0]["metadata"]["name"] == "ml"
+    tpujob = [o for o in objs if o["kind"] == "TPUJob"][0]
+    assert tpujob["spec"]["sliceType"] == "v5p-32"

@@ -1,0 +1,80 @@
+"""Tools tests: build_images command rendering, data stager, CLI bootstrap."""
+
+import numpy as np
+import pytest
+
+from kubeflow_tpu.tools.build_images import (
+    TARGETS,
+    build_command,
+    load_version,
+    release_workflow,
+)
+from kubeflow_tpu.tools.data_stager import _copy_cmd, retry, wait_job
+
+
+class TestBuildImages:
+    def test_commands_render_for_all_targets(self):
+        config = load_version()
+        for target in TARGETS:
+            cmd = build_command(target, config, "reg.example/x")
+            assert cmd[0] == "docker"
+            assert f"reg.example/x/{target}:{config['tag_suffix']}" in cmd
+
+    def test_release_workflow_dag(self):
+        wf = release_workflow("reg.example/x", load_version())
+        main = [t for t in wf["spec"]["templates"]
+                if t["name"] == "main"][0]
+        names = {t["name"] for t in main["dag"]["tasks"]}
+        assert {"checkout", "build-worker", "smoke-test"} <= names
+        smoke = [t for t in main["dag"]["tasks"]
+                 if t["name"] == "smoke-test"][0]
+        assert set(smoke["dependencies"]) == {f"build-{t}" for t in TARGETS}
+
+
+class TestDataStager:
+    def test_copy_cmd_selection(self):
+        assert _copy_cmd("gs://b/x", "/d")[0] == "gsutil"
+        assert _copy_cmd("s3://b/x", "/d")[0] == "aws"
+        assert _copy_cmd("/a", "/d")[0] == "cp"
+
+    def test_retry_backoff(self, monkeypatch):
+        sleeps = []
+        monkeypatch.setattr("time.sleep", sleeps.append)
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise RuntimeError("nope")
+
+        retry(flaky, max_attempts=5, base_delay_s=1.0)
+        assert len(calls) == 3
+        assert sleeps == [1.0, 2.0]
+
+    def test_retry_exhaustion(self, monkeypatch):
+        monkeypatch.setattr("time.sleep", lambda s: None)
+        with pytest.raises(RuntimeError):
+            retry(lambda: (_ for _ in ()).throw(RuntimeError("x")),
+                  max_attempts=2)
+
+    def test_wait_job_against_fake_control_plane(self):
+        from kubeflow_tpu.operator import crd
+        from kubeflow_tpu.operator.kube import FakeKube
+
+        kube = FakeKube()
+        cr = crd.TPUJobSpec(name="j", namespace="ns",
+                            slice_type="v5e-1").to_custom_resource()
+        cr["status"] = {"phase": "Succeeded"}
+        kube.create_custom(cr)
+        assert wait_job("j", "ns", kube=kube) == "Succeeded"
+
+    def test_wait_job_timeout(self):
+        from kubeflow_tpu.operator import crd
+        from kubeflow_tpu.operator.kube import FakeKube
+
+        kube = FakeKube()
+        kube.create_custom(crd.TPUJobSpec(
+            name="j", namespace="ns",
+            slice_type="v5e-1").to_custom_resource())
+        with pytest.raises(TimeoutError):
+            wait_job("j", "ns", timeout_s=0.0, poll_s=0.01, kube=kube)
