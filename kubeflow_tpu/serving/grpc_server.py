@@ -1,0 +1,187 @@
+"""gRPC PredictionService — the reference's primary serving wire contract.
+
+The reference exposed C++ TF-Serving's gRPC PredictionService on :9000
+(kubeflow/tf-serving/tf-serving.libsonnet:118-132) with the REST proxy in
+front; here the same split: serving/http.py is the REST face, this module
+the gRPC face, both over one ModelServer.
+
+Service stubs are hand-rolled with grpc's generic-handler API (the image
+has protoc for messages but no grpc codegen plugin); the method table
+mirrors protos/prediction.proto.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from concurrent import futures
+from typing import Optional
+
+import grpc
+import numpy as np
+
+from kubeflow_tpu.serving.model_server import ModelServer
+from kubeflow_tpu.serving.protos import prediction_pb2 as pb
+
+log = logging.getLogger(__name__)
+
+SERVICE = "kft.serving.PredictionService"
+GRPC_PORT = 9000  # same port the reference's model server bound
+
+
+def tensor_to_numpy(t: pb.Tensor) -> np.ndarray:
+    return np.frombuffer(t.data, dtype=np.dtype(t.dtype)).reshape(
+        tuple(t.shape))
+
+
+def numpy_to_tensor(arr: np.ndarray) -> pb.Tensor:
+    arr = np.ascontiguousarray(arr)
+    return pb.Tensor(dtype=str(arr.dtype), shape=list(arr.shape),
+                     data=arr.tobytes())
+
+
+class PredictionServicer:
+    def __init__(self, server: ModelServer):
+        self.server = server
+
+    def _resolve(self, spec: pb.ModelSpec):
+        version = spec.version if spec.version > 0 else None
+        return self.server.get(spec.name, version)
+
+    def Predict(self, request: pb.PredictRequest,
+                context: grpc.ServicerContext) -> pb.PredictResponse:
+        model = self._resolve(request.model_spec)
+        inputs = {k: tensor_to_numpy(t) for k, t in request.inputs.items()}
+        outputs = model.predict(inputs)
+        resp = pb.PredictResponse()
+        resp.model_spec.name = model.name
+        resp.model_spec.version = model.version
+        for key, value in outputs.items():
+            resp.outputs[key].CopyFrom(numpy_to_tensor(np.asarray(value)))
+        return resp
+
+    def Classify(self, request: pb.ClassifyRequest,
+                 context: grpc.ServicerContext) -> pb.ClassifyResponse:
+        model = self._resolve(request.model_spec)
+        inputs = {k: tensor_to_numpy(t) for k, t in request.inputs.items()}
+        outputs = {k: np.asarray(v)
+                   for k, v in model.predict(inputs).items()}
+        resp = pb.ClassifyResponse()
+        resp.model_spec.name = model.name
+        resp.model_spec.version = model.version
+        if "top_k_classes" in outputs:
+            classes, scores = outputs["top_k_classes"], outputs["top_k_scores"]
+        else:
+            scores = outputs["scores"]
+            k = request.top_k or scores.shape[-1]
+            idx = np.argsort(-scores, axis=-1)[:, :k]
+            classes = idx
+            scores = np.take_along_axis(scores, idx, axis=-1)
+        for row_c, row_s in zip(classes, scores):
+            result = resp.results.add()
+            result.classes.extend(str(c) for c in row_c)
+            result.scores.extend(float(s) for s in row_s)
+        return resp
+
+    def GetModelMetadata(
+        self, request: pb.GetModelMetadataRequest,
+        context: grpc.ServicerContext,
+    ) -> pb.GetModelMetadataResponse:
+        model = self._resolve(request.model_spec)
+        resp = pb.GetModelMetadataResponse()
+        resp.model_spec.name = model.name
+        resp.model_spec.version = model.version
+        resp.metadata_json = json.dumps(model.meta)
+        return resp
+
+
+_METHODS = {
+    "Predict": (pb.PredictRequest, pb.PredictResponse),
+    "Classify": (pb.ClassifyRequest, pb.ClassifyResponse),
+    "GetModelMetadata": (pb.GetModelMetadataRequest,
+                         pb.GetModelMetadataResponse),
+}
+
+
+def _wrap(servicer: PredictionServicer, name: str):
+    method = getattr(servicer, name)
+
+    def handler(request, context):
+        try:
+            return method(request, context)
+        except KeyError as e:
+            context.abort(grpc.StatusCode.NOT_FOUND, str(e))
+        except ValueError as e:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+
+    return handler
+
+
+def make_grpc_server(
+    model_server: ModelServer,
+    port: int = GRPC_PORT,
+    host: str = "0.0.0.0",
+    max_workers: int = 8,
+) -> grpc.Server:
+    """Build + start the gRPC server; returns it (call .stop() to halt).
+    Pass port=0 for an ephemeral port (read it from .bound_port)."""
+    servicer = PredictionServicer(model_server)
+    handlers = {
+        name: grpc.unary_unary_rpc_method_handler(
+            _wrap(servicer, name),
+            request_deserializer=req.FromString,
+            response_serializer=resp.SerializeToString,
+        )
+        for name, (req, resp) in _METHODS.items()
+    }
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+    server.add_generic_rpc_handlers(
+        (grpc.method_handlers_generic_handler(SERVICE, handlers),))
+    bound = server.add_insecure_port(f"{host}:{port}")
+    server.bound_port = bound
+    server.start()
+    log.info("gRPC PredictionService on :%d", bound)
+    return server
+
+
+class PredictionClient:
+    """Minimal client — heir of inception-client/label.py:40-57."""
+
+    def __init__(self, target: str):
+        self._channel = grpc.insecure_channel(target)
+        self._methods = {
+            name: self._channel.unary_unary(
+                f"/{SERVICE}/{name}",
+                request_serializer=req.SerializeToString,
+                response_deserializer=resp.FromString,
+            )
+            for name, (req, resp) in _METHODS.items()
+        }
+
+    def predict(self, model: str, inputs: dict,
+                version: int = 0, timeout: float = 60.0):
+        req = pb.PredictRequest()
+        req.model_spec.name = model
+        req.model_spec.version = version
+        for key, value in inputs.items():
+            req.inputs[key].CopyFrom(numpy_to_tensor(np.asarray(value)))
+        resp = self._methods["Predict"](req, timeout=timeout)
+        return {k: tensor_to_numpy(t) for k, t in resp.outputs.items()}
+
+    def classify(self, model: str, inputs: dict, top_k: int = 5,
+                 timeout: float = 60.0):
+        req = pb.ClassifyRequest(top_k=top_k)
+        req.model_spec.name = model
+        for key, value in inputs.items():
+            req.inputs[key].CopyFrom(numpy_to_tensor(np.asarray(value)))
+        resp = self._methods["Classify"](req, timeout=timeout)
+        return [list(zip(r.classes, r.scores)) for r in resp.results]
+
+    def metadata(self, model: str, timeout: float = 60.0) -> dict:
+        req = pb.GetModelMetadataRequest()
+        req.model_spec.name = model
+        resp = self._methods["GetModelMetadata"](req, timeout=timeout)
+        return json.loads(resp.metadata_json)
+
+    def close(self) -> None:
+        self._channel.close()

@@ -223,3 +223,38 @@ class TestMicroBatcher:
         with pytest.raises(RuntimeError, match="boom"):
             mb.submit({"x": np.zeros((1,))})
         mb.close()
+
+
+class TestGRPC:
+    def test_predict_classify_metadata_roundtrip(self, exported):
+        import grpc
+
+        from kubeflow_tpu.serving.grpc_server import (
+            PredictionClient,
+            make_grpc_server,
+        )
+
+        base, model, variables = exported
+        srv = ModelServer()
+        srv.add_model("tiny", str(base))
+        server = make_grpc_server(srv, port=0, host="127.0.0.1")
+        try:
+            client = PredictionClient(f"127.0.0.1:{server.bound_port}")
+            rng = np.random.RandomState(9)
+            img = rng.randn(2, IMG, IMG, 3).astype(np.float32)
+            out = client.predict("tiny", {"image": img})
+            assert out["scores"].shape == (2, CLASSES)
+            np.testing.assert_allclose(out["scores"].sum(-1), 1.0, atol=1e-3)
+
+            pairs = client.classify("tiny", {"image": img})
+            assert len(pairs) == 2 and len(pairs[0]) == 2  # top_k=2 config
+
+            meta = client.metadata("tiny")
+            assert meta["version"] == 1
+
+            with pytest.raises(grpc.RpcError) as err:
+                client.predict("missing", {"image": img})
+            assert err.value.code() == grpc.StatusCode.NOT_FOUND
+            client.close()
+        finally:
+            server.stop(0)
