@@ -1,10 +1,17 @@
 """Benchmark entrypoint — prints ONE JSON line on stdout.
 
-Measures the framework's heir of the reference's headline benchmark:
-ResNet-50 training throughput (tf_cnn_benchmarks --model=resnet50,
-kubeflow/tf-job/prototypes/tf-cnn-benchmarks.jsonnet:7).  The reference
-published no absolute numbers (BASELINE.md), so ``vs_baseline`` reports
-achieved MFU relative to the BASELINE.json north-star of 50% MFU.
+Measures the framework's heirs of the reference's headline benchmark
+harness (tf_cnn_benchmarks, kubeflow/tf-job/prototypes/
+tf-cnn-benchmarks.jsonnet:7).  The reference published no absolute
+numbers (BASELINE.md), so ``vs_baseline`` reports achieved MFU relative
+to the BASELINE.json north-star of 50% MFU.
+
+Two workloads, both measured through Trainer.fit (the shipped loop IS
+the benchmarked loop):
+  --model=resnet  ResNet-50 images/sec (the reference's headline).
+  --model=lm      Transformer LM tokens/sec with the Pallas flash
+                  attention kernel — the long-context capability the
+                  reference never had.
 
 Runs on whatever devices JAX sees: the real TPU chip under the driver, or
 a fake CPU slice with --fake-devices N for hermetic testing.  Diagnostics
@@ -15,18 +22,217 @@ from __future__ import annotations
 
 import argparse
 import json
-
 import sys
 import time
 
 
+def peak_flops(device) -> float:
+    """Per-chip peak bf16 FLOPs from the device kind (v5e default)."""
+    kind = device.device_kind.lower()
+    if device.platform != "tpu":
+        return 1e12  # nominal CPU "peak" to keep the field defined
+    for key, val in (("v5p", 459e12), ("v6e", 918e12), ("v4", 275e12)):
+        if key in kind:
+            return val
+    return 197e12
+
+
+def measure_fit(trainer, state, dev_batch, warmup: int, steps: int):
+    """Run Trainer.fit twice (compile+warmup, then measured) and return the
+    steady-state step time from the final metrics window.
+
+    The batch is staged to HBM once and the iterator repeats it (fit's
+    shard_batch device_put is then a no-op), so the number measures device
+    step throughput, not the driver tunnel's host->device bandwidth.
+    """
+    import jax  # noqa: F401  (import order: caller configured platform)
+
+    def repeat(b):
+        while True:
+            yield b
+
+    state = trainer.fit(
+        repeat(dev_batch), warmup, state=state,
+        examples_per_step=0, log_every=1,
+    )
+    t0 = time.perf_counter()
+    state = trainer.fit(
+        repeat(dev_batch), steps, state=state,
+        examples_per_step=0, log_every=max(1, steps - 1),
+    )
+    print(f"measured fit wall: {time.perf_counter()-t0:.2f} s",
+          file=sys.stderr)
+    rec = trainer.metrics.history[-1]
+    return rec["step_time_s"]
+
+
+def bench_resnet(args, devices, n_chips, on_tpu):
+    import numpy as np
+    import optax
+
+    from kubeflow_tpu.models.classification import classification_task
+    from kubeflow_tpu.models.resnet import ResNetConfig
+    from kubeflow_tpu.parallel import MeshSpec
+    from kubeflow_tpu.runtime.metrics import MetricsLogger, mfu
+    from kubeflow_tpu.runtime.train import Trainer
+
+    batch = args.batch or (256 if on_tpu else 64) * n_chips
+    size = args.image_size
+    print(
+        f"bench: resnet50 train step, {n_chips}x{devices[0].device_kind}, "
+        f"global batch {batch}, image {size}",
+        file=sys.stderr,
+    )
+    peak = peak_flops(devices[0])
+    cfg = ResNetConfig(name="resnet50")
+    model = cfg.build()
+    init_fn, loss_fn = classification_task(model, (1, size, size, 3))
+    mesh = MeshSpec(data=n_chips).build(devices)
+    trainer = Trainer(
+        init_fn=init_fn, loss_fn=loss_fn,
+        tx=optax.sgd(0.1, momentum=0.9), mesh=mesh,
+        metrics=MetricsLogger(stream=sys.stderr),
+        flops_per_example=cfg.fwd_flops_per_image * (size / 224) ** 2,
+        peak_flops_per_chip=peak,
+    )
+    state = trainer.create_state()
+    rng = np.random.RandomState(0)
+    host_batch = {
+        "image": rng.randn(batch, size, size, 3).astype(np.float32),
+        "label": rng.randint(0, 1000, size=(batch,)),
+    }
+    dev_batch = trainer.shard_batch(host_batch)
+
+    # Roofline context: the v5e ResNet step is HBM-bandwidth-bound, not
+    # MXU-bound — report how close to the chip's own ceiling we run.
+    roofline = {}
+    try:
+        ca = trainer.compile_step().lower(state, dev_batch).compile() \
+            .cost_analysis()
+        hbm_gbps = {"v5p": 2765e9, "v6e": 1640e9}.get(
+            next((g for g in ("v5p", "v6e")
+                  if g in devices[0].device_kind.lower()), ""), 819e9
+        ) if on_tpu else 100e9
+        flops_ms = ca.get("flops", 0) / (peak * n_chips) * 1e3
+        bytes_ms = ca.get("bytes accessed", 0) / (hbm_gbps * n_chips) * 1e3
+        roofline = {
+            "hlo_flops": ca.get("flops", 0),
+            "hlo_bytes_accessed": ca.get("bytes accessed", 0),
+            "mxu_bound_ms": round(flops_ms, 2),
+            "hbm_bound_ms": round(bytes_ms, 2),
+        }
+    except Exception as e:  # cost analysis is best-effort
+        print(f"cost_analysis unavailable: {e}", file=sys.stderr)
+
+    step_s = measure_fit(trainer, state, dev_batch, args.warmup, args.steps)
+    print(f"steady state: {step_s*1e3:.2f} ms/step", file=sys.stderr)
+    images_per_sec = batch / step_s
+    flops_per_step = 3 * cfg.fwd_flops_per_image * batch * (size / 224) ** 2
+    achieved_mfu = mfu(flops_per_step, step_s, n_chips, peak)
+    if roofline:
+        bound_ms = max(roofline["mxu_bound_ms"], roofline["hbm_bound_ms"])
+        if bound_ms:
+            roofline["frac_of_roofline"] = round(
+                bound_ms / (step_s * 1e3), 4)
+    return {
+        "metric": "resnet50_images_per_sec_per_chip",
+        "value": round(images_per_sec / n_chips, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(achieved_mfu / 0.50, 4),
+        "detail": {
+            "images_per_sec": round(images_per_sec, 2),
+            "step_time_ms": round(step_s * 1e3, 2),
+            "global_batch": batch,
+            "n_chips": n_chips,
+            "mfu": round(achieved_mfu, 4),
+            "device": devices[0].device_kind,
+            "roofline": roofline,
+        },
+    }
+
+
+def bench_lm(args, devices, n_chips, on_tpu):
+    """Transformer LM with flash attention: tokens/sec/chip + MFU."""
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from kubeflow_tpu.models.transformer import TransformerConfig, lm_task
+    from kubeflow_tpu.parallel import MeshSpec
+    from kubeflow_tpu.runtime.metrics import MetricsLogger, mfu
+    from kubeflow_tpu.runtime.train import Trainer
+
+    seq = args.seq_len if on_tpu else min(args.seq_len, 128)
+    if on_tpu:
+        cfg = TransformerConfig(
+            vocab_size=32_000, d_model=1024, n_layers=12, n_heads=8,
+            n_kv_heads=8, d_ff=2816, head_dim=128, max_seq_len=seq,
+            dtype=jnp.bfloat16, attention=args.attention, remat=True,
+        )
+        batch = args.batch or 8 * n_chips
+    else:  # tiny hermetic config for --fake-devices runs
+        cfg = TransformerConfig(
+            vocab_size=512, d_model=64, n_layers=2, n_heads=4, n_kv_heads=4,
+            d_ff=128, head_dim=16, max_seq_len=seq, dtype=jnp.float32,
+            attention="dot",
+        )
+        batch = args.batch or 4 * n_chips
+    print(
+        f"bench: lm train step ({cfg.attention} attention), "
+        f"{n_chips}x{devices[0].device_kind}, batch {batch} x seq {seq}",
+        file=sys.stderr,
+    )
+    peak = peak_flops(devices[0])
+    mesh = MeshSpec(data=n_chips).build(devices)
+    init_fn, loss_fn = lm_task(cfg, mesh=mesh)
+    trainer = Trainer(
+        init_fn=init_fn, loss_fn=loss_fn, tx=optax.adamw(1e-3), mesh=mesh,
+        metrics=MetricsLogger(stream=sys.stderr),
+        flops_per_example=cfg.flops_per_token() * seq,
+        peak_flops_per_chip=peak,
+    )
+    state = trainer.create_state()
+    rng = np.random.RandomState(0)
+    tokens = rng.randint(0, cfg.vocab_size, size=(batch, seq)).astype(
+        np.int32)
+    dev_batch = trainer.shard_batch({"tokens": tokens})
+    step_s = measure_fit(trainer, state, dev_batch, args.warmup, args.steps)
+    print(f"steady state: {step_s*1e3:.2f} ms/step", file=sys.stderr)
+    tokens_per_sec = batch * seq / step_s
+    flops_per_step = 3 * cfg.flops_per_token() * batch * seq
+    achieved_mfu = mfu(flops_per_step, step_s, n_chips, peak)
+    return {
+        "metric": "lm_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec / n_chips, 2),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": round(achieved_mfu / 0.50, 4),
+        "detail": {
+            "tokens_per_sec": round(tokens_per_sec, 2),
+            "step_time_ms": round(step_s * 1e3, 2),
+            "global_batch": batch,
+            "seq_len": seq,
+            "attention": cfg.attention,
+            "n_chips": n_chips,
+            "mfu": round(achieved_mfu, 4),
+            "device": devices[0].device_kind,
+        },
+    }
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--model", choices=["resnet", "lm", "both"],
+                    default="both",
+                    help="'both' = ResNet headline (the reference's own "
+                         "benchmark) with the LM suite nested in detail")
+    ap.add_argument("--steps", type=int, default=30)
     ap.add_argument("--warmup", type=int, default=3)
     ap.add_argument("--batch", type=int, default=0,
-                    help="global batch (default: 64 per device)")
+                    help="global batch (default: per-model per-device)")
     ap.add_argument("--image-size", type=int, default=224)
+    ap.add_argument("--seq-len", type=int, default=2048)
+    ap.add_argument("--attention", default="flash",
+                    help="lm attention backend: flash | dot")
     ap.add_argument("--fake-devices", type=int, default=0,
                     help="run on an N-device virtual CPU slice")
     args = ap.parse_args()
@@ -43,87 +249,25 @@ def main() -> None:
     if args.fake_devices:
         jax.config.update("jax_platforms", "cpu")
 
-    import jax.numpy as jnp
-    import numpy as np
-    import optax
-
-    from kubeflow_tpu.models.classification import classification_task
-    from kubeflow_tpu.models.resnet import ResNetConfig
-    from kubeflow_tpu.parallel import MeshSpec
-    from kubeflow_tpu.runtime.metrics import MetricsLogger, mfu
-    from kubeflow_tpu.runtime.train import Trainer
-
     devices = jax.devices()
     n_chips = len(devices)
     on_tpu = devices[0].platform == "tpu"
-    batch = args.batch or 64 * n_chips
-    size = args.image_size
-    print(
-        f"bench: resnet50 train step, {n_chips}x{devices[0].device_kind}, "
-        f"global batch {batch}, image {size}",
-        file=sys.stderr,
-    )
-
-    cfg = ResNetConfig(name="resnet50")
-    model = cfg.build()
-    init_fn, loss_fn = classification_task(model, (1, size, size, 3))
-    mesh = MeshSpec(data=n_chips).build(devices)
-    trainer = Trainer(
-        init_fn=init_fn, loss_fn=loss_fn,
-        tx=optax.sgd(0.1, momentum=0.9), mesh=mesh,
-        metrics=MetricsLogger(stream=sys.stderr),
-    )
-    state = trainer.create_state()
-    step = trainer.compile_step()
-
-    rng = np.random.RandomState(0)
-    host_batch = {
-        "image": rng.randn(batch, size, size, 3).astype(np.float32),
-        "label": rng.randint(0, 1000, size=(batch,)),
-    }
-    dev_batch = trainer.shard_batch(host_batch)
-
-    # Warmup (compile + cache), each synced to the host.
-    for i in range(args.warmup):
-        t0 = time.perf_counter()
-        state, metrics = step(state, dev_batch)
-        loss = float(metrics["loss"])
-        print(f"warmup {i}: {(time.perf_counter()-t0)*1e3:.1f} ms "
-              f"loss={loss:.3f}", file=sys.stderr)
-
-    # Steady state: pipelined dispatch, ONE sync at the end.  Per-step
-    # host syncs would measure host<->device round-trip latency (~100 ms
-    # through the driver's TPU tunnel), not device throughput.
-    t0 = time.perf_counter()
-    for _ in range(args.steps):
-        state, metrics = step(state, dev_batch)
-    jax.block_until_ready(state.params)
-    step_s = (time.perf_counter() - t0) / args.steps
-    print(f"steady state: {step_s*1e3:.2f} ms/step", file=sys.stderr)
-    images_per_sec = batch / step_s
-    # fwd+bwd ~= 3x fwd FLOPs; peak from the chip spec (v5e unless v5p/v6e).
-    peak = {"v5p": 459e12, "v6e": 918e12}.get(
-        next((g for g in ("v5p", "v6e")
-              if g in devices[0].device_kind.lower()), ""), 197e12
-    ) if on_tpu else 1e12  # nominal CPU "peak" to keep the field defined
-    flops_per_step = 3 * cfg.fwd_flops_per_image * batch \
-        * (size / 224) ** 2
-    achieved_mfu = mfu(flops_per_step, step_s, n_chips, peak)
-
-    result = {
-        "metric": "resnet50_images_per_sec_per_chip",
-        "value": round(images_per_sec / n_chips, 2),
-        "unit": "images/sec/chip",
-        "vs_baseline": round(achieved_mfu / 0.50, 4),
-        "detail": {
-            "images_per_sec": round(images_per_sec, 2),
-            "step_time_ms": round(step_s * 1e3, 2),
-            "global_batch": batch,
-            "n_chips": n_chips,
-            "mfu": round(achieved_mfu, 4),
-            "device": devices[0].device_kind,
-        },
-    }
+    if args.model == "lm":
+        result = bench_lm(args, devices, n_chips, on_tpu)
+    elif args.model == "resnet":
+        result = bench_resnet(args, devices, n_chips, on_tpu)
+    else:
+        result = bench_resnet(args, devices, n_chips, on_tpu)
+        try:
+            lm = bench_lm(args, devices, n_chips, on_tpu)
+            result["detail"]["lm"] = {
+                "metric": lm["metric"], "value": lm["value"],
+                "unit": lm["unit"], "vs_baseline": lm["vs_baseline"],
+                **{k: lm["detail"][k] for k in
+                   ("step_time_ms", "mfu", "seq_len", "attention")},
+            }
+        except Exception as e:
+            print(f"lm sub-benchmark failed: {e}", file=sys.stderr)
     print(json.dumps(result))
 
 

@@ -92,6 +92,12 @@ class ResNet(nn.Module):
     num_filters: int = 64
     dtype: Any = jnp.bfloat16
     act: Callable = nn.relu
+    # Rematerialise each residual block in backward — a memory knob for
+    # HBM-limited configs (deep nets, large batch).  NOT a throughput win
+    # for ResNet-50 on v5e: the step is bandwidth-bound (cost analysis:
+    # ~77 GB / ~6 TFLOP per 256-image step) and XLA's recompute cluster
+    # re-materialises traffic (measured 78 -> 96 GB with remat on).
+    remat: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -111,10 +117,13 @@ class ResNet(nn.Module):
         x = norm(name="bn_init")(x)
         x = self.act(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        block_cls = self.block_cls
+        if self.remat:
+            block_cls = nn.remat(block_cls, static_argnums=())
         for i, block_count in enumerate(self.stage_sizes):
             for j in range(block_count):
                 strides = (2, 2) if i > 0 and j == 0 else (1, 1)
-                x = self.block_cls(
+                x = block_cls(
                     filters=self.num_filters * 2 ** i,
                     strides=strides,
                     conv=conv,
@@ -157,6 +166,7 @@ class ResNetConfig:
     name: str = "resnet50"
     num_classes: int = 1000
     dtype: Any = jnp.bfloat16
+    remat: bool = False
 
     _FACTORIES = {
         "resnet18": ResNet18,
@@ -173,7 +183,8 @@ class ResNetConfig:
             raise ValueError(
                 f"unknown resnet {self.name!r}; known: {sorted(self._FACTORIES)}"
             ) from None
-        return factory(num_classes=self.num_classes, dtype=self.dtype)
+        return factory(num_classes=self.num_classes, dtype=self.dtype,
+                       remat=self.remat)
 
     @property
     def fwd_flops_per_image(self) -> float:

@@ -54,8 +54,8 @@ class TransformerConfig:
     # TP-sharded via shard_map when a mesh is given), "ring" (context
     # parallel over the `sequence` mesh axis; requires a mesh).
     attention: str = "dot"
-    flash_block_q: int = 128
-    flash_block_k: int = 128
+    flash_block_q: int = 512
+    flash_block_k: int = 512
     # Mixture-of-Experts: 0 = dense MLP; >0 replaces every block's MLP
     # with a MoE layer of that many experts (expert-parallel over the
     # `expert` mesh axis; models/moe.py).

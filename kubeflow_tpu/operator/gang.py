@@ -77,6 +77,17 @@ class GangScheduler:
         with self._lock:
             return job in self.claims
 
+    def unsatisfiable(self, job: str) -> bool:
+        """True if the job's demand exceeds TOTAL capacity — it can never
+        be admitted no matter what finishes.  The reconciler consumes this
+        to fail the job (Failed/UnsatisfiableResources) and release it,
+        unwedging the per-queue FIFO behind it."""
+        with self._lock:
+            for e in self.queue:
+                if e["job"] == job:
+                    return bool(e.get("unsatisfiable"))
+            return False
+
     def position(self, job: str) -> Optional[int]:
         with self._lock:
             for i, e in enumerate(self.queue):

@@ -186,6 +186,20 @@ class TPUJobController:
             key, job.slice_type, job.num_slices, queue=job.queue or "default"
         )
         if not admitted:
+            if self.scheduler.unsatisfiable(key):
+                # Demand exceeds total inventory: it can NEVER run.  Fail
+                # fast with a clear message and release the queue slot so
+                # jobs behind it in the FIFO are not wedged forever.
+                self._set_phase(
+                    cr_obj, JOB_FAILED, reason="UnsatisfiableResources",
+                    message=(
+                        f"requires {job.num_slices} x {job.slice_type} but "
+                        f"cluster capacity is "
+                        f"{self.scheduler.capacity.get(job.slice_type, 0)}"
+                    ),
+                )
+                self.scheduler.release(key)
+                return JOB_FAILED
             if phase != QUEUED:
                 self._set_phase(cr_obj, QUEUED, reason="WaitingForSlices",
                                 message=f"queue position "
@@ -222,7 +236,8 @@ class TPUJobController:
 
         # 3. Observe the gang.
         pods = self.kube.list_pods(job.namespace, labels={LABEL_JOB: job.name})
-        phases = [p["status"].get("phase", PENDING) for p in pods]
+        phases = [(p.get("status") or {}).get("phase", PENDING)
+                  for p in pods]
         if any(ph == FAILED for ph in phases):
             return self._gang_restart(
                 cr_obj, job, restarts, reason="WorkerFailed",

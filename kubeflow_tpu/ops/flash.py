@@ -1,30 +1,38 @@
-"""Pallas TPU flash attention (forward) with online softmax.
+"""Pallas TPU flash attention — forward AND backward, with online softmax.
 
 Blockwise attention computed entirely in VMEM: for each query block the
-kernel streams key/value blocks through the MXU, maintaining the running
-max / normalizer / weighted-value accumulator of the online-softmax
+forward kernel streams key/value blocks through the MXU, maintaining the
+running max / normalizer / weighted-value accumulator of the online-softmax
 recurrence.  The [s, s] score matrix never exists in HBM — memory is O(s)
 — and every matmul is a [BQ, d] x [d, BK] or [BQ, BK] x [BK, d] MXU tile.
 
-Grid layout: (batch*heads, q_blocks, k_blocks) with the k dimension
+The forward additionally emits the per-row log-sum-exp (lse = m + log l),
+which is what makes a blockwise backward possible: given (o, lse) the
+attention probabilities of any block can be recomputed exactly as
+``p = exp(q k^T * scale - lse)`` without a second online pass.  Backward
+runs two Pallas kernels (dq pass with k innermost; dk/dv pass with q
+innermost, computed in transposed [BK, BQ] space so no in-kernel
+transposes are needed) — training memory is O(s), not O(s^2).  The same
+(o, lse) contract is what parallel/ring.py composes over the `sequence`
+mesh axis for context parallelism.
+
+Grid layout: (batch*heads, outer, inner) with the streamed dimension
 innermost — TPU grids execute sequentially on a core, so VMEM scratch
 accumulators legally carry across the innermost iterations.  Causal jobs
-skip fully-masked k blocks via predication (half the FLOPs back).
-
-Backward: jax.custom_vjp recomputes attention with the XLA path —
-correct everywhere, O(s^2) transient in bwd only.  A blockwise Pallas
-bwd is a planned optimisation, the fwd kernel is the serving/prefill
-hot path.
+skip fully-masked blocks via predication (half the FLOPs back).
 
 Off-TPU the public entrypoint falls back to ops/attention.py so the CPU
-fake-slice tests stay hermetic; the kernel itself is additionally tested
-under the Pallas interpreter.
+fake-slice tests stay hermetic; the kernels themselves are additionally
+tested under the Pallas interpreter (tests/test_flash.py).
+
+Heritage: the reference's attention lived inside external TF binaries
+(SURVEY.md §2.2); this module is new, TPU-first capability.
 """
 
 from __future__ import annotations
 
 import functools
-from typing import Optional
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -36,8 +44,30 @@ from kubeflow_tpu.ops.attention import dot_product_attention
 NEG_INF = float(jnp.finfo(jnp.float32).min)
 
 
-def _flash_kernel(
-    q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
+def _fit_block(block: int, s: int) -> int:
+    """Largest usable block size <= ``block`` that divides ``s``.
+
+    Prefers multiples of 128 (full lane tiles); falls back to gcd so any
+    sequence length works rather than asserting.
+    """
+    b = min(block, s)
+    if s % b == 0:
+        return b
+    for cand in range(b - b % 128, 0, -128):
+        if s % cand == 0:
+            return cand
+    import math
+
+    return math.gcd(s, b)
+
+
+# ---------------------------------------------------------------------------
+# Forward kernel
+# ---------------------------------------------------------------------------
+
+
+def _flash_fwd_kernel(
+    q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
     *, scale: float, causal: bool, block_q: int, block_k: int,
 ):
     qi = pl.program_id(1)
@@ -57,13 +87,16 @@ def _flash_kernel(
 
     @pl.when(live)
     def _compute():
-        q = q_ref[0].astype(jnp.float32)           # [BQ, d]
-        k = k_ref[0].astype(jnp.float32)           # [BK, d]
-        v = v_ref[0].astype(jnp.float32)           # [BK, d]
+        # Dots take the inputs' native (bf16) dtype — the MXU's fast path —
+        # and accumulate f32 via preferred_element_type.  Casting inputs to
+        # f32 first would run the MXU in its 4x-slower f32 mode.
+        q = q_ref[0]                                # [BQ, d]
+        k = k_ref[0]                                # [BK, d]
+        v = v_ref[0]                                # [BK, d]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        ) * scale                                   # [BQ, BK]
+        ) * scale                                   # [BQ, BK] f32
         if causal:
             q_pos = q_start + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
@@ -73,11 +106,11 @@ def _flash_kernel(
 
         m_prev = m_scr[:, :1]                       # [BQ, 1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-        p = jnp.exp(s - m_new)                      # [BQ, BK]
+        p = jnp.exp(s - m_new)                      # [BQ, BK] f32
         alpha = jnp.exp(m_prev - m_new)             # [BQ, 1]
         l_new = alpha * l_scr[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
         acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
@@ -85,38 +118,53 @@ def _flash_kernel(
 
     @pl.when(ki == nk - 1)
     def _finish():
+        m = m_scr[:, :1]
         l = l_scr[:, :1]
         # Fully-masked rows (possible only with padding) produce l == 0.
         safe = jnp.where(l == 0.0, 1.0, l)
         o_ref[0] = (acc_scr[:] / safe).astype(o_ref.dtype)
+        lse_ref[0] = jnp.where(
+            l == 0.0, NEG_INF, m + jnp.log(safe)
+        )                                           # [BQ, 1]
 
 
 def _flash_fwd_bhsd(
     q: jax.Array, k: jax.Array, v: jax.Array,
     *, causal: bool, block_q: int, block_k: int, interpret: bool,
-) -> jax.Array:
-    """q: [bh, sq, d], k/v: [bh, sk, d] -> [bh, sq, d]."""
+) -> Tuple[jax.Array, jax.Array]:
+    """q: [bh, sq, d], k/v: [bh, sk, d] -> (o [bh, sq, d], lse [bh, sq])."""
     bh, sq, d = q.shape
     sk = k.shape[1]
-    block_q = min(block_q, sq)
-    block_k = min(block_k, sk)
-    assert sq % block_q == 0 and sk % block_k == 0, (sq, sk, block_q, block_k)
+    block_q = _fit_block(block_q, sq)
+    block_k = _fit_block(block_k, sk)
     scale = d ** -0.5
     grid = (bh, sq // block_q, sk // block_k)
     kernel = functools.partial(
-        _flash_kernel, scale=scale, causal=causal,
+        _flash_fwd_kernel, scale=scale, causal=causal,
         block_q=block_q, block_k=block_k,
     )
-    return pl.pallas_call(
+    # Propagate the varying-manual-axes type so the kernel is callable
+    # inside shard_map (ring attention, make_sharded_flash).
+    vma = jax.typeof(q).vma
+    o, lse = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sq, d), q.dtype, vma=vma),
+            # lse kept as a trailing-singleton column so every kernel
+            # touches it as a native 2D [BQ, 1] tile (1D<->2D reshapes
+            # are the thing Mosaic does not guarantee).
+            jax.ShapeDtypeStruct((bh, sq, 1), jnp.float32, vma=vma),
+        ],
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, qi, ki: (b, ki, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, qi, ki: (b, ki, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, qi, ki: (b, qi, 0)),
+        ],
         scratch_shapes=[
             # m/l padded to a full 128-lane tile; column 0 is authoritative.
             pltpu.VMEM((block_q, 128), jnp.float32),
@@ -125,47 +173,311 @@ def _flash_fwd_bhsd(
         ],
         interpret=interpret,
     )(q, k, v)
+    return o, lse[:, :, 0]
+
+
+# ---------------------------------------------------------------------------
+# Backward kernels
+#
+# dq pass: grid (bh, q_blocks, k_blocks), k innermost, accumulates dq.
+# dkv pass: grid (bh, k_blocks, q_blocks), q innermost, accumulates dk/dv
+#   entirely in transposed [BK, BQ] space (kq^T instead of qk^T) so the
+#   kernel contains zero transposes.
+# ---------------------------------------------------------------------------
+
+
+def _flash_dq_kernel(
+    q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dq_ref, dq_scr,
+    *, scale: float, causal: bool, block_q: int, block_k: int,
+):
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    q_start = pl.program_id(1) * block_q
+    k_start = ki * block_k
+    live = (not causal) or (q_start + block_q - 1 >= k_start)
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0]                                # [BQ, d] bf16
+        k = k_ref[0]                                # [BK, d]
+        v = v_ref[0]                                # [BK, d]
+        g = g_ref[0]                                # [BQ, d]
+        lse = lse_ref[0]                            # [BQ, 1] f32
+        delta = delta_ref[0]                        # [BQ, 1] f32
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale                                   # [BQ, BK] f32
+        if causal:
+            q_pos = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = k_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        finite = lse > NEG_INF / 2                  # [BQ, 1]
+        p = jnp.where(
+            finite, jnp.exp(s - jnp.where(finite, lse, 0.0)), 0.0
+        )                                           # [BQ, BK] f32
+        dp = jax.lax.dot_general(
+            g, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                           # [BQ, BK] f32
+        ds = (p * (dp - delta) * scale).astype(k.dtype)  # [BQ, BK]
+        dq_scr[:] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _flash_dkv_kernel(
+    q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    dk_scr, dv_scr,
+    *, scale: float, causal: bool, block_q: int, block_k: int,
+):
+    qi = pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    k_start = pl.program_id(1) * block_k
+    q_start = qi * block_q
+    live = (not causal) or (q_start + block_q - 1 >= k_start)
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0]                                # [BQ, d] bf16
+        k = k_ref[0]                                # [BK, d]
+        v = v_ref[0]                                # [BK, d]
+        g = g_ref[0]                                # [BQ, d]
+        lse_row = lse_ref[0]                        # [1, BQ] f32
+        delta_row = delta_ref[0]                    # [1, BQ] f32
+        s_t = jax.lax.dot_general(
+            k, q, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale                                   # [BK, BQ] f32
+        if causal:
+            k_pos = k_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_k, block_q), 0)
+            q_pos = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_k, block_q), 1)
+            s_t = jnp.where(q_pos >= k_pos, s_t, NEG_INF)
+        finite = lse_row > NEG_INF / 2              # [1, BQ]
+        p_t = jnp.where(
+            finite, jnp.exp(s_t - jnp.where(finite, lse_row, 0.0)), 0.0
+        )                                           # [BK, BQ] f32
+        dv_scr[:] += jax.lax.dot_general(
+            p_t.astype(g.dtype), g, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                           # [BK, d]
+        dp_t = jax.lax.dot_general(
+            v, g, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                           # [BK, BQ] f32
+        ds_t = (p_t * (dp_t - delta_row) * scale).astype(q.dtype)
+        dk_scr[:] += jax.lax.dot_general(
+            ds_t, q, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                           # [BK, d]
+
+    @pl.when(qi == nq - 1)
+    def _finish():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd_bhsd(
+    q: jax.Array, k: jax.Array, v: jax.Array,
+    g: jax.Array, lse: jax.Array, delta: jax.Array,
+    *, causal: bool, block_q: int, block_k: int, interpret: bool,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Blockwise backward.  q/g: [bh, sq, d]; k/v: [bh, sk, d];
+    lse/delta: [bh, sq] -> (dq, dk, dv)."""
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    block_q = _fit_block(block_q, sq)
+    block_k = _fit_block(block_k, sk)
+    scale = d ** -0.5
+    lse_col = lse[:, :, None]                       # [bh, sq, 1]
+    delta_col = delta[:, :, None]
+    lse_row = lse[:, None, :]                       # [bh, 1, sq]
+    delta_row = delta[:, None, :]
+
+    vma = jax.typeof(q).vma
+    dq = pl.pallas_call(
+        functools.partial(
+            _flash_dq_kernel, scale=scale, causal=causal,
+            block_q=block_q, block_k=block_k,
+        ),
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype, vma=vma),
+        grid=(bh, sq // block_q, sk // block_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, qi, ki: (b, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, qi, ki: (b, ki, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, qi, ki: (b, qi, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, qi, ki: (b, qi, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, g, lse_col, delta_col)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _flash_dkv_kernel, scale=scale, causal=causal,
+            block_q=block_q, block_k=block_k,
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sk, d), k.dtype, vma=vma),
+            jax.ShapeDtypeStruct((bh, sk, d), v.dtype, vma=vma),
+        ],
+        grid=(bh, sk // block_k, sq // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, ki, qi: (b, qi, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, ki, qi: (b, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, ki, qi: (b, ki, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, ki, qi: (b, qi, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda b, ki, qi: (b, 0, qi)),
+            pl.BlockSpec((1, 1, block_q), lambda b, ki, qi: (b, 0, qi)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda b, ki, qi: (b, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, ki, qi: (b, ki, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, g, lse_row, delta_row)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# Differentiable entrypoint ([bh, s, d] layout)
+# ---------------------------------------------------------------------------
 
 
 @functools.partial(
     jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6)
 )
 def _flash(q, k, v, causal, block_q, block_k, interpret):
-    return _flash_fwd_bhsd(
+    o, _ = _flash_fwd_bhsd(
         q, k, v, causal=causal, block_q=block_q, block_k=block_k,
+        interpret=interpret,
+    )
+    return o
+
+
+def _flash_vjp_fwd(q, k, v, causal, block_q, block_k, interpret):
+    o, lse = _flash_fwd_bhsd(
+        q, k, v, causal=causal, block_q=block_q, block_k=block_k,
+        interpret=interpret,
+    )
+    return o, (q, k, v, o, lse)
+
+
+def _flash_vjp_bwd(causal, block_q, block_k, interpret, res, g):
+    q, k, v, o, lse = res
+    delta = jnp.sum(
+        g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1
+    )                                               # [bh, sq]
+    return _flash_bwd_bhsd(
+        q, k, v, g, lse, delta,
+        causal=causal, block_q=block_q, block_k=block_k,
         interpret=interpret,
     )
 
 
-def _flash_vjp_fwd(q, k, v, causal, block_q, block_k, interpret):
-    out = _flash(q, k, v, causal, block_q, block_k, interpret)
-    return out, (q, k, v)
-
-
-def _flash_vjp_bwd(causal, block_q, block_k, interpret, res, g):
-    q, k, v = res
-
-    def ref(q, k, v):
-        # [bh, s, d] -> [bh, s, 1, d] for the bshd reference path.
-        o = dot_product_attention(
-            q[:, :, None, :], k[:, :, None, :], v[:, :, None, :],
-            causal=causal,
-        )
-        return o[:, :, 0, :]
-
-    _, vjp = jax.vjp(ref, q, k, v)
-    return vjp(g)
-
-
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+# ---------------------------------------------------------------------------
+# Public [b, s, h, d] API + building blocks for ring attention
+# ---------------------------------------------------------------------------
+
+
+def _to_bhsd(x: jax.Array) -> jax.Array:
+    b, s, h, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+
+
+def _from_bhsd(x: jax.Array, b: int, h: int) -> jax.Array:
+    bh, s, d = x.shape
+    return x.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+
+
+def repeat_kv(k: jax.Array, v: jax.Array, h: int):
+    """Broadcast kv heads up to the query head count (GQA). Shared by the
+    plain flash path and ring attention's per-hop kernel calls."""
+    hkv = k.shape[2]
+    if hkv != h:
+        k = jnp.repeat(k, h // hkv, axis=2)
+        v = jnp.repeat(v, h // hkv, axis=2)
+    return k, v
+
+
+def flash_fwd_with_lse(
+    q: jax.Array, k: jax.Array, v: jax.Array,
+    *, causal: bool, block_q: int = 512, block_k: int = 512,
+    interpret: bool = False,
+) -> Tuple[jax.Array, jax.Array]:
+    """Non-differentiable forward returning (o [b,s,h,d], lse [b,h,s]).
+
+    The (o, lse) pair is the composable unit of blockwise attention: ring
+    attention merges per-hop pairs in log-space (parallel/ring.py) and the
+    backward recomputes probabilities from lse.
+    """
+    b, sq, h, d = q.shape
+    k, v = repeat_kv(k, v, h)
+    o, lse = _flash_fwd_bhsd(
+        _to_bhsd(q), _to_bhsd(k), _to_bhsd(v),
+        causal=causal, block_q=block_q, block_k=block_k, interpret=interpret,
+    )
+    return _from_bhsd(o, b, h), lse.reshape(b, h, sq)
+
+
+def flash_bwd_block(
+    q: jax.Array, k: jax.Array, v: jax.Array, g: jax.Array,
+    lse: jax.Array, delta: jax.Array,
+    *, causal: bool, block_q: int = 512, block_k: int = 512,
+    interpret: bool = False,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Blockwise backward in [b,s,h,d] layout; lse/delta are [b,h,s].
+
+    GQA note: callers pass kv already repeated to q's head count and fold
+    the head-group sum themselves (ring does; see parallel/ring.py).
+    """
+    b, sq, h, d = q.shape
+    dq, dk, dv = _flash_bwd_bhsd(
+        _to_bhsd(q), _to_bhsd(k), _to_bhsd(v), _to_bhsd(g),
+        lse.reshape(b * h, sq), delta.reshape(b * h, sq),
+        causal=causal, block_q=block_q, block_k=block_k, interpret=interpret,
+    )
+    return (
+        _from_bhsd(dq, b, h), _from_bhsd(dk, b, h), _from_bhsd(dv, b, h)
+    )
 
 
 def make_sharded_flash(
     mesh,
     *,
     causal: bool = True,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int = 512,
+    block_k: int = 512,
 ):
     """shard_map wrapper: flash per shard, batch over (data, fsdp), heads
     over tensor, sequence resident (use ring attention for sequence
@@ -195,14 +507,16 @@ def flash_attention(
     *,
     causal: bool = True,
     segment_ids: Optional[jax.Array] = None,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int = 512,
+    block_k: int = 512,
     interpret: bool = False,
 ) -> jax.Array:
     """Flash attention with the ops/attention.py [b, s, h, d] signature.
 
-    GQA is handled by repeating kv heads before the kernel (the repeat is
-    fused by XLA into the gather feeding the kernel).  Segment masking is
+    Differentiable end-to-end through the Pallas forward AND backward
+    kernels — long-context training memory is O(s).  GQA is handled by
+    repeating kv heads before the kernel (the cotangent sum over the head
+    group is what jnp.repeat's autodiff gives back).  Segment masking is
     not yet in the kernel: segmented calls fall back to the XLA path.
     """
     on_tpu = jax.default_backend() == "tpu"
@@ -211,13 +525,9 @@ def flash_attention(
             q, k, v, causal=causal, segment_ids=segment_ids
         )
     b, sq, h, d = q.shape
-    hkv = k.shape[2]
-    if hkv != h:
-        k = jnp.repeat(k, h // hkv, axis=2)
-        v = jnp.repeat(v, h // hkv, axis=2)
-    sk = k.shape[1]
-    qt = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
-    kt = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
-    vt = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
-    out = _flash(qt, kt, vt, causal, block_q, block_k, interpret)
-    return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+    k, v = repeat_kv(k, v, h)
+    out = _flash(
+        _to_bhsd(q), _to_bhsd(k), _to_bhsd(v),
+        causal, block_q, block_k, interpret,
+    )
+    return _from_bhsd(out, b, h)

@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import importlib
 import json
+import os
 import re
 from pathlib import Path
 from typing import Any, Callable, Dict, List, Optional, Tuple
@@ -31,6 +32,26 @@ from flax import serialization
 MODEL_FILE = "model.json"
 PARAMS_FILE = "params.msgpack"
 _VERSION_RE = re.compile(r"^\d+$")
+
+# Loader resolution is allowlisted: model.json lives in the (possibly
+# remote, writable-by-producers) model base path, so letting it name an
+# arbitrary importable would hand code execution in the serving process
+# to anyone who can write a model directory.  Only modules registered
+# here — the framework's own loaders by default, plus explicit opt-ins
+# via allow_loader_module() or the KFT_SERVING_LOADER_MODULES env var
+# (comma-separated) — may be imported.
+_ALLOWED_LOADER_MODULES = {"kubeflow_tpu.serving.loaders"}
+_LOADER_REGISTRY: Dict[str, Callable] = {}
+
+
+def register_loader(name: str, fn: Callable) -> None:
+    """Register a loader callable under a plain name (no import at all)."""
+    _LOADER_REGISTRY[name] = fn
+
+
+def allow_loader_module(module: str) -> None:
+    """Opt a module into 'module:function' loader resolution."""
+    _ALLOWED_LOADER_MODULES.add(module)
 
 
 def export(
@@ -74,10 +95,27 @@ def list_versions(base_path: str | Path) -> List[int]:
 
 
 def resolve_loader(path: str) -> Callable:
-    """'pkg.mod:fn' -> callable."""
+    """Registered name or allowlisted 'pkg.mod:fn' -> callable.
+
+    model.json is producer-controlled data; resolution refuses modules
+    outside the allowlist so a writable model path is not an arbitrary
+    code-execution vector into the serving process.
+    """
+    if path in _LOADER_REGISTRY:
+        return _LOADER_REGISTRY[path]
     mod_name, _, fn_name = path.partition(":")
     if not fn_name:
         raise ValueError(f"loader {path!r} must be 'module:function'")
+    allowed = _ALLOWED_LOADER_MODULES | {
+        m.strip() for m in os.environ.get(
+            "KFT_SERVING_LOADER_MODULES", "").split(",") if m.strip()
+    }
+    if mod_name not in allowed:
+        raise PermissionError(
+            f"loader module {mod_name!r} is not allowlisted; register it "
+            f"via register_loader()/allow_loader_module() or the "
+            f"KFT_SERVING_LOADER_MODULES env var (allowed: {sorted(allowed)})"
+        )
     return getattr(importlib.import_module(mod_name), fn_name)
 
 

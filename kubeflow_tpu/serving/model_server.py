@@ -148,9 +148,12 @@ class MicroBatcher:
         allowed_batch_sizes: Optional[List[int]] = None,
     ):
         self._predict = predict
-        self.max_batch_size = max_batch_size
-        self.batch_timeout_s = batch_timeout_s
         self.allowed = sorted(allowed_batch_sizes or [1, 2, 4, 8])
+        # A batch larger than the padding table would go to the device
+        # unpadded and trigger a fresh XLA compile — the exact thing this
+        # class exists to prevent — so the effective cap is the table max.
+        self.max_batch_size = min(max_batch_size, self.allowed[-1])
+        self.batch_timeout_s = batch_timeout_s
         self._lock = threading.Lock()
         self._pending: List[dict] = []
         self._flusher = threading.Condition(self._lock)

@@ -6,14 +6,18 @@ tf-cnn-benchmarks.jsonnet:40-62) and launcher
 Where the reference translated TF_CONFIG into --ps_hosts/--worker_hosts
 PS-mode flags, this entrypoint reads the KFT_* env (runtime/bootstrap.py),
 joins the gang via jax.distributed, and runs the SPMD data-parallel
-trainer.  Synthetic data by default (as tf_cnn_benchmarks offered), real
-input via the data/ pipeline.
+trainer.  Synthetic data by default (as tf_cnn_benchmarks offered); real
+input via --data-dir of KFTR shards through the data/ pipeline's C++
+prefetch core, sharded per process (each host feeds only its own rows —
+the multi-host contract of Trainer.shard_batch).
 """
 
 from __future__ import annotations
 
 import argparse
+import glob
 import logging
+import os
 import sys
 
 
@@ -24,8 +28,13 @@ def main(argv=None) -> int:
     ap.add_argument("--steps", type=int, default=100)
     ap.add_argument("--image-size", type=int, default=224)
     ap.add_argument("--num-classes", type=int, default=1000)
-    ap.add_argument("--dtype", default="bfloat16")
-    ap.add_argument("--synthetic-data", action="store_true")
+    ap.add_argument("--dtype", default="bfloat16",
+                    choices=["bfloat16", "float32"])
+    ap.add_argument("--data-dir", default="",
+                    help="directory of KFTR shards with image/label "
+                         "examples; synthetic data when unset")
+    ap.add_argument("--shuffle-buffer", type=int, default=4096)
+    ap.add_argument("--data-threads", type=int, default=4)
     ap.add_argument("--checkpoint-dir", default="")
     ap.add_argument("--checkpoint-every", type=int, default=100)
     ap.add_argument("--learning-rate", type=float, default=0.1)
@@ -38,6 +47,7 @@ def main(argv=None) -> int:
     env = bootstrap.initialize()
 
     import jax
+    import jax.numpy as jnp
     import numpy as np
     import optax
 
@@ -50,9 +60,14 @@ def main(argv=None) -> int:
     from kubeflow_tpu.runtime.topology import parse_slice_type
 
     n = jax.device_count()
-    batch = args.batch_size_per_device * n
+    global_batch = args.batch_size_per_device * n
+    # Each process feeds only its own shard of the global batch
+    # (Trainer.shard_batch assembles the global array across hosts).
+    host_batch = args.batch_size_per_device * jax.local_device_count()
     size = args.image_size
-    cfg = ResNetConfig(name=args.model, num_classes=args.num_classes)
+    dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
+    cfg = ResNetConfig(name=args.model, num_classes=args.num_classes,
+                       dtype=dtype)
     init_fn, loss_fn = classification_task(
         cfg.build(), (1, size, size, 3))
     mesh = MeshSpec(data=n).build()
@@ -71,17 +86,37 @@ def main(argv=None) -> int:
         peak_flops_per_chip=peak,
     )
 
-    rng = np.random.RandomState(env.process_id)
+    if args.data_dir:
+        from kubeflow_tpu.data.loader import RecordDataset, tensor_batches
 
-    def synthetic():
-        while True:
-            yield {
-                "image": rng.randn(batch, size, size, 3).astype(np.float32),
-                "label": rng.randint(0, args.num_classes, size=(batch,)),
-            }
+        files = sorted(glob.glob(os.path.join(args.data_dir, "*.kftr")))
+        if not files:
+            logging.error("no *.kftr shards under %s", args.data_dir)
+            return 1
+        ds = RecordDataset(
+            files, num_threads=args.data_threads,
+            shuffle_buffer=args.shuffle_buffer, seed=env.process_id,
+            repeat=-1,  # cycle forever; steps bound the run
+        )
+        if env.num_processes > 1:
+            ds = ds.shard(env.process_id, env.num_processes)
+        data = tensor_batches(ds, host_batch)
+    else:
+        rng = np.random.RandomState(env.process_id)
 
-    trainer.fit(synthetic(), num_steps=args.steps,
-                examples_per_step=batch, log_every=args.log_every)
+        def synthetic():
+            while True:
+                yield {
+                    "image": rng.randn(host_batch, size, size, 3).astype(
+                        np.float32),
+                    "label": rng.randint(0, args.num_classes,
+                                         size=(host_batch,)),
+                }
+
+        data = synthetic()
+
+    trainer.fit(data, num_steps=args.steps,
+                examples_per_step=global_batch, log_every=args.log_every)
     logging.info("training done: %s", trainer._last_metrics)
     return 0
 

@@ -1,0 +1,58 @@
+"""XProf trace summarizer: per-op device time from a jax.profiler trace.
+
+Companion to runtime/profiling.py: after capturing a trace with
+``jax.profiler.trace(dir)``, point this tool at the ``*.xplane.pb`` file to
+get a sorted table of where device time went — the analysis loop the
+reference delegated entirely to the TensorBoard UI (SURVEY.md §5
+"Tracing/profiling").  Parsing uses the XPlane proto bundled with the
+installed tensorflow; import stays lazy so the framework itself never
+depends on tf.
+
+Usage: python -m kubeflow_tpu.tools.xplane_summary <trace.xplane.pb> [top_n]
+"""
+
+from __future__ import annotations
+
+import collections
+import sys
+
+
+def summarize_xplane(path: str, top_n: int = 25) -> None:
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2  # lazy: dev tool
+
+    xs = xplane_pb2.XSpace()
+    with open(path, "rb") as f:
+        xs.ParseFromString(f.read())
+    for p in xs.planes:
+        ne = sum(len(line.events) for line in p.lines)
+        print(f"plane: {p.name} lines={len(p.lines)} events={ne}",
+              file=sys.stderr)
+    for p in xs.planes:
+        if "TPU" not in p.name and "device" not in p.name.lower():
+            continue
+        stats: collections.Counter = collections.Counter()
+        total = 0.0
+        for line in p.lines:
+            for ev in line.events:
+                name = p.event_metadata[ev.metadata_id].name
+                dur = ev.duration_ps / 1e9  # ms
+                stats[name] += dur
+                total += dur
+        if not stats:
+            continue
+        print(f"== {p.name}: total {total:.1f} ms")
+        for name, ms in stats.most_common(top_n):
+            print(f"  {ms:8.2f} ms  {name[:110]}")
+
+
+def main(argv=None) -> int:
+    argv = argv if argv is not None else sys.argv[1:]
+    if not argv:
+        print(__doc__, file=sys.stderr)
+        return 2
+    summarize_xplane(argv[0], int(argv[1]) if len(argv) > 1 else 25)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

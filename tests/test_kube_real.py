@@ -1,0 +1,304 @@
+"""RealKube adapter + operator daemon entrypoint, against a stubbed
+``kubernetes`` client.
+
+The reference could only prove its operator deployment on rented clusters
+(/root/reference/testing/test_deploy.py:160-190 deploy-then-verify); here
+the production adapter's 1:1 method mapping — create/list/delete, label
+selectors, CRD group/version routing, 404/409 translation — is verified
+hermetically by injecting a fake ``kubernetes`` module.
+"""
+
+from __future__ import annotations
+
+import sys
+import types
+from typing import Any, Dict, List, Optional
+
+import pytest
+
+from kubeflow_tpu.operator import crd
+from kubeflow_tpu.operator.kube import Conflict, NotFound
+
+
+class ApiException(Exception):
+    def __init__(self, status: int, reason: str = ""):
+        super().__init__(f"{status}: {reason}")
+        self.status = status
+
+
+class _Obj:
+    """Mimics the kubernetes client's model objects (sanitizable)."""
+
+    def __init__(self, data):
+        self.data = data
+
+
+class FakeCoreV1Api:
+    """Records calls; raises ApiException(404/409) on demand."""
+
+    def __init__(self, state):
+        self.state = state
+        self.api_client = types.SimpleNamespace(
+            sanitize_for_serialization=lambda o: o.data
+            if isinstance(o, _Obj) else o
+        )
+
+    # pods
+    def create_namespaced_pod(self, namespace, pod):
+        key = (namespace, pod["metadata"]["name"])
+        if key in self.state["pods"]:
+            raise ApiException(409, "exists")
+        pod = dict(pod)
+        pod.setdefault("status", {"phase": "Pending"})  # apiserver adds this
+        self.state["pods"][key] = pod
+        return pod
+
+    def read_namespaced_pod(self, name, namespace):
+        try:
+            return _Obj(self.state["pods"][(namespace, name)])
+        except KeyError:
+            raise ApiException(404, "nope") from None
+
+    def list_namespaced_pod(self, namespace, label_selector=None):
+        items = []
+        want = dict(
+            pair.split("=", 1) for pair in (label_selector or "").split(",")
+            if pair
+        )
+        for (ns, _), pod in self.state["pods"].items():
+            if ns != namespace:
+                continue
+            labels = pod["metadata"].get("labels", {})
+            if all(labels.get(k) == v for k, v in want.items()):
+                items.append(_Obj(pod))
+        self.state["last_selector"] = label_selector
+        return types.SimpleNamespace(items=items)
+
+    def delete_namespaced_pod(self, name, namespace):
+        try:
+            del self.state["pods"][(namespace, name)]
+        except KeyError:
+            raise ApiException(404, "nope") from None
+
+    # services
+    def create_namespaced_service(self, namespace, svc):
+        key = (namespace, svc["metadata"]["name"])
+        if key in self.state["services"]:
+            raise ApiException(409, "exists")
+        self.state["services"][key] = svc
+        return svc
+
+    def delete_namespaced_service(self, name, namespace):
+        try:
+            del self.state["services"][(namespace, name)]
+        except KeyError:
+            raise ApiException(404, "nope") from None
+
+    # events
+    def create_namespaced_event(self, namespace, event):
+        self.state["events"].append((namespace, event))
+        return event
+
+
+class FakeCustomObjectsApi:
+    def __init__(self, state):
+        self.state = state
+
+    def _check(self, group, version, plural):
+        assert group == crd.GROUP and version == crd.VERSION
+        assert plural == crd.PLURAL
+
+    def list_namespaced_custom_object(self, group, version, namespace,
+                                      plural):
+        self._check(group, version, plural)
+        return {"items": [o for (ns, _), o in self.state["custom"].items()
+                          if ns == namespace]}
+
+    def list_cluster_custom_object(self, group, version, plural):
+        self._check(group, version, plural)
+        return {"items": list(self.state["custom"].values())}
+
+    def get_namespaced_custom_object(self, group, version, namespace,
+                                     plural, name):
+        self._check(group, version, plural)
+        try:
+            return self.state["custom"][(namespace, name)]
+        except KeyError:
+            raise ApiException(404, "nope") from None
+
+    def patch_namespaced_custom_object_status(self, group, version,
+                                              namespace, plural, name, body):
+        self._check(group, version, plural)
+        try:
+            self.state["custom"][(namespace, name)]["status"] = body["status"]
+        except KeyError:
+            raise ApiException(404, "nope") from None
+
+    def delete_namespaced_custom_object(self, group, version, namespace,
+                                        plural, name):
+        self._check(group, version, plural)
+        try:
+            del self.state["custom"][(namespace, name)]
+        except KeyError:
+            raise ApiException(404, "nope") from None
+
+
+@pytest.fixture()
+def fake_kubernetes(monkeypatch):
+    """Inject a minimal ``kubernetes`` module into sys.modules."""
+    state: Dict[str, Any] = {"pods": {}, "services": {}, "custom": {},
+                             "events": [], "incluster": False}
+
+    mod = types.ModuleType("kubernetes")
+    config = types.SimpleNamespace()
+
+    def load_incluster_config():
+        if not state["incluster"]:
+            raise RuntimeError("not in cluster")
+
+    def load_kube_config(config_file=None):
+        state["kubeconfig"] = config_file
+
+    config.load_incluster_config = load_incluster_config
+    config.load_kube_config = load_kube_config
+
+    client = types.SimpleNamespace(
+        CoreV1Api=lambda: FakeCoreV1Api(state),
+        CustomObjectsApi=lambda: FakeCustomObjectsApi(state),
+        rest=types.SimpleNamespace(ApiException=ApiException),
+    )
+    mod.config = config
+    mod.client = client
+    monkeypatch.setitem(sys.modules, "kubernetes", mod)
+    return state
+
+
+@pytest.fixture()
+def real_kube(fake_kubernetes):
+    from kubeflow_tpu.operator.kube_real import RealKube
+
+    return RealKube(kubeconfig="/tmp/kc"), fake_kubernetes
+
+
+def make_pod(name="p0", ns="kubeflow", labels=None):
+    return {"apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": name, "namespace": ns,
+                         "labels": labels or {}},
+            "spec": {}, "status": {"phase": "Pending"}}
+
+
+class TestRealKubePods:
+    def test_create_get_delete(self, real_kube):
+        rk, state = real_kube
+        rk.create_pod(make_pod())
+        assert ("kubeflow", "p0") in state["pods"]
+        got = rk.get_pod("kubeflow", "p0")
+        assert got["metadata"]["name"] == "p0"
+        rk.delete_pod("kubeflow", "p0")
+        assert ("kubeflow", "p0") not in state["pods"]
+
+    def test_conflict_and_notfound_translation(self, real_kube):
+        rk, _ = real_kube
+        rk.create_pod(make_pod())
+        with pytest.raises(Conflict):
+            rk.create_pod(make_pod())
+        with pytest.raises(NotFound):
+            rk.get_pod("kubeflow", "missing")
+        with pytest.raises(NotFound):
+            rk.delete_pod("kubeflow", "missing")
+
+    def test_list_pods_label_selector(self, real_kube):
+        rk, state = real_kube
+        rk.create_pod(make_pod("a", labels={"job": "x", "idx": "0"}))
+        rk.create_pod(make_pod("b", labels={"job": "y"}))
+        out = rk.list_pods("kubeflow", labels={"job": "x"})
+        assert [p["metadata"]["name"] for p in out] == ["a"]
+        assert "job=x" in state["last_selector"]
+        # No labels -> no selector sent.
+        rk.list_pods("kubeflow")
+        assert state["last_selector"] is None
+
+
+class TestRealKubeServicesAndCustom:
+    def test_service_roundtrip(self, real_kube):
+        rk, state = real_kube
+        svc = {"metadata": {"name": "s", "namespace": "kubeflow"}}
+        rk.create_service(svc)
+        assert ("kubeflow", "s") in state["services"]
+        with pytest.raises(Conflict):
+            rk.create_service(svc)
+        rk.delete_service("kubeflow", "s")
+        with pytest.raises(NotFound):
+            rk.delete_service("kubeflow", "s")
+
+    def test_custom_crud_and_status(self, real_kube):
+        rk, state = real_kube
+        cr = crd.TPUJobSpec(name="train").to_custom_resource()
+        ns = cr["metadata"]["namespace"]
+        state["custom"][(ns, "train")] = cr
+        assert rk.get_custom(ns, "train")["metadata"]["name"] == "train"
+        assert len(rk.list_custom()) == 1
+        assert len(rk.list_custom(namespace=ns)) == 1
+        assert rk.list_custom(namespace="elsewhere") == []
+        rk.update_custom_status(ns, "train", {"phase": "Running"})
+        assert state["custom"][(ns, "train")]["status"]["phase"] == "Running"
+        rk.delete_custom(ns, "train")
+        assert not state["custom"]
+        with pytest.raises(NotFound):
+            rk.get_custom(ns, "train")
+
+    def test_events_best_effort(self, real_kube):
+        rk, state = real_kube
+        rk.record_event("kubeflow", "TPUJob/train", "Started", "gang up")
+        assert state["events"]
+        ns, ev = state["events"][0]
+        assert ev["involvedObject"]["kind"] == "TPUJob"
+        assert ev["reason"] == "Started"
+
+    def test_incluster_config_preferred(self, fake_kubernetes):
+        from kubeflow_tpu.operator.kube_real import RealKube
+
+        fake_kubernetes["incluster"] = True
+        fake_kubernetes["kubeconfig"] = "UNTOUCHED"
+        RealKube()
+        assert fake_kubernetes["kubeconfig"] == "UNTOUCHED"
+
+
+class TestOperatorMain:
+    def test_parse_inventory(self):
+        from kubeflow_tpu.operator.main import parse_inventory
+
+        assert parse_inventory(["v5e-8=4", "v5p-32=2"]) == {
+            "v5e-8": 4, "v5p-32": 2}
+        assert parse_inventory(["v5e-8"]) == {"v5e-8": 1}
+
+    def test_fake_kube_loop_runs(self):
+        from kubeflow_tpu.operator.main import main
+
+        rc = main(["--fake-kube", "--max-iterations", "2",
+                   "--poll-interval-s", "0", "--inventory", "v5e-8=1"])
+        assert rc == 0
+
+    def test_real_kube_drives_reconciler(self, fake_kubernetes, monkeypatch):
+        """operator/main.py end-to-end against the stubbed client: a CR in
+        the fake API server reaches Starting with pods created."""
+        from kubeflow_tpu.operator.main import main
+
+        cr = crd.TPUJobSpec(name="train", slice_type="v5e-8").to_custom_resource()
+        ns = cr["metadata"]["namespace"]
+        fake_kubernetes["custom"][(ns, "train")] = cr
+        fake_kubernetes["incluster"] = True
+        rc = main(["--max-iterations", "2", "--poll-interval-s", "0",
+                   "--inventory", "v5e-8=2"])
+        assert rc == 0
+        assert cr["status"]["phase"] == "Starting"
+        names = sorted(n for (_, n) in fake_kubernetes["pods"])
+        assert names and all(n.startswith("train-worker-") for n in names)
+        assert (ns, "train") in fake_kubernetes["services"]
+
+    def test_no_cluster_access_errors(self, monkeypatch):
+        from kubeflow_tpu.operator.main import main
+
+        monkeypatch.setitem(sys.modules, "kubernetes", None)
+        rc = main(["--max-iterations", "1"])
+        assert rc == 1

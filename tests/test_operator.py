@@ -203,3 +203,29 @@ class TestMultiSlice:
                ["spec"]["containers"][0]["env"]}
         assert env["MEGASCALE_NUM_SLICES"] == "2"
         assert env["MEGASCALE_SLICE_ID"] == "1"
+
+
+class TestUnsatisfiableJobs:
+    def test_unsatisfiable_job_fails_fast(self, cluster):
+        """Demand beyond total inventory -> Failed/UnsatisfiableResources,
+        not Queued forever (the reference had no admission check at all)."""
+        kube, sched, ctl = cluster
+        kube.create_custom(make_cr(name="huge", num_slices=5))  # cap is 2
+        cr = kube.list_custom()[0]
+        assert ctl.reconcile_once(cr) == JOB_FAILED
+        assert cr["status"]["reason"] == "UnsatisfiableResources"
+        assert "capacity" in cr["status"]["message"]
+        # Released from the queue: nothing left pending.
+        assert sched.position("kubeflow/huge") is None
+
+    def test_unsatisfiable_head_does_not_wedge_queue(self, cluster):
+        """A failed unsatisfiable head unblocks later jobs in FIFO order."""
+        kube, sched, ctl = cluster
+        kube.create_custom(make_cr(name="huge", num_slices=5))
+        kube.create_custom(make_cr(name="ok", num_slices=1))
+        ctl.reconcile_all()
+        crs = {c["metadata"]["name"]: c for c in kube.list_custom()}
+        assert crs["huge"]["status"]["phase"] == JOB_FAILED
+        # Second pass: with the head gone, "ok" is admitted and starts.
+        ctl.reconcile_all()
+        assert crs["ok"]["status"]["phase"] == STARTING

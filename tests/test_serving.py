@@ -258,3 +258,64 @@ class TestGRPC:
             client.close()
         finally:
             server.stop(0)
+
+
+class TestLoaderAllowlist:
+    """model.json is producer-controlled: loader resolution must not
+    import arbitrary modules (ADVICE r1: code-exec via writable model
+    path)."""
+
+    def test_unlisted_module_rejected(self):
+        from kubeflow_tpu.serving.export import resolve_loader
+
+        with pytest.raises(PermissionError):
+            resolve_loader("os:system")
+
+    def test_builtin_loaders_allowed(self):
+        from kubeflow_tpu.serving.export import resolve_loader
+
+        fn = resolve_loader("kubeflow_tpu.serving.loaders:classifier")
+        assert callable(fn)
+
+    def test_registered_name_wins(self):
+        from kubeflow_tpu.serving.export import (
+            register_loader,
+            resolve_loader,
+        )
+
+        sentinel = lambda cfg: None
+        register_loader("my-loader", sentinel)
+        assert resolve_loader("my-loader") is sentinel
+
+    def test_opt_in_module(self, monkeypatch):
+        from kubeflow_tpu.serving.export import resolve_loader
+
+        monkeypatch.setenv("KFT_SERVING_LOADER_MODULES", "json")
+        assert callable(resolve_loader("json:loads"))
+
+
+class TestBatcherPadTable:
+    def test_max_batch_clamped_to_pad_table(self):
+        """max_batch_size beyond the padding table would produce unpadded
+        batches and fresh compiles; the cap is the table max."""
+        calls = []
+
+        def predict(inputs):
+            calls.append(inputs["x"].shape[0])
+            return {"y": inputs["x"]}
+
+        b = MicroBatcher(predict, max_batch_size=8,
+                         allowed_batch_sizes=[1, 2, 4],
+                         batch_timeout_s=0.01)
+        try:
+            assert b.max_batch_size == 4
+            import concurrent.futures as cf
+
+            with cf.ThreadPoolExecutor(8) as ex:
+                outs = list(ex.map(
+                    lambda i: b.submit({"x": np.full((1, 2), i)}), range(8)
+                ))
+            assert len(outs) == 8
+            assert all(c in (1, 2, 4) for c in calls)  # never unpadded 8
+        finally:
+            b.close()
