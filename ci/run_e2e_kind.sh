@@ -1,0 +1,59 @@
+#!/usr/bin/env bash
+# Real-cluster E2E on an ephemeral local cluster — heir of the
+# reference's deploy_minikube path (testing/test_deploy.py:348-450),
+# which rented a GCE VM per run to get a disposable cluster.  kind gives
+# the same disposability without the VM.
+#
+# Default (control-plane) mode: apply only the CRDs, run the operator as
+# a host process against the cluster — exactly ONE reconciler owns the
+# CRs, and no platform images need to exist inside kind — then submit a
+# TPUJob CR and poll it to a terminal phase.
+#
+# KFT_E2E_FULL=1 additionally builds the platform images, `kind load`s
+# them, and deploys the whole kubeflow-core manifest with rollout
+# verification (the reference's full deploy-then-verify,
+# test_deploy.py:160-190); in that mode the in-cluster operator is the
+# reconciler and no host operator is started.
+#
+# Requires: kind, kubectl (+ docker for KFT_E2E_FULL).  JUnit artifacts
+# land in ${ARTIFACTS_DIR:-/tmp/artifacts} (TestGrid contract,
+# testing/test_deploy.py:271-276).
+set -euo pipefail
+
+CLUSTER="${KFT_KIND_CLUSTER:-kft-e2e-$$}"
+NAMESPACE="${KFT_E2E_NAMESPACE:-kubeflow-test}"
+ARTIFACTS_DIR="${ARTIFACTS_DIR:-/tmp/artifacts}"
+REGISTRY="${KFT_REGISTRY:-ghcr.io/kubeflow-tpu}"
+OPERATOR_PID=""
+
+cleanup() {
+  [ -n "$OPERATOR_PID" ] && kill "$OPERATOR_PID" 2>/dev/null || true
+  kind delete cluster --name "$CLUSTER" || true
+}
+trap cleanup EXIT
+
+kind create cluster --name "$CLUSTER" --wait 300s
+
+if [ "${KFT_E2E_FULL:-0}" = "1" ]; then
+  python -m kubeflow_tpu.tools.build_images --build --registry "$REGISTRY"
+  for image in worker model-server notebook operator; do
+    kind load docker-image --name "$CLUSTER" \
+      "$REGISTRY/$image:$(python -c 'from kubeflow_tpu.tools.build_images import load_version; print(load_version()["tag_suffix"])')"
+  done
+  python -m kubeflow_tpu.testing.e2e deploy --namespace "$NAMESPACE" \
+    --artifacts-dir "$ARTIFACTS_DIR"
+else
+  python -m kubeflow_tpu.testing.e2e deploy-crds --namespace "$NAMESPACE" \
+    --artifacts-dir "$ARTIFACTS_DIR"
+  # CPU-only cluster: cpu-N gangs schedule on any node (the reference's
+  # minikube CPU-TFJob shape); gang logic is identical to TPU slices.
+  export KFT_E2E_SLICE="cpu-1"
+  python -m kubeflow_tpu.operator.main --inventory cpu-1=2 &
+  OPERATOR_PID=$!
+fi
+
+python -m kubeflow_tpu.testing.e2e tpujob-real --namespace "$NAMESPACE" \
+  --artifacts-dir "$ARTIFACTS_DIR"
+python -m kubeflow_tpu.testing.e2e teardown --namespace "$NAMESPACE" \
+  --artifacts-dir "$ARTIFACTS_DIR"
+echo "kind e2e: OK"

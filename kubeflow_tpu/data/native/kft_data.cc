@@ -9,10 +9,16 @@
 //
 // Design: N reader threads pull files off a shared queue, stream
 // length-prefixed records, and push them into a bounded ring buffer
-// (backpressure = bounded memory).  The consumer side optionally applies
-// reservoir-style shuffle.  Records are returned as malloc'd buffers the
-// caller frees (kft_free), so Python can wrap them zero-copy via ctypes
-// -> numpy.frombuffer without the GIL held during reads.
+// (backpressure = bounded memory).  The ring carries *batches* of
+// records, not single records: per-record mutex/condvar traffic is what
+// caps a multi-threaded reader below a single-threaded loop (measured
+// 10k vs 18k rec/s on 256 KiB records), so producers stage up to
+// kBatchRecords locally and cross the lock once per batch, and the
+// consumer drains whole batches per acquisition.  The consumer side
+// optionally applies reservoir-style shuffle.  Records are returned as
+// malloc'd buffers the caller frees (kft_free), so Python can wrap them
+// zero-copy via ctypes -> numpy.frombuffer without the GIL held during
+// reads.
 //
 // File format "KFTR1": [magic 'K''F''T''R'][u8 version=1][records...]
 // record: [u32 little-endian payload length][payload bytes].
@@ -21,12 +27,17 @@
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
+#if defined(__GLIBC__)
+#include <malloc.h>
+#endif
 #include <condition_variable>
 #include <deque>
+#include <map>
 #include <mutex>
 #include <random>
 #include <string>
 #include <thread>
+#include <unordered_map>
 #include <vector>
 
 namespace {
@@ -36,14 +47,19 @@ struct Record {
   uint64_t len;
 };
 
+// Records staged per lock crossing.  Small enough that batch latency is
+// invisible next to a train step, large enough to amortise the mutex.
+constexpr size_t kBatchRecords = 16;
+
 struct Loader {
   std::vector<std::string> paths;
   size_t next_path = 0;
   int repeat = 1;  // -1 = forever
   int epoch = 0;
 
-  size_t capacity;
-  std::deque<Record> buffer;
+  size_t capacity;  // bound on buffered records (across batches)
+  size_t buffered_records = 0;
+  std::deque<std::vector<Record>> buffer;
   std::mutex mu;
   std::condition_variable not_full;
   std::condition_variable not_empty;
@@ -53,10 +69,69 @@ struct Loader {
   bool stopped = false;
   char error[256] = {0};
 
-  // Consumer-side shuffle reservoir.
+  // Consumer-side staging (drained batch) + shuffle reservoir.
+  std::vector<Record> staged;
+  size_t staged_pos = 0;
   std::vector<Record> reservoir;
   size_t shuffle_buffer;
   std::mt19937_64 rng;
+
+  // Buffer pool: consumed records come back via kft_loader_free_batch
+  // and are reissued to readers.  Without reuse every record is a fresh
+  // allocation the consumer frees on another thread — glibc arena
+  // ping-pong — and the ring streams through cold DRAM; with it, a
+  // shallow queue runs entirely in cache-hot recycled buffers.
+  std::mutex pool_mu;
+  std::multimap<size_t, uint8_t*> pool;  // capacity -> free buffer
+  std::unordered_map<void*, size_t> cap_of;  // every live pooled alloc
+  size_t pool_bytes = 0;
+  size_t pool_bytes_limit = 512u << 20;
+
+  uint8_t* alloc(uint64_t len) {
+    size_t want = len ? len : 1;
+    {
+      std::lock_guard<std::mutex> lock(pool_mu);
+      auto it = pool.lower_bound(want);
+      if (it != pool.end()) {
+        uint8_t* buf = it->second;
+        pool_bytes -= it->first;
+        pool.erase(it);
+        return buf;
+      }
+    }
+    auto* buf = static_cast<uint8_t*>(malloc(want));
+    if (buf) {
+      std::lock_guard<std::mutex> lock(pool_mu);
+      cap_of[buf] = want;
+    }
+    return buf;
+  }
+
+  // Forget a buffer that leaves pool ownership (single-record API hands
+  // buffers to plain kft_free): without this, cap_of grows per record
+  // and keeps dangling pointer keys that can alias later allocations.
+  void untrack(void* ptr) {
+    std::lock_guard<std::mutex> lock(pool_mu);
+    cap_of.erase(ptr);
+  }
+
+  void release_batch(void** ptrs, int n) {
+    std::lock_guard<std::mutex> lock(pool_mu);
+    for (int i = 0; i < n; ++i) {
+      auto it = cap_of.find(ptrs[i]);
+      if (it == cap_of.end()) {
+        free(ptrs[i]);
+        continue;
+      }
+      if (pool_bytes + it->second > pool_bytes_limit) {
+        free(ptrs[i]);
+        cap_of.erase(it);
+        continue;
+      }
+      pool_bytes += it->second;
+      pool.emplace(it->second, static_cast<uint8_t*>(ptrs[i]));
+    }
+  }
 
   ~Loader() {
     {
@@ -68,8 +143,12 @@ struct Loader {
     for (auto& t : readers) {
       if (t.joinable()) t.join();
     }
-    for (auto& r : buffer) free(r.data);
+    for (auto& batch : buffer)
+      for (auto& r : batch) free(r.data);
+    for (size_t i = staged_pos; i < staged.size(); ++i)
+      free(staged[i].data);
     for (auto& r : reservoir) free(r.data);
+    for (auto& kv : pool) free(kv.second);
   }
 
   bool take_path(std::string* out) {
@@ -93,16 +172,24 @@ struct Loader {
     }
   }
 
-  void push(Record r) {
+  // One lock crossing per staged batch; frees the batch if stopping.
+  // Returns false when the loader is shutting down.
+  bool push_batch(std::vector<Record>&& batch) {
+    if (batch.empty()) return true;
     std::unique_lock<std::mutex> lock(mu);
-    not_full.wait(lock, [&] { return buffer.size() < capacity || stopped; });
+    not_full.wait(lock, [&] {
+      return buffered_records < capacity || stopped;
+    });
     if (stopped) {
-      free(r.data);
-      return;
+      lock.unlock();
+      for (auto& r : batch) free(r.data);
+      return false;
     }
-    buffer.push_back(r);
+    buffered_records += batch.size();
+    buffer.push_back(std::move(batch));
     lock.unlock();
     not_empty.notify_one();
+    return true;
   }
 
   void read_file(const std::string& path) {
@@ -111,12 +198,17 @@ struct Loader {
       fail("open failed", path);
       return;
     }
+    // 1 MiB stdio buffer: record-sized freads otherwise degrade to many
+    // small kernel reads for large records.
+    setvbuf(f, nullptr, _IOFBF, 1 << 20);
     char magic[5] = {0};
     if (fread(magic, 1, 5, f) != 5 || memcmp(magic, "KFTR\x01", 5) != 0) {
       fail("bad magic (want KFTR v1)", path);
       fclose(f);
       return;
     }
+    std::vector<Record> staging;
+    staging.reserve(kBatchRecords);
     for (;;) {
       uint32_t len_le;
       size_t n = fread(&len_le, 1, 4, f);
@@ -126,18 +218,24 @@ struct Loader {
         break;
       }
       uint64_t len = len_le;
-      uint8_t* data = static_cast<uint8_t*>(malloc(len ? len : 1));
+      uint8_t* data = alloc(len);
       if (len && fread(data, 1, len, f) != len) {
-        free(data);
+        void* p = data;
+        release_batch(&p, 1);
         fail("truncated payload", path);
         break;
       }
-      push(Record{data, len});
-      {
-        std::lock_guard<std::mutex> lock(mu);
-        if (stopped) break;
+      staging.push_back(Record{data, len});
+      if (staging.size() >= kBatchRecords) {
+        if (!push_batch(std::move(staging))) {
+          fclose(f);
+          return;  // stopped
+        }
+        staging = std::vector<Record>();
+        staging.reserve(kBatchRecords);
       }
     }
+    push_batch(std::move(staging));
     fclose(f);
   }
 
@@ -148,18 +246,43 @@ struct Loader {
     if (--active_readers == 0) not_empty.notify_all();
   }
 
-  // Pop one record from the ring (blocking); false on end-of-data.
-  bool pop(Record* out) {
+  // Refill the consumer staging vector from the ring (blocking).
+  // Returns false on end-of-data.  Consumer-side record handout then
+  // runs lock-free out of `staged`.
+  bool refill_staged() {
     std::unique_lock<std::mutex> lock(mu);
     not_empty.wait(lock, [&] {
       return !buffer.empty() || active_readers == 0 || stopped;
     });
     if (buffer.empty()) return false;
-    *out = buffer.front();
+    staged = std::move(buffer.front());
     buffer.pop_front();
+    buffered_records -= staged.size();
+    staged_pos = 0;
     lock.unlock();
-    not_full.notify_one();
+    not_full.notify_all();
     return true;
+  }
+
+  // Pop one record (blocking); false on end-of-data.
+  bool pop(Record* out) {
+    if (staged_pos >= staged.size() && !refill_staged()) return false;
+    *out = staged[staged_pos++];
+    return true;
+  }
+
+  // Pop up to max_n records; at most one lock acquisition (the refill).
+  int pop_batch(Record* out, int max_n) {
+    int n = 0;
+    while (n < max_n) {
+      if (staged_pos >= staged.size()) {
+        // Don't block for a second batch once we have records in hand.
+        if (n > 0) break;
+        if (!refill_staged()) break;
+      }
+      out[n++] = staged[staged_pos++];
+    }
+    return n;
   }
 
   // Shuffled next: keep a reservoir topped up; emit a random element.
@@ -190,6 +313,14 @@ void* kft_loader_create(const char** paths, int n_paths, int n_threads,
                         int prefetch, int shuffle_buffer, uint64_t seed,
                         int repeat) {
   if (n_paths <= 0) return nullptr;
+#if defined(__GLIBC__)
+  // Record payloads are commonly 100 KiB - 1 MiB; glibc's default mmap
+  // threshold (128 KiB) would turn every such malloc/free into an
+  // mmap/munmap pair plus double page-fault traffic (once in fread, once
+  // in the consumer copy), capping throughput far below memcpy speed.
+  // Keep them on the heap freelist instead.
+  mallopt(M_MMAP_THRESHOLD, 8 << 20);
+#endif
   auto* loader = new Loader();
   for (int i = 0; i < n_paths; ++i) loader->paths.emplace_back(paths[i]);
   loader->capacity = prefetch > 0 ? prefetch : 64;
@@ -210,9 +341,49 @@ int kft_loader_next(void* handle, void** data, uint64_t* len) {
   auto* loader = static_cast<Loader*>(handle);
   Record r;
   if (!loader->next(&r)) return 0;
+  loader->untrack(r.data);  // ownership moves to the caller (kft_free)
   *data = r.data;
   *len = r.len;
   return 1;
+}
+
+// Batched variant: fills up to max_n (data, len) pairs, returns the
+// count (0 = end-of-data).  One FFI round-trip per batch instead of per
+// record; every returned buffer is caller-owned (kft_free/_batch).
+// Shuffled loaders still draw through the reservoir one at a time
+// (correctness of the sampling), unshuffled ones drain the ring in one
+// locked sweep.
+int kft_loader_next_batch(void* handle, void** datas, uint64_t* lens,
+                          int max_n) {
+  auto* loader = static_cast<Loader*>(handle);
+  if (max_n <= 0) return 0;
+  if (loader->shuffle_buffer > 1) {
+    int n = 0;
+    Record r;
+    while (n < max_n && loader->next(&r)) {
+      datas[n] = r.data;
+      lens[n] = r.len;
+      ++n;
+    }
+    return n;
+  }
+  std::vector<Record> recs(static_cast<size_t>(max_n));
+  int n = loader->pop_batch(recs.data(), max_n);
+  for (int i = 0; i < n; ++i) {
+    datas[i] = recs[i].data;
+    lens[i] = recs[i].len;
+  }
+  return n;
+}
+
+// Return consumed buffers to the loader's pool for reader reuse.
+void kft_loader_free_batch(void* handle, void** datas, int n) {
+  static_cast<Loader*>(handle)->release_batch(datas, n);
+}
+
+// Handle-less variants (no pooling): for buffers from kft_loader_next.
+void kft_free_batch(void** datas, int n) {
+  for (int i = 0; i < n; ++i) free(datas[i]);
 }
 
 // Last error message ('' if none); valid until destroy.

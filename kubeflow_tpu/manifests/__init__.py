@@ -10,7 +10,9 @@ prototypes available).  Sub-modules map to reference packages:
   jupyterhub   kubeflow/core/jupyterhub.libsonnet + kubeform_spawner.py
   serving      kubeflow/tf-serving heir (tpu-serving)
   tensorboard  kubeflow/core/tensorboard.libsonnet heir
-  iap          kubeflow/core/iap + cloud-endpoints + cert-manager heir
+  iap          kubeflow/core/iap.libsonnet heir (GKE IAP ingress)
+  certs        kubeflow/core/cert-manager.libsonnet heir (non-GKE TLS)
+  endpoints    kubeflow/core/cloud-endpoints.libsonnet heir
   torch        kubeflow/pytorch-job heir (torch-xla-job)
   addons       kubeflow/argo, seldon, pachyderm, credentials-pod-preset
   examples     kubeflow/examples heirs (tpu-job-simple, tpu-serving-simple)
@@ -21,7 +23,9 @@ from kubeflow_tpu.manifests import base  # noqa: F401
 # Import order matters only for examples (it references tpu-serving).
 from kubeflow_tpu.manifests import (  # noqa: F401
     addons,
+    certs,
     core,
+    endpoints,
     iap,
     jupyterhub,
     serving,

@@ -127,7 +127,11 @@ def pod_spec(containers: Sequence[dict],
 
 def deployment(name: str, namespace: str, labels: Dict[str, str],
                spec: dict, replicas: int = 1,
-               annotations: Optional[Dict[str, str]] = None) -> dict:
+               annotations: Optional[Dict[str, str]] = None,
+               template_labels: Optional[Dict[str, str]] = None) -> dict:
+    """`template_labels` extend `labels` on the pod template only — the
+    selector stays at `labels`, which is immutable once applied, so
+    rollout-varying labels (e.g. Istio `version`) must go here."""
     return {
         "apiVersion": "apps/v1",
         "kind": "Deployment",
@@ -136,7 +140,7 @@ def deployment(name: str, namespace: str, labels: Dict[str, str],
             "replicas": replicas,
             "selector": {"matchLabels": labels},
             "template": {
-                "metadata": {"labels": labels},
+                "metadata": {"labels": template_labels or labels},
                 "spec": spec,
             },
         },

@@ -16,11 +16,11 @@ def _generate_job_simple(component_name: str, **p: Any) -> List[dict]:
     job = TPUJobSpec(
         name=component_name,
         namespace=p["namespace"],
-        slice_type="v5e-1",
+        slice_type=p["slice_type"],
         worker=WorkerSpec(
             image="ghcr.io/kubeflow-tpu/worker:latest",
             command=["python", "-m", "kubeflow_tpu.tools.train_cnn"],
-            args=["--model=resnet18", "--steps=10", "--synthetic-data"],
+            args=["--model=resnet18", "--steps=10"],
         ),
     )
     return [job.to_custom_resource()]
@@ -30,7 +30,11 @@ job_simple_prototype = default_registry.register(Prototype(
     name="tpu-job-simple",
     doc="Smallest runnable TPUJob (heir of examples/tf-job-simple): "
         "ResNet-18, 10 steps, one v5e chip, synthetic data",
-    params=[param("namespace", str, "kubeflow", "target namespace")],
+    params=[
+        param("namespace", str, "kubeflow", "target namespace"),
+        param("slice_type", str, "v5e-1",
+              "slice to run on (cpu-1 for TPU-less E2E clusters)"),
+    ],
     generate=_generate_job_simple,
 ))
 
@@ -55,4 +59,32 @@ serving_simple_prototype = default_registry.register(Prototype(
               "versioned model directory"),
     ],
     generate=_generate_serving_simple,
+))
+
+
+def _generate_serving_istio(component_name: str, **p: Any) -> List[dict]:
+    proto = default_registry.get("tpu-serving")
+    return proto.generate(
+        component_name,
+        namespace=p["namespace"],
+        model_name=component_name,
+        model_base_path=p["model_base_path"],
+        istio_enable=True,
+        istio_version=p["version"],
+    )
+
+
+serving_istio_prototype = default_registry.register(Prototype(
+    name="tpu-serving-with-istio",
+    doc="Model server joined to the Istio mesh (heir of "
+        "examples/prototypes/tf-serving-with-istio.jsonnet): sidecar "
+        "inject + versioned VirtualService/DestinationRule routing",
+    params=[
+        param("namespace", str, "kubeflow", "target namespace"),
+        param("model_base_path", str, "gs://kubeflow-examples/inception",
+              "versioned model directory"),
+        param("version", str, "v1",
+              "deployment version label the default route targets"),
+    ],
+    generate=_generate_serving_istio,
 ))

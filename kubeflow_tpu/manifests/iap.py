@@ -1,6 +1,7 @@
-"""GCP ingress + IAP auth — heir of kubeflow/core/iap.libsonnet (1,310 LoC
-of hand-rolled envoy JWT config, cloud-endpoints.libsonnet, and
-cert-manager.libsonnet).
+"""GCP ingress + IAP auth — heir of kubeflow/core/iap.libsonnet (the
+hand-rolled envoy JWT fleet; sibling packages: ``certs`` re-provides
+cert-manager.libsonnet, ``endpoints`` re-provides
+cloud-endpoints.libsonnet).
 
 The capability re-provided: expose the platform behind Google
 Identity-Aware Proxy on a managed TLS hostname.  The mechanism is
@@ -45,12 +46,24 @@ def _generate_iap(component_name: str, **p: Any) -> List[dict]:
             },
         },
     }
-    certificate = {
-        "apiVersion": "networking.gke.io/v1",
-        "kind": "ManagedCertificate",
-        "metadata": base.metadata("platform-cert", namespace, labels),
-        "spec": {"domains": [hostname]},
-    }
+    if p["tls_type"] == "cert-manager":
+        # Non-GKE path: a cert-manager Certificate (heir of
+        # cert-manager.libsonnet's Let's-Encrypt flow; deploy the
+        # `cert-manager` prototype alongside this one).
+        from kubeflow_tpu.manifests import certs
+
+        certificate = certs.certificate("platform-cert", namespace,
+                                        hostname)
+    elif p["tls_type"] == "gke":
+        certificate = {
+            "apiVersion": "networking.gke.io/v1",
+            "kind": "ManagedCertificate",
+            "metadata": base.metadata("platform-cert", namespace, labels),
+            "spec": {"domains": [hostname]},
+        }
+    else:
+        raise ValueError(
+            f"tls_type must be 'gke' or 'cert-manager', got {p['tls_type']!r}")
     # Ambassador fronts everything (same gateway as the reference); the
     # ingress targets it and carries the IAP BackendConfig.
     gateway_svc = base.service(
@@ -64,13 +77,23 @@ def _generate_iap(component_name: str, **p: Any) -> List[dict]:
         },
         labels=labels,
     )
+    if p["tls_type"] == "gke":
+        ingress_annotations = {
+            "kubernetes.io/ingress.global-static-ip-name":
+                p["static_ip_name"],
+            "networking.gke.io/managed-certificates": "platform-cert",
+        }
+    else:
+        # No cert-manager.io/issuer annotation here: the explicit
+        # Certificate below owns platform-cert-tls; the annotation would
+        # make ingress-shim mint a SECOND Certificate for the same
+        # secret (renewal churn + duplicate ACME orders).
+        ingress_annotations = None
     ingress = {
         "apiVersion": "networking.k8s.io/v1",
         "kind": "Ingress",
-        "metadata": base.metadata(component_name, namespace, labels, {
-            "kubernetes.io/ingress.global-static-ip-name": p["static_ip_name"],
-            "networking.gke.io/managed-certificates": "platform-cert",
-        }),
+        "metadata": base.metadata(component_name, namespace, labels,
+                                  ingress_annotations),
         "spec": {
             "rules": [{
                 "host": hostname,
@@ -85,6 +108,11 @@ def _generate_iap(component_name: str, **p: Any) -> List[dict]:
             }],
         },
     }
+    if p["tls_type"] == "cert-manager":
+        # The Certificate writes platform-cert-tls; the Ingress serves it.
+        ingress["spec"]["tls"] = [
+            {"hosts": [hostname], "secretName": "platform-cert-tls"},
+        ]
     whoami = base.deployment(
         name="whoami-app", namespace=namespace,
         labels={"app": "whoami"},
@@ -121,6 +149,10 @@ iap_prototype = default_registry.register(Prototype(
               "name of the reserved global static IP"),
         param("gateway_selector", str, "ambassador",
               "label of the gateway Deployment to expose"),
+        param("tls_type", str, "gke",
+              "certificate machinery: 'gke' (ManagedCertificate) or "
+              "'cert-manager' (Let's Encrypt via the cert-manager "
+              "prototype on any cluster)"),
         param("whoami_image", str,
               "gcr.io/cloud-solutions-group/esp-sample-app:1.0.0",
               "identity echo app for auth smoke tests"),

@@ -3,9 +3,12 @@
 Re-provides the reference package's full parameter surface
 (kubeflow/tf-serving/tf-serving.libsonnet): model server Deployment +
 Service (gRPC-era :9000 folded into the one REST port :8000 our server
-exposes), Ambassador route annotations (:247-267), and the storage
+exposes), Ambassador route annotations (:247-267), the storage
 credential mixins — GCS service-account secret mount (:342-382), S3 env
-plumbing (:310-339), NFS PVC mount (:151-155).  The C++
+plumbing (:310-339), NFS PVC mount (:151-155) — and the optional Istio
+mesh integration (sidecar inject + versioned routing, the capability of
+the v1alpha2 RouteRule at tf-serving.libsonnet:287-305, re-provided on
+the modern VirtualService/DestinationRule API).  The C++
 tensorflow_model_server + proxy sidecar pair is replaced by the single
 first-party serving container (serving/main.py).
 """
@@ -48,10 +51,54 @@ def gcp_volume_mixin(secret_name: str, mount_path: str = "/secret/gcp-credential
     return volume, mount, env
 
 
+def istio_routing(name: str, namespace: str, version: str,
+                  labels: Dict[str, str]) -> List[dict]:
+    """Istio versioned-routing pair for a serving Service.
+
+    Capability heir of the reference's RouteRule
+    (kubeflow/tf-serving/tf-serving.libsonnet:287-305: route all traffic
+    for the service to the pods labelled with ``version``), expressed on
+    the post-v1alpha2 API surface: a DestinationRule declaring the
+    version subset and a VirtualService pinning the default route to it.
+    Canary rollout = generate a second subset and shift route weights.
+    """
+    destination_rule = {
+        "apiVersion": "networking.istio.io/v1beta1",
+        "kind": "DestinationRule",
+        "metadata": base.metadata(name, namespace, labels),
+        "spec": {
+            "host": name,
+            "subsets": [
+                {"name": version, "labels": {"version": version}},
+            ],
+        },
+    }
+    virtual_service = {
+        "apiVersion": "networking.istio.io/v1beta1",
+        "kind": "VirtualService",
+        "metadata": base.metadata(f"{name}-default", namespace, labels),
+        "spec": {
+            "hosts": [name],
+            "http": [{
+                "route": [{
+                    "destination": {"host": name, "subset": version},
+                    "weight": 100,
+                }],
+            }],
+        },
+    }
+    return [destination_rule, virtual_service]
+
+
 def _generate_serving(component_name: str, **p: Any) -> List[dict]:
     namespace = p["namespace"]
     name = component_name
     labels = {"app": name, "kubeflow-tpu.org/component": "model-server"}
+    # Pods carry the version label the DestinationRule subset selects on;
+    # the Service selector stays version-free so it spans every subset
+    # (the reference's split at tf-serving.libsonnet:170 vs :282).
+    pod_labels = (dict(labels, version=p["istio_version"])
+                  if p["istio_enable"] else labels)
 
     env: List[dict] = []
     volumes: List[dict] = []
@@ -93,12 +140,19 @@ def _generate_serving(component_name: str, **p: Any) -> List[dict]:
         name=name, namespace=namespace, labels=labels,
         replicas=p["replicas"],
         spec=base.pod_spec([serving_container], volumes=volumes),
+        template_labels=pod_labels if p["istio_enable"] else None,
     )
     if p["slice_type"]:
         from kubeflow_tpu.runtime.topology import parse_slice_type
 
         deploy["spec"]["template"]["spec"]["nodeSelector"] = \
             parse_slice_type(p["slice_type"]).k8s_node_selector()
+    if p["istio_enable"]:
+        # Sidecar injection is requested per-pod, exactly as the reference
+        # did (examples/prototypes/tf-serving-with-istio.jsonnet:106).
+        deploy["spec"]["template"]["metadata"]["annotations"] = {
+            "sidecar.istio.io/inject": "true",
+        }
 
     annotations = None
     if p["ambassador_route"]:
@@ -113,7 +167,11 @@ def _generate_serving(component_name: str, **p: Any) -> List[dict]:
         annotations=annotations,
         labels=labels,
     )
-    return [deploy, svc]
+    objs = [deploy, svc]
+    if p["istio_enable"]:
+        objs.extend(istio_routing(name, namespace, p["istio_version"],
+                                  labels))
+    return objs
 
 
 serving_prototype = default_registry.register(Prototype(
@@ -148,6 +206,11 @@ serving_prototype = default_registry.register(Prototype(
         param("s3_endpoint", str, "s3.us-west-1.amazonaws.com",
               "S3 endpoint"),
         param("nfs_pvc", str, "nfs-external", "NFS PVC to mount at /mnt"),
+        param("istio_enable", bool, False,
+              "join the Istio mesh: sidecar inject + versioned "
+              "VirtualService/DestinationRule routing"),
+        param("istio_version", str, "v1",
+              "version label the Istio route subset selects on"),
     ],
     generate=_generate_serving,
 ))

@@ -182,8 +182,8 @@ def _generate_tpu_cnn(component_name: str, **p: Any) -> List[dict]:
         f"--steps={p['num_batches']}",
         "--dtype=bfloat16",
     ]
-    if p["synthetic_data"]:
-        args.append("--synthetic-data")
+    if p["data_dir"]:
+        args.append(f"--data-dir={p['data_dir']}")
     job = _job_from_params(
         component_name, p["namespace"], p["slice_type"], p["num_slices"],
         p["image"], ["python", "-m", "kubeflow_tpu.tools.train_cnn"], args,
@@ -204,7 +204,8 @@ tpu_cnn_prototype = default_registry.register(Prototype(
               choices=["resnet50", "resnet101", "inception_v3"]),
         param("batch_size", int, 128, "per-device batch size"),
         param("num_batches", int, 100, "training steps to run"),
-        param("synthetic_data", bool, True, "use synthetic input data"),
+        param("data_dir", str, "",
+              "KFTR shard directory (synthetic input data when unset)"),
         param("image", str, DEFAULT_WORKER_IMAGE, "worker image"),
         param("checkpoint_path", str, "", "GCS checkpoint path"),
     ],

@@ -97,14 +97,20 @@ def build_worker_pod(job: crd.TPUJobSpec, index: int) -> dict:
     if job.num_slices > 1:
         env[bootstrap.ENV_MEGASCALE_SLICES] = str(job.num_slices)
         env["MEGASCALE_SLICE_ID"] = str(slice_id)
+    if topo.is_cpu:
+        # CPU gang (cpu-N slice): schedulable anywhere, no TPU resource —
+        # the reference's minikube CPU TFJob shape.
+        resources = {"requests": {"cpu": "1", "memory": "1Gi"}}
+    else:
+        resources = {
+            "limits": {"google.com/tpu": str(topo.chips_per_host)},
+            "requests": {"google.com/tpu": str(topo.chips_per_host)},
+        }
     container = {
         "name": "worker",
         "image": job.worker.image,
         "env": [{"name": k, "value": v} for k, v in sorted(env.items())],
-        "resources": {
-            "limits": {"google.com/tpu": str(topo.chips_per_host)},
-            "requests": {"google.com/tpu": str(topo.chips_per_host)},
-        },
+        "resources": resources,
         "ports": [{"containerPort": COORDINATOR_PORT}],
     }
     if job.worker.command:

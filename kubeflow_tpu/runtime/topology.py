@@ -49,7 +49,17 @@ class SliceTopology:
         """JAX device count the runtime will see across the whole slice."""
         return self.chips
 
+    @property
+    def is_cpu(self) -> bool:
+        """CPU "slices" (cpu-N): gangs schedulable on any node — the heir
+        of the reference's CPU-fallback TFJobs on minikube
+        (tf-controller-examples/tf-cnn/create_job_specs.py:111
+        ``--device=cpu``); used by E2E on clusters without TPUs."""
+        return self.generation == "cpu"
+
     def k8s_node_selector(self) -> Dict[str, str]:
+        if self.is_cpu:
+            return {}
         return {
             "cloud.google.com/gke-tpu-accelerator": self.gke_accelerator(),
             "cloud.google.com/gke-tpu-topology": "x".join(map(str, self.ici_mesh)),
@@ -98,6 +108,13 @@ for topo in [
     _v5p(32, (2, 4, 4), 8),    # v5p-64
     _v5p(64, (4, 4, 4), 16),   # v5p-128
     _v5p(128, (4, 4, 8), 32),  # v5p-256
+] + [
+    # CPU gangs for TPU-less clusters (kind/minikube E2E): n single-
+    # process hosts, fake-slice JAX devices inside each.
+    SliceTopology(name=f"cpu-{n}", generation="cpu", chips=n, hosts=n,
+                  ici_mesh=(n,), cores_per_chip=1, hbm_gib_per_chip=0,
+                  bf16_tflops_per_chip=1.0)
+    for n in (1, 2, 4, 8)
 ]:
     _TOPOLOGIES[topo.name] = topo
 

@@ -24,7 +24,8 @@ from __future__ import annotations
 
 import dataclasses
 import logging
-from typing import Any, Callable, Dict, Iterable, Optional, Tuple
+from collections import deque
+from typing import Any, Callable, Deque, Dict, Iterable, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -268,7 +269,11 @@ class Trainer:
             step is still executing (host->HBM transfer overlaps compute);
           - step time is averaged over the window since the last sync —
             a per-step host sync would measure host<->device round-trip
-            latency, not device throughput.
+            latency, not device throughput;
+          - dispatch depth is bounded at 2 steps: the host blocks on the
+            result from two steps ago, so at most two batches are ever in
+            flight no matter how `log_every` is set (an unbounded loop
+            would queue every batch's HBM buffer ahead of the device).
         """
         if state is None:
             state = self.create_state()
@@ -296,12 +301,18 @@ class Trainer:
         timer = Timer()
         timer.start()
         window_steps = 0
+        inflight: Deque[Any] = deque()
         for i in range(start_step, num_steps):
             state, metrics = step_fn(state, batch)
             window_steps += 1
             if i + 1 < num_steps:
                 # Overlaps with the async step above.
                 batch = self.shard_batch(next(it))
+            inflight.append(metrics["loss"])
+            if len(inflight) > 2:
+                # Backpressure: in steady state this result is already
+                # done, so the wait is free — it only paces the host.
+                jax.block_until_ready(inflight.popleft())
             if log_every and (i % log_every == 0 or i == num_steps - 1):
                 loss = float(metrics["loss"])  # device sync
                 dt = timer.stop() / window_steps

@@ -98,21 +98,125 @@ def train_smoke(namespace: str = "kubeflow-test") -> None:
         [sys.executable, "-m", "kubeflow_tpu.tools.train_cnn",
          "--model", "resnet18", "--steps", "2",
          "--batch-size-per-device", "2", "--image-size", "32",
-         "--num-classes", "4", "--synthetic-data"],
+         "--num-classes", "4"],
         capture_output=True, text=True, timeout=600,
     )
     assert proc.returncode == 0, proc.stderr[-2000:]
 
 
+def _kubectl(args, *, input_text: str = None, timeout: int = 300) -> str:
+    import subprocess
+
+    proc = subprocess.run(
+        ["kubectl"] + args, input=input_text, text=True,
+        capture_output=True, timeout=timeout,
+    )
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"kubectl {' '.join(args)} failed: {proc.stderr[-2000:]}")
+    return proc.stdout
+
+
+def deploy_real(namespace: str = "kubeflow-test") -> None:
+    """Deploy the platform to the CURRENT kubectl context and verify it
+    comes up — the reference's center-of-gravity E2E
+    (testing/test_deploy.py:160-190 deploy-then-verify; cluster may be
+    kind/minikube/GKE, exactly as prow_config.yaml parameterised it).
+
+    Renders kubeflow-core + the operator through the same registry path a
+    user drives, applies it, then waits for every Deployment to roll out
+    within the reference's 10-minute readiness budget
+    (test_deploy.py:188-189).
+    """
+    import kubeflow_tpu.manifests  # noqa: F401 — registers prototypes
+    from kubeflow_tpu.config.registry import App
+    from kubeflow_tpu.manifests.base import to_yaml
+
+    app = App()
+    app.add("kubeflow-core", "core", namespace=namespace)
+    objects = app.render()
+    _kubectl(["create", "namespace", namespace,
+              "--dry-run=client", "-o", "yaml"])  # validates kubectl works
+    try:
+        _kubectl(["create", "namespace", namespace])
+    except RuntimeError:
+        pass  # already exists
+    _kubectl(["apply", "-n", namespace, "-f", "-"],
+             input_text=to_yaml(objects))
+    deployments = [o["metadata"]["name"] for o in objects
+                   if o["kind"] == "Deployment"]
+    for name in deployments:
+        _kubectl(["rollout", "status", f"deployment/{name}",
+                  "-n", namespace, "--timeout=600s"], timeout=650)
+
+
+def deploy_crds(namespace: str = "kubeflow-test") -> None:
+    """Apply only the CRDs (+ namespace) to the current context.
+
+    The control-plane-only footing for clusters that cannot pull the
+    platform images (ephemeral kind, ci/run_e2e_kind.sh): the operator
+    then runs as a host process against the cluster, so exactly one
+    reconciler owns the CRs."""
+    import kubeflow_tpu.manifests  # noqa: F401
+    from kubeflow_tpu.config.registry import default_registry
+    from kubeflow_tpu.manifests.base import to_yaml
+
+    objs = default_registry.generate("tpujob-operator", "op",
+                                     namespace=namespace)
+    crds = [o for o in objs if o["kind"] == "CustomResourceDefinition"]
+    try:
+        _kubectl(["create", "namespace", namespace])
+    except RuntimeError:
+        pass  # already exists
+    _kubectl(["apply", "-f", "-"], input_text=to_yaml(crds))
+
+
+def tpujob_real(namespace: str = "kubeflow-test") -> None:
+    """Submit the tpu-job-simple example to the real cluster and poll the
+    CR until the operator reports a terminal phase (the simple_tfjob
+    check, workflows.libsonnet:398-411, against a live control plane)."""
+    import json
+    import os
+
+    import kubeflow_tpu.manifests  # noqa: F401
+    from kubeflow_tpu.config.registry import default_registry
+    from kubeflow_tpu.manifests.base import to_yaml
+
+    objs = default_registry.generate(
+        "tpu-job-simple", "e2e-smoke", namespace=namespace,
+        slice_type=os.environ.get("KFT_E2E_SLICE", "v5e-1"))
+    _kubectl(["apply", "-n", namespace, "-f", "-"],
+             input_text=to_yaml(objs))
+    deadline = time.time() + 600
+    phase = ""
+    while time.time() < deadline:
+        out = _kubectl(["get", "tpujobs.kubeflow-tpu.org", "e2e-smoke",
+                        "-n", namespace, "-o", "json"])
+        phase = json.loads(out).get("status", {}).get("phase", "")
+        if phase in ("Succeeded", "Failed"):
+            break
+        time.sleep(5)
+    assert phase == "Succeeded", f"TPUJob ended in phase {phase!r}"
+
+
 def teardown(namespace: str = "kubeflow-test") -> None:
     """Hermetic backend has nothing persistent; real clusters delete the
-    test namespace (left to kubectl in the workflow step)."""
+    test namespace (the reference's teardown subcommand,
+    test_deploy.py:520-626)."""
+    try:
+        _kubectl(["delete", "namespace", namespace, "--ignore-not-found"],
+                 timeout=600)
+    except (RuntimeError, FileNotFoundError):
+        pass  # no cluster in hermetic runs — nothing to tear down
 
 
 COMMANDS = {
     "tpujob": tpujob_smoke,
     "serving": serving_smoke,
     "train": train_smoke,
+    "deploy": deploy_real,
+    "deploy-crds": deploy_crds,
+    "tpujob-real": tpujob_real,
     "teardown": teardown,
 }
 

@@ -104,7 +104,8 @@ def default_e2e(name: str = "e2e", namespace: str = "kubeflow-test",
         "checkout", ["git", "clone", repo, "/src"], image=image))
     wf.add_step(Step(
         "deploy-kubeflow",
-        ["kubeflow-tpu", "apply"],
+        ["python", "-m", "kubeflow_tpu.testing.e2e", "deploy",
+         "--namespace", namespace],
         image=image, deps=["checkout"]))
     wf.add_step(Step(
         "tpujob-test",

@@ -32,6 +32,16 @@ def load_version(version: str = "default") -> dict:
     return json.loads(path.read_text())
 
 
+def list_versions() -> List[str]:
+    """Every entry in the version matrix (heir of the reference's
+    versions/* dirs, e.g. components/tensorflow-notebook-image/versions).
+    'default' sorts first so it is what un-suffixed tags track."""
+    names = sorted(p.name for p in VERSIONS_DIR.iterdir()
+                   if (p / "version-config.json").exists())
+    names.sort(key=lambda n: n != "default")
+    return names
+
+
 def build_command(target: str, config: dict, registry: str,
                   push: bool = False) -> List[str]:
     platforms: Dict[str, dict] = config.get("platforms", {})
@@ -87,6 +97,8 @@ def main(argv=None) -> int:
                     help=f"images to build (default: all of {TARGETS})")
     ap.add_argument("--version", default="default",
                     help="version dir under docker/versions/")
+    ap.add_argument("--all-versions", action="store_true",
+                    help="build every entry in the version matrix")
     ap.add_argument("--registry", default="ghcr.io/kubeflow-tpu")
     ap.add_argument("--build", action="store_true",
                     help="actually run docker (default: print commands)")
@@ -95,16 +107,20 @@ def main(argv=None) -> int:
                     help="print the nightly release Argo Workflow")
     args = ap.parse_args(argv)
 
-    config = load_version(args.version)
     if args.emit_release_workflow:
+        config = load_version(args.version)
         print(json.dumps(release_workflow(args.registry, config), indent=2))
         return 0
+    versions = list_versions() if args.all_versions else [args.version]
     rc = 0
-    for target in (args.targets or TARGETS):
-        cmd = build_command(target, config, args.registry, push=args.push)
-        print(" ".join(cmd), file=sys.stderr)
-        if args.build:
-            rc |= subprocess.run(cmd).returncode
+    for version in versions:
+        config = load_version(version)
+        for target in (args.targets or TARGETS):
+            cmd = build_command(target, config, args.registry,
+                                push=args.push)
+            print(" ".join(cmd), file=sys.stderr)
+            if args.build:
+                rc |= subprocess.run(cmd).returncode
     return rc
 
 

@@ -1,0 +1,116 @@
+"""PyTorch worker entrypoint — the executed half of the torch profile.
+
+Heir of the reference's pytorch-job path (kubeflow/pytorch-job/
+pytorch-job.libsonnet:4-34, pytorch-operator.libsonnet:30-80): there the
+operator injected MASTER_ADDR/MASTER_PORT/RANK/WORLD_SIZE for DDP
+rendezvous.  Here the SAME KFT_* contract every worker kind uses
+(runtime/bootstrap.py) is translated into torch.distributed's env
+variables, so the torch-xla-job prototype (manifests/torch.py) runs
+through the same gang machinery as JAX jobs.
+
+Backend selection:
+  - torch_xla present (the TPU image): PJRT/XLA device, SPMD-style.
+  - plain torch (tests, CPU smoke): gloo process group when distributed,
+    single-process otherwise.
+
+The training body is a deliberate minimal loop (linear regression) — the
+reference's pytorch-job likewise shipped only the dist_mnist example
+contract, not a model zoo; the point is the executed rendezvous + step.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+
+def torch_dist_env(env) -> dict:
+    """KFT_* -> torch.distributed env contract (MASTER_ADDR et al.).
+
+    The reference's operator wrote these directly into pod env
+    (pytorch-operator's DDP convention); we derive them from the one
+    KFT contract instead of maintaining a second injection path.
+    """
+    out = {
+        "RANK": str(env.process_id),
+        "WORLD_SIZE": str(env.num_processes),
+    }
+    if env.coordinator_address:
+        host, _, port = env.coordinator_address.partition(":")
+        out["MASTER_ADDR"] = host
+        out["MASTER_PORT"] = port or "12355"
+    else:
+        out["MASTER_ADDR"] = "127.0.0.1"
+        out["MASTER_PORT"] = "12355"
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="kubeflow-tpu-train-torch")
+    ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--features", type=int, default=8)
+    ap.add_argument("--learning-rate", type=float, default=0.05)
+    args = ap.parse_args(argv)
+
+    logging.basicConfig(level=logging.INFO, stream=sys.stderr)
+    from kubeflow_tpu.runtime.bootstrap import worker_env
+
+    env = worker_env()
+    for key, value in torch_dist_env(env).items():
+        os.environ.setdefault(key, value)
+
+    import torch
+
+    xm = None
+    try:  # the TPU worker image; absent in CPU test environments
+        import torch_xla.core.xla_model as xm  # type: ignore
+
+        device = xm.xla_device()
+    except ImportError:
+        device = torch.device("cpu")
+
+    # Gradient sync: on XLA devices torch_xla's own collectives do the
+    # cross-replica reduce (xm.optimizer_step below) — gloo cannot carry
+    # XLA tensors, so DDP-over-gloo is the CPU-only path.
+    distributed = env.num_processes > 1 and xm is None
+    if distributed:
+        import torch.distributed as dist
+
+        dist.init_process_group(backend="gloo", rank=env.process_id,
+                                world_size=env.num_processes)
+
+    torch.manual_seed(env.process_id)
+    model = torch.nn.Linear(args.features, 1).to(device)
+    if distributed:
+        model = torch.nn.parallel.DistributedDataParallel(model)
+    opt = torch.optim.SGD(model.parameters(), lr=args.learning_rate)
+    true_w = torch.arange(args.features, dtype=torch.float32,
+                          device=device)
+
+    loss = None
+    for step in range(args.steps):
+        x = torch.randn(args.batch_size, args.features, device=device)
+        y = (x @ true_w)[:, None]
+        opt.zero_grad()
+        loss = torch.nn.functional.mse_loss(model(x), y)
+        loss.backward()  # DDP averages grads across the gang here (CPU)
+        if xm is not None:
+            xm.optimizer_step(opt)  # allreduce + step on the XLA device
+        else:
+            opt.step()
+        if step % max(1, args.steps // 5) == 0:
+            logging.info("step %d loss %.4f", step, loss.item())
+
+    if distributed:
+        import torch.distributed as dist
+
+        dist.destroy_process_group()
+    logging.info("torch training done: loss %.4f", loss.item())
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

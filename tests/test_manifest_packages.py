@@ -12,10 +12,11 @@ from kubeflow_tpu.manifests.iap import is_cloud_endpoint
 
 
 EXPECTED_PROTOTYPES = {
-    "argo", "gcp-credentials-pod-preset", "iap-ingress", "jupyterhub",
-    "kubeflow-core", "pachyderm", "seldon", "tensorboard", "torch-xla-job",
-    "tpu-cnn-benchmark", "tpu-job", "tpu-job-simple", "tpu-serving",
-    "tpu-serving-simple", "tpujob-operator",
+    "argo", "cert-manager", "cloud-endpoints", "gcp-credentials-pod-preset",
+    "iap-ingress", "jupyterhub", "kubeflow-core", "pachyderm", "seldon",
+    "tensorboard", "torch-xla-job", "tpu-cnn-benchmark", "tpu-job",
+    "tpu-job-simple", "tpu-serving", "tpu-serving-simple",
+    "tpu-serving-with-istio", "tpujob-operator",
 }
 
 
@@ -139,6 +140,123 @@ class TestExamples:
     def test_serving_simple_delegates(self):
         objs = default_registry.generate("tpu-serving-simple", "inception")
         assert kinds(objs) == ["Deployment", "Service"]
+
+    def test_serving_with_istio(self):
+        objs = default_registry.generate("tpu-serving-with-istio",
+                                         "inception")
+        assert kinds(objs) == ["Deployment", "Service", "DestinationRule",
+                               "VirtualService"]
+
+
+class TestServingIstio:
+    """Heir of the RouteRule + sidecar-inject surface
+    (kubeflow/tf-serving/tf-serving.libsonnet:287-305,
+    examples/prototypes/tf-serving-with-istio.jsonnet:106)."""
+
+    def test_sidecar_inject_and_version_label(self):
+        objs = default_registry.generate(
+            "tpu-serving", "m", istio_enable=True, istio_version="v2")
+        deploy = objs[0]
+        tmpl = deploy["spec"]["template"]
+        assert tmpl["metadata"]["annotations"][
+            "sidecar.istio.io/inject"] == "true"
+        assert tmpl["metadata"]["labels"]["version"] == "v2"
+        # Selector must stay version-free: it is immutable on the API
+        # server, and the canary flow re-renders with a new version.
+        assert "version" not in deploy["spec"]["selector"]["matchLabels"]
+        svc = objs[1]
+        assert "version" not in svc["spec"]["selector"]
+
+    def test_route_objects_target_the_subset(self):
+        objs = default_registry.generate(
+            "tpu-serving", "m", istio_enable=True)
+        dr = [o for o in objs if o["kind"] == "DestinationRule"][0]
+        vs = [o for o in objs if o["kind"] == "VirtualService"][0]
+        assert dr["spec"]["subsets"] == [
+            {"name": "v1", "labels": {"version": "v1"}}]
+        route = vs["spec"]["http"][0]["route"][0]
+        assert route["destination"] == {"host": "m", "subset": "v1"}
+        assert route["weight"] == 100
+
+    def test_istio_off_by_default(self):
+        objs = default_registry.generate("tpu-serving", "m")
+        assert kinds(objs) == ["Deployment", "Service"]
+        assert "annotations" not in objs[0]["spec"]["template"]["metadata"]
+
+
+class TestCertManager:
+    """Heir of kubeflow/core/cert-manager.libsonnet:1-182."""
+
+    def test_full_render(self):
+        objs = default_registry.generate("cert-manager", "certs")
+        ks = kinds(objs)
+        assert ks.count("CustomResourceDefinition") == 3
+        assert {"ServiceAccount", "ClusterRole", "ClusterRoleBinding",
+                "Deployment", "Issuer"} <= set(ks)
+        issuer = [o for o in objs if o["kind"] == "Issuer"][0]
+        acme = issuer["spec"]["acme"]
+        assert acme["server"].startswith("https://acme-v02")
+        assert acme["solvers"] == [{"http01": {"ingress": {}}}]
+        assert acme["privateKeySecretRef"]["name"] == \
+            "letsencrypt-prod-secret"
+
+    def test_crd_scopes(self):
+        objs = default_registry.generate("cert-manager", "certs")
+        scopes = {o["spec"]["names"]["kind"]: o["spec"]["scope"]
+                  for o in objs
+                  if o["kind"] == "CustomResourceDefinition"}
+        assert scopes == {"Certificate": "Namespaced",
+                          "Issuer": "Namespaced",
+                          "ClusterIssuer": "Cluster"}
+
+    def test_iap_cert_manager_tls(self):
+        objs = default_registry.generate(
+            "iap-ingress", "iap", tls_type="cert-manager",
+            hostname="kf.example.com")
+        cert = [o for o in objs if o["kind"] == "Certificate"][0]
+        assert cert["apiVersion"] == "cert-manager.io/v1"
+        assert cert["spec"]["dnsNames"] == ["kf.example.com"]
+        ingress = [o for o in objs if o["kind"] == "Ingress"][0]
+        # No ingress-shim annotation: the explicit Certificate is the
+        # single owner of the TLS secret.
+        assert "annotations" not in ingress["metadata"]
+        assert ingress["spec"]["tls"] == [
+            {"hosts": ["kf.example.com"],
+             "secretName": "platform-cert-tls"}]
+
+    def test_iap_rejects_unknown_tls_type(self):
+        with pytest.raises(Exception):
+            default_registry.generate("iap-ingress", "iap", tls_type="nope")
+
+
+class TestCloudEndpoints:
+    """Heir of kubeflow/core/cloud-endpoints.libsonnet:1-332."""
+
+    def test_controller_render(self):
+        objs = default_registry.generate("cloud-endpoints", "cloudep")
+        ks = kinds(objs)
+        assert ks == ["CustomResourceDefinition", "ServiceAccount",
+                      "ClusterRole", "ClusterRoleBinding", "Deployment",
+                      "Service"]
+        deploy = [o for o in objs if o["kind"] == "Deployment"][0]
+        c = deploy["spec"]["template"]["spec"]["containers"][0]
+        env = {e["name"]: e["value"] for e in c["env"]}
+        assert env["GOOGLE_APPLICATION_CREDENTIALS"] == \
+            "/var/run/secrets/sa/sa-key.json"
+
+    def test_hostname_renders_cr(self):
+        objs = default_registry.generate(
+            "cloud-endpoints", "cloudep",
+            hostname="kubeflow.endpoints.myproj.cloud.goog")
+        cr = [o for o in objs if o["kind"] == "CloudEndpoint"][0]
+        assert cr["metadata"]["name"] == "kubeflow"
+        assert cr["spec"]["project"] == "myproj"
+        assert cr["spec"]["targetIngress"]["name"] == "iap-ingress"
+
+    def test_non_cloud_goog_hostname_rejected(self):
+        with pytest.raises(Exception):
+            default_registry.generate("cloud-endpoints", "cloudep",
+                                      hostname="kf.example.com")
 
 
 class TestWholeAppRenders:

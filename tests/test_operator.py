@@ -91,6 +91,28 @@ class TestHappyPath:
         assert sel["cloud.google.com/gke-tpu-accelerator"] == \
             "tpu-v5-lite-podslice"
 
+    def test_cpu_gang_pod_shape(self):
+        """cpu-N slices (TPU-less E2E clusters, ci/run_e2e_kind.sh):
+        pods schedule anywhere, no TPU resource or selector — the
+        reference's minikube CPU-TFJob shape
+        (tf-controller-examples/tf-cnn/create_job_specs.py:111)."""
+        from kubeflow_tpu.operator import crd
+        from kubeflow_tpu.operator.gang import GangScheduler
+        from kubeflow_tpu.operator.kube import FakeKube
+        from kubeflow_tpu.operator.reconciler import TPUJobController
+
+        kube = FakeKube()
+        ctl = TPUJobController(kube, GangScheduler({"cpu-2": 1}))
+        job = crd.TPUJobSpec(name="cpujob", namespace="kubeflow",
+                             slice_type="cpu-2")
+        kube.create_custom(job.to_custom_resource())
+        ctl.reconcile_once(kube.list_custom()[0])
+        pods = kube.list_pods("kubeflow")
+        assert len(pods) == 2  # one per host
+        container = pods[0]["spec"]["containers"][0]
+        assert "google.com/tpu" not in str(container["resources"])
+        assert pods[0]["spec"]["nodeSelector"] == {}
+
 
 class TestGangSemantics:
     def test_all_or_nothing_admission(self, cluster):

@@ -1,0 +1,85 @@
+"""Golden-output regression test for the serving path.
+
+Heir of the reference's committed inference goldens: it shipped
+result.txt from a real Inception Predict and diffed serving output
+against it in CI (components/k8s-model-server/images/test-worker/
+result.txt, testing/test_tf_serving.py).  Same idea here: a
+deterministic Inception-v3 (fixed init seed, fixed input) is exported
+through the real export/load/serve stack and its scores are diffed
+against the committed artifact, so a release pipeline catches any
+numerical or contract drift in export, loaders, or the HTTP layer.
+
+Regenerate after an intentional model/serving change with:
+    KFT_UPDATE_GOLDEN=1 python -m pytest tests/test_serving_golden.py
+"""
+
+import json
+import os
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+GOLDEN = Path(__file__).parent / "golden" / "inception_predict.json"
+SEED = 20260730
+
+
+@pytest.fixture(scope="module")
+def served_api(tmp_path_factory):
+    import jax
+
+    from kubeflow_tpu.models.inception import InceptionV3
+    from kubeflow_tpu.serving.export import export
+    from kubeflow_tpu.serving.http import ServingAPI
+    from kubeflow_tpu.serving.model_server import ModelServer
+
+    base = tmp_path_factory.mktemp("models") / "inception"
+    model = InceptionV3(num_classes=16)
+    x = np.zeros((1, 96, 96, 3), np.float32)
+    variables = model.init(jax.random.key(SEED), x, train=False)
+    export(base, 1, variables,
+           loader="kubeflow_tpu.serving.loaders:classifier",
+           config={"family": "inception_v3", "num_classes": 16,
+                   "top_k": 5},
+           signature={"inputs": {"image": [None, 96, 96, 3]},
+                      "outputs": {"scores": [None, 16]}})
+    server = ModelServer()
+    server.add_model("inception", str(base))
+    return ServingAPI(server)
+
+
+def _request_image():
+    rng = np.random.RandomState(SEED)
+    return rng.uniform(-1, 1, size=(1, 96, 96, 3)).astype(np.float32)
+
+
+def test_predict_matches_golden(served_api):
+    out = served_api.predict(
+        "inception", {"instances": [{"image": _request_image()[0].tolist()}]})
+    pred = out["predictions"][0]
+    got = {
+        "scores": np.asarray(pred["scores"], np.float64).round(6).tolist(),
+        "top_k_classes": np.asarray(pred["top_k_classes"]).tolist(),
+    }
+    if os.environ.get("KFT_UPDATE_GOLDEN"):
+        GOLDEN.parent.mkdir(exist_ok=True)
+        GOLDEN.write_text(json.dumps(got, indent=2) + "\n")
+        pytest.skip("golden updated")
+    assert GOLDEN.exists(), (
+        "golden artifact missing; regenerate with KFT_UPDATE_GOLDEN=1")
+    want = json.loads(GOLDEN.read_text())
+    np.testing.assert_allclose(
+        np.asarray(got["scores"]), np.asarray(want["scores"]),
+        atol=5e-3,
+        err_msg="serving scores drifted from the committed golden",
+    )
+    # The argmax class must be stable even where scores wiggle in the
+    # last decimals (the reference's textual diff pinned exactly this).
+    assert got["top_k_classes"][0] == want["top_k_classes"][0]
+
+
+def test_metadata_signature_stable(served_api):
+    meta = served_api.metadata("inception")
+    assert meta["metadata"]["signature"]["inputs"] == {
+        "image": [None, 96, 96, 3]}
+    assert meta["model_spec"]["name"] == "inception"

@@ -58,3 +58,73 @@ class TestE2EDrivers:
 
     def test_serving_smoke(self):
         serving_smoke()
+
+
+class _FakeKubectl:
+    """Records kubectl invocations; scripted stdout per verb."""
+
+    def __init__(self):
+        self.calls = []
+        self.job_phase = "Succeeded"
+
+    def __call__(self, cmd, input=None, text=None, capture_output=None,
+                 timeout=None):
+        import types
+
+        assert cmd[0] == "kubectl"
+        self.calls.append((cmd[1:], input))
+        stdout = ""
+        if cmd[1] == "get" and "-o" in cmd:
+            stdout = ('{"status": {"phase": "%s"}}' % self.job_phase)
+        return types.SimpleNamespace(returncode=0, stdout=stdout,
+                                     stderr="")
+
+
+class TestRealClusterDrivers:
+    """The deploy-then-verify path (heir of
+    testing/test_deploy.py:160-190) against a scripted kubectl — the
+    real code path short of a live apiserver; ci/run_e2e_kind.sh runs
+    the same commands against an actual kind cluster."""
+
+    def test_deploy_applies_and_waits(self, monkeypatch):
+        import subprocess
+
+        from kubeflow_tpu.testing import e2e
+
+        fake = _FakeKubectl()
+        monkeypatch.setattr(subprocess, "run", fake)
+        e2e.deploy_real("kf-e2e")
+        verbs = [c[0][0] for c in fake.calls]
+        assert "apply" in verbs
+        applied = [c for c in fake.calls if c[0][0] == "apply"][0]
+        assert "kind: Deployment" in applied[1]
+        # Every rendered Deployment gets a rollout wait (readiness
+        # budget, test_deploy.py:188-189).
+        rollouts = [c[0] for c in fake.calls if c[0][0] == "rollout"]
+        assert len(rollouts) >= 3
+        assert all("--timeout=600s" in r for r in rollouts)
+
+    def test_tpujob_real_polls_to_success(self, monkeypatch):
+        import subprocess
+
+        from kubeflow_tpu.testing import e2e
+
+        fake = _FakeKubectl()
+        monkeypatch.setattr(subprocess, "run", fake)
+        e2e.tpujob_real("kf-e2e")
+        applied = [c for c in fake.calls if c[0][0] == "apply"][0]
+        assert "TPUJob" in applied[1]
+        assert any(c[0][0] == "get" for c in fake.calls)
+
+    def test_tpujob_real_fails_on_failed_phase(self, monkeypatch):
+        import subprocess
+
+        import pytest
+
+        from kubeflow_tpu.testing import e2e
+
+        fake = _FakeKubectl()
+        fake.job_phase = "Failed"
+        monkeypatch.setattr(subprocess, "run", fake)
+        with pytest.raises(AssertionError, match="Failed"):
+            e2e.tpujob_real("kf-e2e")

@@ -6,6 +6,7 @@ import pytest
 from kubeflow_tpu.tools.build_images import (
     TARGETS,
     build_command,
+    list_versions,
     load_version,
     release_workflow,
 )
@@ -19,6 +20,22 @@ class TestBuildImages:
             cmd = build_command(target, config, "reg.example/x")
             assert cmd[0] == "docker"
             assert f"reg.example/x/{target}:{config['tag_suffix']}" in cmd
+
+    def test_version_matrix_has_multiple_entries(self):
+        # Heir of the reference's per-TF-version configs
+        # (components/tensorflow-notebook-image/versions/*).
+        versions = list_versions()
+        assert versions[0] == "default"
+        assert len(versions) >= 2
+        seen_tags = set()
+        for version in versions:
+            config = load_version(version)
+            assert config["tag_suffix"] not in seen_tags
+            seen_tags.add(config["tag_suffix"])
+            for target in TARGETS:
+                cmd = build_command(target, config, "reg.example/x")
+                assert f"PYTHON_VERSION={config['python_version']}" in cmd
+                assert f"JAX_VERSION={config['jax_version']}" in cmd
 
     def test_release_workflow_dag(self):
         wf = release_workflow("reg.example/x", load_version())
