@@ -34,20 +34,26 @@ trap cleanup EXIT
 
 kind create cluster --name "$CLUSTER" --wait 300s
 
+# CPU-only cluster: cpu-N gangs schedule on any node (the reference's
+# minikube CPU-TFJob shape); gang logic is identical to TPU slices.
+export KFT_E2E_SLICE="cpu-1"
+
 if [ "${KFT_E2E_FULL:-0}" = "1" ]; then
   python -m kubeflow_tpu.tools.build_images --build --registry "$REGISTRY"
+  TAG="$(python -c 'from kubeflow_tpu.tools.build_images import load_version; print(load_version()["tag_suffix"])')"
   for image in worker model-server notebook operator; do
-    kind load docker-image --name "$CLUSTER" \
-      "$REGISTRY/$image:$(python -c 'from kubeflow_tpu.tools.build_images import load_version; print(load_version()["tag_suffix"])')"
+    # Manifests reference :latest; retag the versioned build to match.
+    docker tag "$REGISTRY/$image:$TAG" "$REGISTRY/$image:latest"
+    kind load docker-image --name "$CLUSTER" "$REGISTRY/$image:latest"
   done
+  # Deploy only what the locally built images can back (the hub /
+  # dashboard images are registry-published, not built here).
+  export KFT_E2E_DEPLOY="tpujob-operator"
   python -m kubeflow_tpu.testing.e2e deploy --namespace "$NAMESPACE" \
     --artifacts-dir "$ARTIFACTS_DIR"
 else
   python -m kubeflow_tpu.testing.e2e deploy-crds --namespace "$NAMESPACE" \
     --artifacts-dir "$ARTIFACTS_DIR"
-  # CPU-only cluster: cpu-N gangs schedule on any node (the reference's
-  # minikube CPU-TFJob shape); gang logic is identical to TPU slices.
-  export KFT_E2E_SLICE="cpu-1"
   python -m kubeflow_tpu.operator.main --inventory cpu-1=2 &
   OPERATOR_PID=$!
 fi

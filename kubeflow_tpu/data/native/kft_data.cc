@@ -27,9 +27,6 @@
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
-#if defined(__GLIBC__)
-#include <malloc.h>
-#endif
 #include <condition_variable>
 #include <deque>
 #include <map>
@@ -313,14 +310,11 @@ void* kft_loader_create(const char** paths, int n_paths, int n_threads,
                         int prefetch, int shuffle_buffer, uint64_t seed,
                         int repeat) {
   if (n_paths <= 0) return nullptr;
-#if defined(__GLIBC__)
-  // Record payloads are commonly 100 KiB - 1 MiB; glibc's default mmap
-  // threshold (128 KiB) would turn every such malloc/free into an
-  // mmap/munmap pair plus double page-fault traffic (once in fread, once
-  // in the consumer copy), capping throughput far below memcpy speed.
-  // Keep them on the heap freelist instead.
-  mallopt(M_MMAP_THRESHOLD, 8 << 20);
-#endif
+  // NOTE: no mallopt(M_MMAP_THRESHOLD) here even though record-sized
+  // mallocs cross glibc's mmap threshold — that knob is process-global
+  // (it would change allocator behavior for the embedding trainer and
+  // disable glibc's dynamic threshold for good).  The loader-local
+  // buffer pool below provides the reuse instead.
   auto* loader = new Loader();
   for (int i = 0; i < n_paths; ++i) loader->paths.emplace_back(paths[i]);
   loader->capacity = prefetch > 0 ? prefetch : 64;

@@ -19,7 +19,7 @@ from kubeflow_tpu.config import Prototype, default_registry, param
 from kubeflow_tpu.manifests import base
 
 DEFAULT_HUB_IMAGE = "ghcr.io/kubeflow-tpu/jupyterhub:latest"
-DEFAULT_NOTEBOOK_IMAGE = "ghcr.io/kubeflow-tpu/jax-notebook:latest"
+DEFAULT_NOTEBOOK_IMAGE = "ghcr.io/kubeflow-tpu/notebook:latest"
 
 SPAWNER_FORM = """\
 <label for='image'>Image</label>

@@ -28,8 +28,8 @@ from kubeflow_tpu.operator.crd import (
     WorkerSpec,
 )
 
-DEFAULT_OPERATOR_IMAGE = "ghcr.io/kubeflow-tpu/tpujob-operator:latest"
-DEFAULT_WORKER_IMAGE = "ghcr.io/kubeflow-tpu/jax-worker:latest"
+DEFAULT_OPERATOR_IMAGE = "ghcr.io/kubeflow-tpu/operator:latest"
+DEFAULT_WORKER_IMAGE = "ghcr.io/kubeflow-tpu/worker:latest"
 
 
 def tpujob_crd() -> dict:
@@ -56,6 +56,10 @@ def controller_config(namespace: str) -> dict:
             "scheduleToRunningP50TargetSeconds": 60,
         },
         "coordinatorPort": 8476,
+        # Slice capacity the deployed operator schedules against
+        # (operator/main.py reads this key); cpu-1 slots make CPU gangs
+        # work on TPU-less clusters out of the box.
+        "inventory": {"v5e-8": 4, "cpu-1": 4},
     }
     return base.config_map(
         "tpujob-operator-config", namespace,

@@ -25,8 +25,18 @@ def parse_inventory(pairs) -> dict:
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="kubeflow-tpu-operator")
-    ap.add_argument("--inventory", nargs="*", default=["v5e-8=4"],
+    # cpu-N gangs are schedulable anywhere, so a little CPU capacity is
+    # in the default inventory — TPU-less clusters (kind E2E) work out
+    # of the box.
+    ap.add_argument("--inventory", nargs="*",
+                    default=["v5e-8=4", "cpu-1=4"],
                     help="slice capacity, e.g. v5p-32=2 v5e-8=4")
+    ap.add_argument("--namespace", default="",
+                    help="informational; CRs are watched cluster-wide")
+    ap.add_argument("--controller-config-file", default="",
+                    help="operator ConfigMap file (manifests/tpujob.py "
+                         "controller_config); an 'inventory' key there "
+                         "overrides --inventory")
     ap.add_argument("--poll-interval-s", type=float, default=2.0)
     ap.add_argument("--max-iterations", type=int, default=0,
                     help="stop after N reconcile passes (0 = forever)")
@@ -38,6 +48,15 @@ def main(argv=None) -> int:
     from kubeflow_tpu.operator.gang import GangScheduler
     from kubeflow_tpu.operator.kube import FakeKube
     from kubeflow_tpu.operator.reconciler import TPUJobController
+
+    inventory = parse_inventory(args.inventory)
+    if args.controller_config_file:
+        import json
+
+        with open(args.controller_config_file) as f:
+            config = json.load(f)
+        if "inventory" in config:
+            inventory = {k: int(v) for k, v in config["inventory"].items()}
 
     if args.fake_kube:
         kube = FakeKube()
@@ -51,11 +70,8 @@ def main(argv=None) -> int:
                 "no cluster access (%s); use --fake-kube for local runs", e
             )
             return 1
-    controller = TPUJobController(
-        kube, GangScheduler(parse_inventory(args.inventory))
-    )
-    logging.info("operator up; inventory=%s",
-                 parse_inventory(args.inventory))
+    controller = TPUJobController(kube, GangScheduler(inventory))
+    logging.info("operator up; inventory=%s", inventory)
     controller.run(poll_interval_s=args.poll_interval_s,
                    max_iterations=args.max_iterations)
     return 0

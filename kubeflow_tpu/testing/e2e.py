@@ -123,17 +123,23 @@ def deploy_real(namespace: str = "kubeflow-test") -> None:
     (testing/test_deploy.py:160-190 deploy-then-verify; cluster may be
     kind/minikube/GKE, exactly as prow_config.yaml parameterised it).
 
-    Renders kubeflow-core + the operator through the same registry path a
-    user drives, applies it, then waits for every Deployment to roll out
-    within the reference's 10-minute readiness budget
-    (test_deploy.py:188-189).
+    Renders the platform through the same registry path a user drives,
+    applies it, then waits for every Deployment to roll out within the
+    reference's 10-minute readiness budget (test_deploy.py:188-189).
+    KFT_E2E_DEPLOY selects the prototypes (comma-separated; default the
+    full kubeflow-core — clusters that can only pull a subset of images,
+    e.g. kind with locally built ones, set e.g. `tpujob-operator`).
     """
+    import os
+
     import kubeflow_tpu.manifests  # noqa: F401 — registers prototypes
     from kubeflow_tpu.config.registry import App
     from kubeflow_tpu.manifests.base import to_yaml
 
     app = App()
-    app.add("kubeflow-core", "core", namespace=namespace)
+    prototypes = os.environ.get("KFT_E2E_DEPLOY", "kubeflow-core")
+    for i, proto in enumerate(p.strip() for p in prototypes.split(",")):
+        app.add(proto, f"c{i}-{proto}", namespace=namespace)
     objects = app.render()
     _kubectl(["create", "namespace", namespace,
               "--dry-run=client", "-o", "yaml"])  # validates kubectl works

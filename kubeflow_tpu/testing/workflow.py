@@ -128,3 +128,78 @@ def default_e2e(name: str = "e2e", namespace: str = "kubeflow-test",
             ["gsutil", "-m", "cp", "-r", "/artifacts",
              artifacts_gcs], image=image))
     return wf
+
+
+# Per-platform default step lists (ci/e2e_config.yaml's `steps:` values
+# resolve to kubeflow_tpu.testing.e2e subcommands).
+PLATFORM_STEPS = {
+    "hermetic": ["tpujob", "serving", "train"],
+    "kind": ["deploy-crds", "tpujob-real"],
+    "gke": ["deploy", "tpujob-real"],
+}
+
+
+def platform_e2e(platform: str, steps: Optional[List[str]] = None,
+                 name: str = "", namespace: str = "kubeflow-test",
+                 image: str = "ghcr.io/kubeflow-tpu/worker:latest",
+                 artifacts_gcs: str = "") -> E2EWorkflow:
+    """Render the DAG for one ci/e2e_config.yaml entry (the heir of the
+    reference's per-platform workflow params, prow_config.yaml:3-15)."""
+    if platform not in PLATFORM_STEPS:
+        raise ValueError(
+            f"unknown platform {platform!r}; known: {sorted(PLATFORM_STEPS)}")
+    steps = steps or PLATFORM_STEPS[platform]
+    wf = E2EWorkflow(name or f"e2e-{platform}", namespace, artifacts_gcs)
+    wf.add_step(Step("checkout", ["git", "clone",
+                                 "https://github.com/kubeflow-tpu/"
+                                 "kubeflow-tpu", "/src"], image=image))
+    prev = "checkout"
+    for step_name in steps:
+        wf.add_step(Step(
+            step_name,
+            ["python", "-m", "kubeflow_tpu.testing.e2e", step_name,
+             "--namespace", namespace],
+            image=image, deps=[prev]))
+        prev = step_name
+    wf.add_exit_step(Step(
+        "teardown",
+        ["python", "-m", "kubeflow_tpu.testing.e2e", "teardown",
+         "--namespace", namespace], image=image))
+    if artifacts_gcs:
+        wf.add_exit_step(Step(
+            "copy-artifacts",
+            ["gsutil", "-m", "cp", "-r", "/artifacts", artifacts_gcs],
+            image=image))
+    return wf
+
+
+def main(argv=None) -> int:
+    """`python -m kubeflow_tpu.testing.workflow --platform=gke` prints the
+    Argo Workflow JSON for a CI trigger to submit."""
+    import argparse
+    import json
+    import sys
+
+    ap = argparse.ArgumentParser(prog="kubeflow-tpu-workflow")
+    ap.add_argument("--platform", default="hermetic",
+                    choices=sorted(PLATFORM_STEPS))
+    ap.add_argument("--steps", default="",
+                    help="comma-separated e2e subcommands (default: the "
+                         "platform's list)")
+    ap.add_argument("--name", default="")
+    ap.add_argument("--namespace", default="kubeflow-test")
+    ap.add_argument("--artifacts-gcs", default="")
+    args = ap.parse_args(argv)
+    steps = [s.strip() for s in args.steps.split(",") if s.strip()] or None
+    wf = platform_e2e(args.platform, steps, name=args.name,
+                      namespace=args.namespace,
+                      artifacts_gcs=args.artifacts_gcs)
+    json.dump(wf.to_custom_resource(), sys.stdout, indent=2)
+    print()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())
