@@ -1,0 +1,125 @@
+#!/usr/bin/env python
+"""First-party lint gate — stdlib-only, zero dependencies.
+
+Heir of the reference's formatting-as-a-build-step gates
+(scripts/autoformat_jsonnet.sh:17-30 rewrote + diffed jsonnet in CI;
+build/check_boilerplate.sh enforced file headers via Makefile:15-18).
+The build environment bakes in no third-party linter, so the gate is a
+deterministic AST/text checker enforcing the rules this codebase
+actually keeps:
+
+  parse        every .py file parses (ast)
+  docstring    every kubeflow_tpu module opens with a docstring
+  line-length  <= 88 columns (generated protos + a grandfather list
+               excepted; the list may only shrink)
+  whitespace   no tabs in indentation, no trailing whitespace
+  banned       datetime.utcnow (deprecated), pdb.set_trace/breakpoint
+               (debug leftovers), TODO/FIXME/XXX markers (track work in
+               VERDICT/tasks, not code), bare NotImplementedError stubs
+
+Run: python ci/lint.py [--root DIR].  Exit 0 = clean.  Wired into CI as
+the ``lint`` workflow step (ci/e2e_config.yaml) and executed by the test
+suite (tests/test_lint.py) so every pytest run is also a lint run.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import pathlib
+import re
+import sys
+from typing import Iterator, List, Tuple
+
+MAX_LINE = 88
+
+SKIP_DIRS = {"__pycache__", ".git", ".pytest_cache", "artifacts",
+             "node_modules", ".claude"}
+
+# Generated code is exempt from style rules (still must parse).
+GENERATED = {"kubeflow_tpu/serving/protos/prediction_pb2.py"}
+
+# The gate and its test speak the banned patterns by name.
+SELF = {"ci/lint.py", "tests/test_lint.py"}
+
+# Pre-gate lines slightly over budget (89-96 cols, mostly long reference
+# citations).  Entries may be removed as files are touched, never added.
+GRANDFATHER_LONG = {
+    "kubeflow_tpu/runtime/topology.py",
+    "kubeflow_tpu/operator/crd.py",
+    "kubeflow_tpu/tools/cli.py",
+    "kubeflow_tpu/manifests/base.py",
+    "kubeflow_tpu/manifests/jupyterhub.py",
+}
+
+BANNED = [
+    (re.compile(r"datetime\.utcnow\s*\("), "datetime.utcnow() is "
+     "deprecated; use datetime.now(timezone.utc)"),
+    (re.compile(r"\bpdb\.set_trace\s*\("), "debug leftover"),
+    (re.compile(r"(?<![\w.])breakpoint\s*\("), "debug leftover"),
+    (re.compile(r"#.*\b(TODO|FIXME|XXX)\b"), "work marker in code"),
+    (re.compile(r"raise\s+NotImplementedError"), "unimplemented stub"),
+]
+
+
+def py_files(root: pathlib.Path) -> Iterator[pathlib.Path]:
+    for path in sorted(root.rglob("*.py")):
+        if not SKIP_DIRS.intersection(path.relative_to(root).parts):
+            yield path
+
+
+def check_file(path: pathlib.Path, root: pathlib.Path) -> List[str]:
+    rel = path.relative_to(root).as_posix()
+    problems: List[str] = []
+    text = path.read_text(encoding="utf-8")
+    try:
+        tree = ast.parse(text)
+    except SyntaxError as e:
+        return [f"{rel}:{e.lineno}: syntax error: {e.msg}"]
+
+    if rel.startswith("kubeflow_tpu/") and ast.get_docstring(tree) is None:
+        problems.append(f"{rel}:1: module docstring required")
+
+    if rel in GENERATED:
+        return problems
+
+    for lineno, line in enumerate(text.splitlines(), 1):
+        if len(line) > MAX_LINE and rel not in GRANDFATHER_LONG:
+            problems.append(
+                f"{rel}:{lineno}: line too long ({len(line)} > {MAX_LINE})")
+        if line.rstrip() != line:
+            problems.append(f"{rel}:{lineno}: trailing whitespace")
+        indent = line[:len(line) - len(line.lstrip())]
+        if "\t" in indent:
+            problems.append(f"{rel}:{lineno}: tab in indentation")
+        if rel not in SELF:
+            for pattern, why in BANNED:
+                if pattern.search(line):
+                    problems.append(f"{rel}:{lineno}: banned: {why}")
+    return problems
+
+
+def run(root: pathlib.Path) -> Tuple[int, List[str]]:
+    problems: List[str] = []
+    n = 0
+    for path in py_files(root):
+        n += 1
+        problems.extend(check_file(path, root))
+    return n, problems
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--root", default=".",
+                    help="repo root to lint (default: cwd)")
+    args = ap.parse_args(argv)
+    n, problems = run(pathlib.Path(args.root).resolve())
+    for p in problems:
+        print(p)
+    print(f"lint: {n} files checked, {len(problems)} problem(s)",
+          file=sys.stderr)
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

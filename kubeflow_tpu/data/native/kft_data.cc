@@ -44,6 +44,70 @@ struct Record {
   uint64_t len;
 };
 
+// One tensor slot of the KTE1 payload schema (data/loader.py
+// encode_example: 'KTE1', u16 n_keys, then per key [u16 klen][u16 dlen]
+// [key][dtype][u8 ndim][i64 shape*ndim][u64 nbytes][raw bytes]).
+struct SchemaEntry {
+  std::string key;
+  std::string dtype;
+  std::vector<int64_t> shape;
+  uint64_t nbytes = 0;
+};
+
+struct TensorView {
+  const uint8_t* data;
+  uint64_t nbytes;
+};
+
+// Parse a KTE1 payload; fills entries (schema) and views (raw tensor
+// bytes, aliasing `p`).  Returns false on malformed input.
+static bool parse_kte1(const uint8_t* p, uint64_t len,
+                       std::vector<SchemaEntry>* entries,
+                       std::vector<TensorView>* views) {
+  if (len < 6 || memcmp(p, "KTE1", 4) != 0) return false;
+  uint16_t n_keys;
+  memcpy(&n_keys, p + 4, 2);
+  uint64_t off = 6;
+  entries->clear();
+  views->clear();
+  for (uint16_t k = 0; k < n_keys; ++k) {
+    if (off + 4 > len) return false;
+    uint16_t klen, dlen;
+    memcpy(&klen, p + off, 2);
+    memcpy(&dlen, p + off + 2, 2);
+    off += 4;
+    if (off + klen + dlen + 1 > len) return false;
+    SchemaEntry e;
+    e.key.assign(reinterpret_cast<const char*>(p + off), klen);
+    off += klen;
+    e.dtype.assign(reinterpret_cast<const char*>(p + off), dlen);
+    off += dlen;
+    uint8_t ndim = p[off++];
+    if (off + 8ull * ndim + 8 > len) return false;
+    e.shape.resize(ndim);
+    memcpy(e.shape.data(), p + off, 8ull * ndim);
+    off += 8ull * ndim;
+    memcpy(&e.nbytes, p + off, 8);
+    off += 8;
+    // Subtraction form: `off + e.nbytes > len` can wrap for nbytes
+    // near 2^64 and pass the check with an out-of-range view.
+    if (e.nbytes > len - off) return false;
+    views->push_back(TensorView{p + off, e.nbytes});
+    off += e.nbytes;
+    entries->push_back(std::move(e));
+  }
+  return true;
+}
+
+// numpy dtype strings carry the itemsize as their trailing digits
+// ('<f4' -> 4, '|u1' -> 1).  0 = unparsable.
+static uint64_t dtype_itemsize(const std::string& dtype) {
+  size_t i = dtype.size();
+  while (i > 0 && isdigit(static_cast<unsigned char>(dtype[i - 1]))) --i;
+  if (i == dtype.size()) return 0;
+  return strtoull(dtype.c_str() + i, nullptr, 10);
+}
+
 // Records staged per lock crossing.  Small enough that batch latency is
 // invisible next to a train step, large enough to amortise the mutex.
 constexpr size_t kBatchRecords = 16;
@@ -140,6 +204,7 @@ struct Loader {
     for (auto& t : readers) {
       if (t.joinable()) t.join();
     }
+    if (has_pending) free(pending.data);
     for (auto& batch : buffer)
       for (auto& r : batch) free(r.data);
     for (size_t i = staged_pos; i < staged.size(); ++i)
@@ -215,7 +280,18 @@ struct Loader {
         break;
       }
       uint64_t len = len_le;
+      // A corrupt length prefix must surface as a loader error, not a
+      // multi-GiB malloc; no KFTR shard record is anywhere near this.
+      static const uint64_t kMaxRecordBytes = 1ull << 30;
+      if (len > kMaxRecordBytes) {
+        fail("record length exceeds 1 GiB cap (corrupt shard?)", path);
+        break;
+      }
       uint8_t* data = alloc(len);
+      if (data == nullptr) {
+        fail("allocation failed", path);
+        break;
+      }
       if (len && fread(data, 1, len, f) != len) {
         void* p = data;
         release_batch(&p, 1);
@@ -281,6 +357,12 @@ struct Loader {
     }
     return n;
   }
+
+  // Stacked-batch state: the schema locked in by the first record, plus
+  // a pending record held between schema peek and the first fill.
+  std::vector<SchemaEntry> schema;
+  Record pending{nullptr, 0};
+  bool has_pending = false;
 
   // Shuffled next: keep a reservoir topped up; emit a random element.
   bool next(Record* out) {
@@ -373,6 +455,131 @@ int kft_loader_next_batch(void* handle, void** datas, uint64_t* lens,
 // Return consumed buffers to the loader's pool for reader reuse.
 void kft_loader_free_batch(void* handle, void** datas, int n) {
   static_cast<Loader*>(handle)->release_batch(datas, n);
+}
+
+// ---------------------------------------------------------------------
+// Stacked batches: KTE1 decode + batch assembly inside the core.
+//
+// The per-record handout path costs two python-side copies per record
+// (ctypes bytes, then np.stack) plus a GIL-bound decode loop; for
+// batch-consuming trainers that loop IS the pipeline bottleneck.  Here
+// the consumer instead asks the core to fill ONE contiguous buffer per
+// schema key with `batch` records' tensors — python wraps the buffers
+// zero-copy, so the python cost per BATCH is a ctypes call and a dict.
+// ---------------------------------------------------------------------
+
+// Peek the schema from the next record (held pending, not consumed).
+// Writes "key|dtype|d0,d1;..." into buf.  Returns bytes written,
+// 0 on end-of-data, -1 on error (not KTE1 / malformed / buf too small).
+int kft_loader_schema(void* handle, char* buf, int buf_len) {
+  auto* loader = static_cast<Loader*>(handle);
+  if (!loader->has_pending) {
+    if (!loader->next(&loader->pending)) return 0;
+    loader->has_pending = true;
+  }
+  std::vector<TensorView> views;
+  if (!parse_kte1(loader->pending.data, loader->pending.len,
+                  &loader->schema, &views)) {
+    loader->fail("not a KTE1 payload", "stacked batch");
+    return -1;
+  }
+  // Lock-in validation: the consumer sizes its per-key buffers from
+  // shape x dtype, and fill_batch memcpys nbytes — any disagreement
+  // (corrupt or crafted record) would be a heap overflow, so it is an
+  // error here, not later.  Keys must also survive the '|'/';'-joined
+  // schema wire (the python side rejects such keys at encode time;
+  // foreign shards fall back to the python decode path).
+  for (const auto& e : loader->schema) {
+    if (e.key.find('|') != std::string::npos ||
+        e.key.find(';') != std::string::npos) {
+      loader->fail("key contains schema separator", "stacked batch");
+      return -1;
+    }
+    uint64_t itemsize = dtype_itemsize(e.dtype);
+    uint64_t count = 1;
+    for (int64_t d : e.shape) {
+      if (d < 0) { count = 0; break; }
+      count *= static_cast<uint64_t>(d);
+    }
+    if (itemsize == 0 || count * itemsize != e.nbytes) {
+      loader->fail("record nbytes disagrees with shape x dtype",
+                   "stacked batch");
+      return -1;
+    }
+  }
+  std::string out;
+  for (size_t i = 0; i < loader->schema.size(); ++i) {
+    const auto& e = loader->schema[i];
+    if (i) out += ';';
+    out += e.key;
+    out += '|';
+    out += e.dtype;
+    out += '|';
+    for (size_t d = 0; d < e.shape.size(); ++d) {
+      if (d) out += ',';
+      out += std::to_string(e.shape[d]);
+    }
+  }
+  if (static_cast<int>(out.size()) + 1 > buf_len) {
+    loader->fail("schema buffer too small", "stacked batch");
+    return -1;
+  }
+  memcpy(buf, out.c_str(), out.size() + 1);
+  return static_cast<int>(out.size());
+}
+
+// Fill caller-allocated per-key buffers with up to `batch` records.
+// dests[k] must hold batch * schema[k].nbytes bytes.  Every record must
+// match the locked-in schema (keys, order, dtype, shape).  Returns rows
+// filled (0 = end-of-data), or -1 with the error set.
+int kft_loader_fill_batch(void* handle, void** dests, int n_keys,
+                          int batch) {
+  auto* loader = static_cast<Loader*>(handle);
+  if (loader->schema.empty()) {
+    char tmp[4096];
+    int rc = kft_loader_schema(handle, tmp, sizeof(tmp));
+    if (rc <= 0) return rc;
+  }
+  if (n_keys != static_cast<int>(loader->schema.size())) {
+    loader->fail("schema key-count mismatch", "stacked batch");
+    return -1;
+  }
+  std::vector<SchemaEntry> entries;
+  std::vector<TensorView> views;
+  int row = 0;
+  Record r;
+  while (row < batch) {
+    if (loader->has_pending) {
+      r = loader->pending;
+      loader->has_pending = false;
+    } else if (!loader->next(&r)) {
+      break;
+    }
+    bool ok = parse_kte1(r.data, r.len, &entries, &views);
+    if (ok) {
+      for (int k = 0; ok && k < n_keys; ++k) {
+        const auto& want = loader->schema[k];
+        const auto& got = entries[k];
+        ok = got.key == want.key && got.dtype == want.dtype &&
+             got.shape == want.shape && got.nbytes == want.nbytes;
+      }
+    }
+    if (!ok) {
+      void* p = r.data;
+      loader->release_batch(&p, 1);
+      loader->fail("record does not match batch schema", "stacked batch");
+      return -1;
+    }
+    for (int k = 0; k < n_keys; ++k) {
+      memcpy(static_cast<uint8_t*>(dests[k]) +
+                 static_cast<uint64_t>(row) * loader->schema[k].nbytes,
+             views[k].data, views[k].nbytes);
+    }
+    void* p = r.data;
+    loader->release_batch(&p, 1);
+    ++row;
+  }
+  return row;
 }
 
 // Handle-less variants (no pooling): for buffers from kft_loader_next.

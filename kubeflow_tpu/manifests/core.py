@@ -80,6 +80,7 @@ def central_dashboard_manifests(namespace: str, image: str) -> List[dict]:
             containers=[base.container(
                 "centraldashboard", image,
                 command=["python", "-m", "kubeflow_tpu.tools.dashboard"],
+                args=["--mode=central", "--port=8082"],
                 ports=[8082],
             )],
             service_account="centraldashboard",

@@ -115,9 +115,13 @@ cloud_endpoints_prototype = default_registry.register(Prototype(
         "controller syncing cloud.goog names to the ingress IP",
     params=[
         param("namespace", str, "kubeflow", "target namespace"),
+        # Third-party controller consumed as an external image, exactly
+        # as the reference consumed it (cloud-endpoints.libsonnet used
+        # gcr.io/cloud-solutions-group/cloud-endpoints-controller) and
+        # as Ambassador/envoy are consumed here.
         param("controller_image", str,
-              "ghcr.io/kubeflow-tpu/cloud-endpoints-controller:latest",
-              "controller image"),
+              "gcr.io/cloud-solutions-group/cloud-endpoints-controller:"
+              "0.2.1", "controller image (third-party, external)"),
         param("secret_name", str, "cloudep-sa",
               "secret holding the GCP service-account key"),
         param("secret_key", str, "sa-key.json",

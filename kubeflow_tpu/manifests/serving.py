@@ -2,8 +2,9 @@
 
 Re-provides the reference package's full parameter surface
 (kubeflow/tf-serving/tf-serving.libsonnet): model server Deployment +
-Service (gRPC-era :9000 folded into the one REST port :8000 our server
-exposes), Ambassador route annotations (:247-267), the storage
+Service with the same two-protocol split — gRPC PredictionService :9000
+(:118-132, the reference's primary protocol) and REST :8000 (:176-207)
+— Ambassador route annotations (:247-267), the storage
 credential mixins — GCS service-account secret mount (:342-382), S3 env
 plumbing (:310-339), NFS PVC mount (:151-155) — and the optional Istio
 mesh integration (sidecar inject + versioned routing, the capability of
@@ -22,6 +23,7 @@ from kubeflow_tpu.config.registry import default_registry
 from kubeflow_tpu.manifests import base
 
 SERVE_PORT = 8000
+GRPC_PORT = 9000  # same port the reference's model server bound
 
 
 def s3_env(params: Dict[str, Any]) -> List[dict]:
@@ -122,8 +124,12 @@ def _generate_serving(component_name: str, **p: Any) -> List[dict]:
             f"--model_name={p['model_name']}",
             f"--model_base_path={p['model_base_path']}",
             f"--port={SERVE_PORT}",
+            f"--grpc_port={GRPC_PORT}",
         ],
-        "ports": [{"containerPort": SERVE_PORT}],
+        "ports": [
+            {"containerPort": SERVE_PORT, "name": "http"},
+            {"containerPort": GRPC_PORT, "name": "grpc"},
+        ],
         "env": env,  # may contain valueFrom secretKeyRef entries
         "resources": {
             "limits": base.tpu_resource_limits(p["slice_type"])["limits"]
@@ -163,7 +169,8 @@ def _generate_serving(component_name: str, **p: Any) -> List[dict]:
         )}
     svc = base.service(
         name=name, namespace=namespace, selector=labels,
-        ports=[base.port(SERVE_PORT, "http")],
+        ports=[base.port(SERVE_PORT, "http"),
+               base.port(GRPC_PORT, "grpc")],
         annotations=annotations,
         labels=labels,
     )

@@ -115,6 +115,7 @@ def dashboard_manifests(name: str = "tpujob-dashboard",
         base.pod_spec(containers=[base.container(
             name, image,
             command=["python", "-m", "kubeflow_tpu.tools.dashboard"],
+            args=["--mode=tpujobs", "--port=8080"],
             ports=[8080],
         )], service_account="tpujob-operator"),
     )

@@ -1,0 +1,1 @@
+"""Model families: ResNet/Inception classifiers, Transformer LM, MoE."""

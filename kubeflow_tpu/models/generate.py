@@ -103,22 +103,24 @@ def _forward_with_cache(cfg: TransformerConfig, params, tokens, cache,
     positions = jnp.broadcast_to(positions, tokens.shape)
 
     layer_stack = params["layers"]
-    n_layers = cfg.n_layers
 
-    def body(carry, idx):
-        x, cache_k, cache_v = carry
-        layer_params = jax.tree_util.tree_map(lambda a: a[idx], layer_stack)
+    # The caches ride the scan as xs/ys (sliced per layer on the leading
+    # axis, re-stacked from the per-layer outputs) — NOT as carry with
+    # `cache.at[idx].set(...)`.  Indexed whole-cache updates in the body
+    # compile to a copy of the full [L, b, s, h, d] buffer per layer per
+    # token (measured 235 ms/token for a 188M model on v5e — ~20 GB of
+    # HBM traffic per 128-token request); scan ys write each layer's
+    # slice in place.
+    def body(x, inputs):
+        layer_params, ck, cv = inputs
         x, (ck, cv) = _layer_step(
-            cfg, layer_params, x,
-            (cache_k[idx], cache_v[idx]), cache_len, positions,
+            cfg, layer_params, x, (ck, cv), cache_len, positions,
         )
-        cache_k = cache_k.at[idx].set(ck)
-        cache_v = cache_v.at[idx].set(cv)
-        return (x, cache_k, cache_v), None
+        return x, (ck, cv)
 
     cache_k, cache_v = cache
-    (x, cache_k, cache_v), _ = jax.lax.scan(
-        body, (x, cache_k, cache_v), jnp.arange(n_layers))
+    x, (cache_k, cache_v) = jax.lax.scan(
+        body, x, (layer_stack, cache_k, cache_v))
 
     scale = params["final_norm"]["scale"]
     x32 = x.astype(jnp.float32)

@@ -51,6 +51,11 @@ class FakeKube:
         self.custom: Dict[Tuple[str, str], ObjectDict] = {}
         self.events: List[ObjectDict] = []
         self.deleted_pods: List[str] = []
+        self.nodes: List[ObjectDict] = []
+
+    def list_nodes(self) -> List[ObjectDict]:
+        with self._lock:
+            return copy.deepcopy(self.nodes)
 
     # -- pods -------------------------------------------------------------
 

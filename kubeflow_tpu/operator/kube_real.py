@@ -60,6 +60,11 @@ class RealKube:
     def delete_pod(self, namespace: str, name: str) -> None:
         self._wrap(self._core.delete_namespaced_pod, name, namespace)
 
+    def list_nodes(self) -> List[ObjectDict]:
+        out = self._wrap(self._core.list_node)
+        return [self._core.api_client.sanitize_for_serialization(n)
+                for n in out.items]
+
     # -- services ---------------------------------------------------------
 
     def create_service(self, svc: ObjectDict) -> ObjectDict:
@@ -123,7 +128,8 @@ class RealKube:
                 "message": message,
                 "type": type_,
                 "firstTimestamp":
-                    datetime.datetime.utcnow().isoformat() + "Z",
+                    datetime.datetime.now(datetime.timezone.utc)
+                    .strftime("%Y-%m-%dT%H:%M:%SZ"),
             })
         except Exception:
             pass

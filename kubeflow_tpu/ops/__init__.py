@@ -1,0 +1,1 @@
+"""TPU kernels and attention ops (Pallas flash attention et al.)."""

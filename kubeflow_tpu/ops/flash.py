@@ -23,7 +23,7 @@ skip fully-masked blocks via predication (half the FLOPs back).
 
 Off-TPU the public entrypoint falls back to ops/attention.py so the CPU
 fake-slice tests stay hermetic; the kernels themselves are additionally
-tested under the Pallas interpreter (tests/test_flash.py).
+tested under the Pallas interpreter (tests/test_ops.py).
 
 Heritage: the reference's attention lived inside external TF binaries
 (SURVEY.md §2.2); this module is new, TPU-first capability.

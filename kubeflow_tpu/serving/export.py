@@ -71,7 +71,14 @@ def export(
     if final.exists():
         raise FileExistsError(f"version {version} already exists at {final}")
     tmp.mkdir(parents=True, exist_ok=True)
-    (tmp / PARAMS_FILE).write_bytes(serialization.to_bytes(variables))
+    # Unbox partitioning metadata (nn.Partitioned wrappers from
+    # with_logical_partitioning): serialized boxes restore as plain
+    # dicts, which loaders would then have to special-case.  Sharding at
+    # serve time is the server's decision, not the artifact's.
+    from flax import linen as nn
+
+    (tmp / PARAMS_FILE).write_bytes(
+        serialization.to_bytes(nn.unbox(variables)))
     (tmp / MODEL_FILE).write_text(json.dumps({
         "format": "kubeflow-tpu/1",
         "loader": loader,

@@ -4,8 +4,10 @@ Flag-compatible heir of the model server invocation the reference's
 manifests assembled: ``tensorflow_model_server --port=9000
 --model_name=... --model_base_path=...``
 (kubeflow/tf-serving/tf-serving.libsonnet:118-132) plus the http proxy's
-``--port 8000`` sidecar (:176-207) — here one process serves both the
-REST contract and (optionally) warm models on the local TPU.
+``--port 8000`` sidecar (:176-207) — here one process serves both wire
+protocols over one set of warm models on the local TPU: the gRPC
+PredictionService on ``--grpc_port`` (:9000, the reference's primary
+protocol) and the REST contract on ``--port`` (:8000).
 """
 
 from __future__ import annotations
@@ -26,6 +28,9 @@ def main(argv=None) -> int:
     ap.add_argument("--model_base_path", required=True)
     ap.add_argument("--port", type=int, default=8000,
                     help="REST port (reference http-proxy contract)")
+    ap.add_argument("--grpc_port", type=int, default=9000,
+                    help="gRPC PredictionService port (reference "
+                         "tensorflow_model_server contract); -1 disables")
     ap.add_argument("--poll_interval_s", type=float, default=2.0,
                     help="model version poll period (hot-swap latency)")
     ap.add_argument("--host", default="0.0.0.0")
@@ -36,13 +41,32 @@ def main(argv=None) -> int:
     server.add_model(args.model_name, args.model_base_path)
     server.start_watcher()
     httpd, _ = make_http_server(server, port=args.port, host=args.host)
-    logging.info("serving %r on :%d", args.model_name, args.port)
+    grpc_server = None
+    if args.grpc_port >= 0:
+        # Deferred import: grpcio is the [serving] extra; a REST-only
+        # deployment (--grpc_port -1) must run without it installed.
+        from kubeflow_tpu.serving.grpc_server import make_grpc_server
+
+        grpc_server = make_grpc_server(server, port=args.grpc_port,
+                                       host=args.host)
+        logging.info("serving %r on rest=:%d grpc=:%d", args.model_name,
+                     args.port, grpc_server.bound_port)
+    else:
+        logging.info("serving %r on rest=:%d (grpc disabled)",
+                     args.model_name, args.port)
+    # Readiness marker for process-spawning tests/orchestration: the
+    # bound ports, on one parseable stderr line, after both servers are up.
+    print(f"KFT_SERVING_READY rest={httpd.server_address[1]} "
+          f"grpc={grpc_server.bound_port if grpc_server else -1}",
+          file=sys.stderr, flush=True)
 
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *_: stop.set())
     signal.signal(signal.SIGINT, lambda *_: stop.set())
     stop.wait()
     httpd.shutdown()
+    if grpc_server is not None:
+        grpc_server.stop(grace=1)
     server.stop()
     return 0
 

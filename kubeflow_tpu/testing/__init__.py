@@ -1,0 +1,1 @@
+"""E2E test harness: drivers, JUnit artifacts, Argo-style DAG renderer."""

@@ -1,0 +1,1 @@
+"""Operator/user CLIs and build tooling."""

@@ -24,7 +24,8 @@ from typing import Dict, List
 
 REPO_ROOT = Path(__file__).resolve().parents[2]
 VERSIONS_DIR = REPO_ROOT / "docker" / "versions"
-TARGETS = ("worker", "model-server", "notebook", "operator")
+TARGETS = ("worker", "model-server", "notebook", "operator", "jupyterhub",
+           "centraldashboard", "tpujob-dashboard", "telemetry", "torch-xla")
 
 
 def load_version(version: str = "default") -> dict:

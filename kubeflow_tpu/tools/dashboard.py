@@ -1,0 +1,201 @@
+"""Platform dashboards: central landing page + TPUJob job browser.
+
+One module, two modes, matching the two reference UIs it re-provides:
+
+``--mode=central`` (default, :8082) — the landing page, heir of the
+central dashboard (kubeflow/core/centraldashboard.libsonnet:20,38 and
+the 20-line Go static server at
+components/centraldashboard/frontend/dashboard.go:13-19): links to the
+gateway routes the core package wires up (hub, TPUJob dashboard,
+TensorBoard), plus /healthz.
+
+``--mode=tpujobs`` (:8080) — the TPUJob browser, heir of the tf-job
+dashboard (kubeflow/core/tf-job-operator.libsonnet:417-450): lists
+TPUJob custom resources with phase/slice/restart info, as an HTML table
+at ``/tpujobs/`` and JSON at ``/tpujobs/api/jobs``.  Reads CRs through
+the same kube interface the operator uses (RealKube in-cluster;
+anything FakeKube-shaped in tests).
+
+stdlib http.server only — the containers stay single-process with no
+web framework (same reasoning as serving/http.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import re
+import sys
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional, Tuple
+
+log = logging.getLogger(__name__)
+
+LINKS = [
+    ("JupyterHub notebooks", "/hub/"),
+    ("TPUJob dashboard", "/tpujobs/"),
+    ("TensorBoard", "/tensorboard/"),
+]
+
+_PAGE = """<!doctype html>
+<html><head><title>{title}</title>
+<style>
+ body {{ font-family: system-ui, sans-serif; margin: 3em; }}
+ table {{ border-collapse: collapse; }}
+ td, th {{ border: 1px solid #ccc; padding: .4em .8em; text-align: left; }}
+ h1 {{ font-weight: 600; }}
+</style></head>
+<body><h1>{title}</h1>
+{body}
+</body></html>
+"""
+
+
+def render_central() -> str:
+    items = "\n".join(
+        f'<li><a href="{href}">{label}</a></li>'
+        for label, href in LINKS
+    )
+    return _PAGE.format(title="Kubeflow-TPU",
+                        body=f"<ul>\n{items}\n</ul>")
+
+
+def job_rows(kube, namespace: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Flatten TPUJob CRs into display rows (phase/slice/restarts)."""
+    rows = []
+    for cr in kube.list_custom(namespace=namespace):
+        spec = cr.get("spec", {})
+        status = cr.get("status", {})
+        rows.append({
+            "name": cr.get("metadata", {}).get("name", "?"),
+            "namespace": cr.get("metadata", {}).get("namespace", "?"),
+            "phase": status.get("phase", "Pending"),
+            "slice_type": spec.get("sliceType", ""),
+            "num_slices": spec.get("numSlices", 1),
+            "restarts": status.get("restarts", 0),
+        })
+    return rows
+
+
+def render_tpujobs(rows: List[Dict[str, Any]]) -> str:
+    header = ("<tr><th>namespace</th><th>name</th><th>phase</th>"
+              "<th>slice</th><th>#slices</th><th>restarts</th></tr>")
+    body_rows = "\n".join(
+        "<tr>" + "".join(
+            f"<td>{r[k]}</td>" for k in
+            ("namespace", "name", "phase", "slice_type", "num_slices",
+             "restarts")
+        ) + "</tr>"
+        for r in rows
+    )
+    table = f"<table>\n{header}\n{body_rows}\n</table>" if rows else \
+        "<p>No TPUJobs.</p>"
+    return _PAGE.format(title="TPUJobs", body=table)
+
+
+class DashboardAPI:
+    """Transport-independent handlers (shared by tests + HTTP)."""
+
+    def __init__(self, mode: str, kube=None):
+        self.mode = mode
+        self.kube = kube
+
+    def routes(self) -> List[Tuple[str, "re.Pattern", str]]:
+        if self.mode == "central":
+            return [
+                ("GET", re.compile(r"^/(index\.html)?$"), "central"),
+                ("GET", re.compile(r"^/healthz$"), "health"),
+            ]
+        return [
+            ("GET", re.compile(r"^/tpujobs/?$"), "tpujobs_html"),
+            ("GET", re.compile(r"^/tpujobs/api/jobs$"), "tpujobs_json"),
+            ("GET", re.compile(r"^/healthz$"), "health"),
+        ]
+
+    def central(self) -> Tuple[str, str]:
+        return render_central(), "text/html"
+
+    def health(self) -> Tuple[str, str]:
+        return json.dumps({"status": "ok", "mode": self.mode}), \
+            "application/json"
+
+    def tpujobs_html(self) -> Tuple[str, str]:
+        return render_tpujobs(job_rows(self.kube)), "text/html"
+
+    def tpujobs_json(self) -> Tuple[str, str]:
+        return json.dumps({"jobs": job_rows(self.kube)}), \
+            "application/json"
+
+
+class _Handler(BaseHTTPRequestHandler):
+    api: DashboardAPI  # set by make_server
+
+    def log_message(self, fmt, *args):
+        log.debug("dashboard: " + fmt, *args)
+
+    def do_GET(self):
+        for method, pattern, action in self.api.routes():
+            if method == "GET" and pattern.match(self.path):
+                try:
+                    payload, ctype = getattr(self.api, action)()
+                except Exception as e:  # noqa: BLE001 — UI must not die
+                    log.exception("dashboard handler error")
+                    payload, ctype = (
+                        json.dumps({"error": f"{type(e).__name__}: {e}"}),
+                        "application/json")
+                    self._send(500, payload, ctype)
+                    return
+                self._send(200, payload, ctype)
+                return
+        self._send(404, json.dumps({"error": f"no route {self.path}"}),
+                   "application/json")
+
+    def _send(self, code: int, payload: str, ctype: str) -> None:
+        data = payload.encode()
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+
+def make_server(mode: str, port: int, host: str = "0.0.0.0", kube=None
+                ) -> Tuple[ThreadingHTTPServer, threading.Thread]:
+    handler = type("BoundHandler", (_Handler,),
+                   {"api": DashboardAPI(mode, kube=kube)})
+    httpd = ThreadingHTTPServer((host, port), handler)
+    thread = threading.Thread(target=httpd.serve_forever, daemon=True,
+                              name=f"dashboard-{mode}")
+    thread.start()
+    return httpd, thread
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="kubeflow-tpu-dashboard")
+    ap.add_argument("--mode", choices=["central", "tpujobs"],
+                    default="central")
+    ap.add_argument("--port", type=int, default=0,
+                    help="default: 8082 central, 8080 tpujobs")
+    ap.add_argument("--host", default="0.0.0.0")
+    args = ap.parse_args(argv)
+    port = args.port or (8082 if args.mode == "central" else 8080)
+
+    logging.basicConfig(level=logging.INFO, stream=sys.stderr)
+    kube = None
+    if args.mode == "tpujobs":
+        from kubeflow_tpu.operator.kube_real import RealKube
+
+        kube = RealKube()
+    httpd, thread = make_server(args.mode, port, args.host, kube=kube)
+    log.info("%s dashboard on :%d", args.mode, port)
+    try:
+        thread.join()
+    except KeyboardInterrupt:
+        httpd.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,94 @@
+"""Opt-in anonymous usage telemetry — heir of Spartakus.
+
+The reference deployed the spartakus volunteer
+(kubeflow/core/spartakus.libsonnet:4-14) gated on ``reportUsage`` with a
+generated ``usageId`` (README.md:127-130); opt-out was documented
+(user_guide.md:158-186).  Same contract here, first-party: a periodic
+reporter that assembles an anonymous payload {usage id, framework/jax
+versions, node count} and POSTs it to ``--report-url`` (or logs it when
+no collector is configured — the report is always inspectable).  Only
+deployed when the core package renders with report_usage=True
+(manifests/core.py telemetry_manifests), so it is opt-in twice over.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import sys
+import time
+import urllib.request
+from typing import Any, Dict, Optional
+
+log = logging.getLogger(__name__)
+
+
+def collect(usage_id: str, kube=None) -> Dict[str, Any]:
+    """Anonymous payload: no names, no IPs, no workload details."""
+    from kubeflow_tpu.version import __version__
+
+    payload: Dict[str, Any] = {
+        "usage_id": usage_id,
+        "framework_version": __version__,
+    }
+    try:
+        import jax
+
+        payload["jax_version"] = jax.__version__
+    except Exception:
+        payload["jax_version"] = None
+    if kube is not None:
+        try:
+            payload["node_count"] = len(kube.list_nodes())
+        except Exception:
+            payload["node_count"] = None
+    return payload
+
+
+def report(payload: Dict[str, Any], url: Optional[str] = None,
+           timeout_s: float = 10.0) -> bool:
+    """POST the payload; log-only when no collector URL is configured.
+    Returns True when the report was delivered (or logged)."""
+    body = json.dumps(payload).encode()
+    if not url:
+        log.info("usage report (no collector configured): %s",
+                 body.decode())
+        return True
+    try:
+        req = urllib.request.Request(
+            url, data=body, headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+            return 200 <= resp.status < 300
+    except Exception as e:
+        log.warning("usage report failed: %s", e)
+        return False
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="kubeflow-tpu-telemetry")
+    ap.add_argument("--usage-id", required=True)
+    ap.add_argument("--interval-hours", type=float, default=24.0)
+    ap.add_argument("--report-url", default="",
+                    help="collector endpoint; log-only when empty")
+    ap.add_argument("--once", action="store_true",
+                    help="report once and exit (tests/cron)")
+    args = ap.parse_args(argv)
+
+    logging.basicConfig(level=logging.INFO, stream=sys.stderr)
+    kube = None
+    try:  # node count needs cluster credentials; fine without
+        from kubeflow_tpu.operator.kube_real import RealKube
+
+        kube = RealKube()
+    except Exception:
+        pass
+    while True:
+        report(collect(args.usage_id, kube=kube), args.report_url or None)
+        if args.once:
+            return 0
+        time.sleep(args.interval_hours * 3600)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

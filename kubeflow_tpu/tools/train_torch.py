@@ -69,7 +69,8 @@ def main(argv=None) -> int:
         import torch_xla.core.xla_model as xm  # type: ignore
 
         device = xm.xla_device()
-    except ImportError:
+    except Exception:  # ImportError, or RuntimeError when torch_xla is
+        xm = None      # installed but no TPU is attached — fall back.
         device = torch.device("cpu")
 
     # Gradient sync: on XLA devices torch_xla's own collectives do the
@@ -108,7 +109,10 @@ def main(argv=None) -> int:
         import torch.distributed as dist
 
         dist.destroy_process_group()
-    logging.info("torch training done: loss %.4f", loss.item())
+    if loss is not None:  # --steps 0 runs no iterations
+        logging.info("torch training done: loss %.4f", loss.item())
+    else:
+        logging.info("torch training done: 0 steps")
     return 0
 
 

@@ -1,0 +1,118 @@
+"""Dashboard (central + TPUJob browser) and usage-telemetry tests —
+the first-party heirs of centraldashboard.libsonnet, the tf-job
+dashboard (tf-job-operator.libsonnet:417-450), and spartakus
+(spartakus.libsonnet:4-14)."""
+
+import json
+import threading
+import urllib.request
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import numpy as np  # noqa: F401 — keeps conftest platform setup uniform
+
+from kubeflow_tpu.operator import crd
+from kubeflow_tpu.operator.kube import FakeKube
+from kubeflow_tpu.tools.dashboard import (
+    DashboardAPI,
+    job_rows,
+    make_server,
+    render_central,
+)
+from kubeflow_tpu.tools.telemetry import collect, report
+
+
+def _fake_kube_with_job():
+    kube = FakeKube()
+    cr = crd.TPUJobSpec(name="mnist", namespace="kubeflow",
+                        slice_type="v5e-8",
+                        num_slices=2).to_custom_resource()
+    cr["status"] = {"phase": "Running", "restarts": 1}
+    kube.create_custom(cr)
+    return kube
+
+
+class TestCentralDashboard:
+    def test_landing_page_links(self):
+        page = render_central()
+        assert "/hub/" in page and "/tpujobs/" in page
+
+    def test_http_roundtrip(self):
+        httpd, _ = make_server("central", 0, host="127.0.0.1")
+        port = httpd.server_address[1]
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/", timeout=10) as resp:
+                assert "Kubeflow-TPU" in resp.read().decode()
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/healthz", timeout=10) as resp:
+                assert json.loads(resp.read())["status"] == "ok"
+        finally:
+            httpd.shutdown()
+
+
+class TestTPUJobDashboard:
+    def test_job_rows_from_crs(self):
+        rows = job_rows(_fake_kube_with_job())
+        assert rows == [{
+            "name": "mnist", "namespace": "kubeflow", "phase": "Running",
+            "slice_type": "v5e-8", "num_slices": 2, "restarts": 1,
+        }]
+
+    def test_html_and_json_routes(self):
+        httpd, _ = make_server("tpujobs", 0, host="127.0.0.1",
+                               kube=_fake_kube_with_job())
+        port = httpd.server_address[1]
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/tpujobs/", timeout=10) as r:
+                html = r.read().decode()
+            assert "mnist" in html and "Running" in html
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/tpujobs/api/jobs",
+                    timeout=10) as r:
+                jobs = json.loads(r.read())["jobs"]
+            assert jobs[0]["slice_type"] == "v5e-8"
+        finally:
+            httpd.shutdown()
+
+    def test_empty_cluster_renders(self):
+        api = DashboardAPI("tpujobs", kube=FakeKube())
+        page, ctype = api.tpujobs_html()
+        assert "No TPUJobs" in page and ctype == "text/html"
+
+
+class TestTelemetry:
+    def test_collect_payload_is_anonymous(self):
+        kube = _fake_kube_with_job()
+        kube.nodes.append({"metadata": {"name": "node-a"}})
+        payload = collect("uid-123", kube=kube)
+        assert payload["usage_id"] == "uid-123"
+        assert payload["framework_version"]
+        assert payload["node_count"] == 1
+        # No identifying fields beyond the opaque usage id.
+        assert set(payload) <= {"usage_id", "framework_version",
+                                "jax_version", "node_count"}
+
+    def test_report_log_only(self):
+        assert report({"usage_id": "x"}, url=None) is True
+
+    def test_report_posts_json(self):
+        received = {}
+
+        class Collector(BaseHTTPRequestHandler):
+            def do_POST(self):
+                n = int(self.headers["Content-Length"])
+                received.update(json.loads(self.rfile.read(n)))
+                self.send_response(204)
+                self.end_headers()
+
+            def log_message(self, *a):
+                pass
+
+        httpd = HTTPServer(("127.0.0.1", 0), Collector)
+        threading.Thread(target=httpd.handle_request, daemon=True).start()
+        port = httpd.server_address[1]
+        ok = report({"usage_id": "y"},
+                    url=f"http://127.0.0.1:{port}/report")
+        httpd.server_close()
+        assert ok and received == {"usage_id": "y"}

@@ -39,7 +39,7 @@ class TestFormat:
     def test_bad_magic_rejected(self, tmp_path):
         p = tmp_path / "bad.bin"
         p.write_bytes(b"GARBAGE")
-        with pytest.raises(ValueError, match="magic"):
+        with pytest.raises(IOError, match="magic"):
             list(read_records(p))
 
     def test_example_codec(self):
@@ -81,8 +81,12 @@ class TestNativeCore:
         # Truncate mid-payload.
         data = p.read_bytes()
         p.write_bytes(data[:-4])
+        # Same IOError contract from both readers: the default (python
+        # auto-select) and the explicitly threaded native core.
         with pytest.raises(IOError, match="truncated"):
             list(RecordDataset([p]))
+        with pytest.raises(IOError, match="truncated"):
+            list(RecordDataset([p], num_threads=1))
 
 
 class TestSharding:
@@ -169,3 +173,166 @@ class TestBatching:
         batches = list(tensor_batches(RecordDataset(paths), 32,
                                       drop_remainder=False))
         assert batches[-1]["x"].shape == (4, 4)
+
+
+class TestStackedBatches:
+    """In-core decode + batch assembly (loader.stacked_batches): the
+    pipeline default, where the C++ core fills per-key batch buffers
+    numpy wraps zero-copy."""
+
+    def test_matches_python_pipeline_exactly(self, shard_dir):
+        _, paths = shard_dir
+        # num_threads=1 => deterministic file/record order, comparable
+        # element-for-element with the sequential python path.
+        nat = list(RecordDataset(paths, num_threads=1)
+                   .stacked_batches(32))
+        py = list(tensor_batches(
+            RecordDataset(paths, force_python=True), 32))
+        assert len(nat) == len(py) == 3
+        for a, b in zip(nat, py):
+            assert set(a) == set(b)
+            for k in a:
+                np.testing.assert_array_equal(a[k], b[k])
+                assert a[k].dtype == b[k].dtype
+
+    def test_threaded_same_multiset(self, shard_dir):
+        _, paths = shard_dir
+        nat = list(RecordDataset(paths, num_threads=4)
+                   .stacked_batches(10, drop_remainder=False))
+        ys = np.sort(np.concatenate([b["y"] for b in nat]))
+        py = list(tensor_batches(
+            RecordDataset(paths, force_python=True), 10,
+            drop_remainder=False))
+        ys_py = np.sort(np.concatenate([b["y"] for b in py]))
+        np.testing.assert_array_equal(ys, ys_py)
+
+    def test_remainder(self, shard_dir):
+        _, paths = shard_dir
+        nat = list(RecordDataset(paths, num_threads=1)
+                   .stacked_batches(32, drop_remainder=False))
+        assert [b["y"].shape[0] for b in nat] == [32, 32, 32, 4]
+
+    def test_schema_mismatch_raises(self, tmp_path):
+        from kubeflow_tpu.data.loader import RecordWriter, encode_example
+
+        p = tmp_path / "mixed.kftr"
+        with RecordWriter(p) as w:
+            w.write(encode_example({"x": np.zeros(4, np.float32)}))
+            w.write(encode_example({"x": np.zeros(5, np.float32)}))
+        with pytest.raises(IOError, match="schema"):
+            list(RecordDataset([p]).stacked_batches(2))
+
+    def test_non_kte1_payload_falls_back(self, tmp_path):
+        from kubeflow_tpu.data.loader import RecordWriter
+
+        import io as _io
+
+        p = tmp_path / "npz.kftr"
+        buf = _io.BytesIO()
+        np.savez(buf, x=np.arange(4, dtype=np.float32))
+        with RecordWriter(p) as w:
+            for _ in range(4):
+                w.write(buf.getvalue())
+        batches = list(RecordDataset([p]).stacked_batches(2))
+        assert len(batches) == 2
+        assert batches[0]["x"].shape == (2, 4)
+
+    def test_uint8_dtype_roundtrips(self, tmp_path):
+        """1-byte dtypes serialize as '|u1' — the '|' must not break
+        schema parsing (uint8 images are the serving wire format)."""
+        from kubeflow_tpu.data.loader import write_example_shards
+
+        img = np.arange(48, dtype=np.uint8).reshape(4, 4, 3)
+        paths = write_example_shards(
+            ({"image": img + i, "ok": np.bool_(i % 2)} for i in range(6)),
+            tmp_path, examples_per_shard=6)
+        (batch,) = RecordDataset(paths, num_threads=1).stacked_batches(6)
+        assert batch["image"].dtype == np.uint8
+        assert batch["ok"].dtype == np.bool_
+        np.testing.assert_array_equal(batch["image"][2], img + 2)
+
+    def test_scalar_fields_stack_to_vector(self, tmp_path):
+        from kubeflow_tpu.data.loader import write_example_shards
+
+        paths = write_example_shards(
+            ({"label": np.int64(i)} for i in range(8)),
+            tmp_path, examples_per_shard=8)
+        (batch,) = RecordDataset(paths, num_threads=1).stacked_batches(8)
+        np.testing.assert_array_equal(batch["label"], np.arange(8))
+
+    def test_truncated_shard_raises_not_truncates(self, tmp_path):
+        """A corrupt shard must raise from the stacked path exactly as
+        it does from raw iteration — silent short batches would train
+        on partial data (review finding r3)."""
+        from kubeflow_tpu.data.loader import RecordWriter, encode_example
+
+        p = tmp_path / "trunc.kftr"
+        with RecordWriter(p) as w:
+            for i in range(64):
+                w.write(encode_example({"x": np.full(8, i, np.float32)}))
+        data = p.read_bytes()
+        p.write_bytes(data[:-7])  # cut mid-payload
+        with pytest.raises(IOError, match="truncated"):
+            list(RecordDataset([p], num_threads=1).stacked_batches(64))
+
+    def test_nbytes_shape_mismatch_rejected(self, tmp_path):
+        """A record whose nbytes disagrees with shape x dtype must be
+        rejected at schema lock-in — the fill path sizes buffers from
+        shape x dtype and copies nbytes (heap overflow otherwise)."""
+        import struct as st
+
+        from kubeflow_tpu.data.loader import RecordWriter
+
+        # Hand-craft KTE1: key 'x', dtype '<f4', shape (4,), but
+        # nbytes=64 with 64 payload bytes (parse succeeds, sizes lie).
+        payload = (b"KTE1" + st.pack("<H", 1)
+                   + st.pack("<HH", 1, 3) + b"x" + b"<f4"
+                   + st.pack("<B", 1) + st.pack("<q", 4)
+                   + st.pack("<Q", 64) + b"\0" * 64)
+        p = tmp_path / "evil.kftr"
+        with RecordWriter(p) as w:
+            for _ in range(4):
+                w.write(payload)
+        with pytest.raises((IOError, ValueError)):
+            list(RecordDataset([p], num_threads=1).stacked_batches(4))
+
+    def test_reserved_key_characters_rejected_at_encode(self):
+        from kubeflow_tpu.data.loader import encode_example
+
+        with pytest.raises(ValueError, match="reserved"):
+            encode_example({"a|b": np.zeros(2, np.float32)})
+        with pytest.raises(ValueError, match="reserved"):
+            encode_example({"a;b": np.zeros(2, np.float32)})
+
+    def test_foreign_shard_with_separator_key_falls_back(self, tmp_path):
+        """A shard written by a foreign producer with a '|' in a key:
+        the native schema path refuses it and stacked_batches falls back
+        to the python decode loop, which handles it."""
+        import struct as st
+
+        from kubeflow_tpu.data.loader import RecordWriter
+
+        arr = np.arange(4, dtype=np.float32)
+        payload = (b"KTE1" + st.pack("<H", 1)
+                   + st.pack("<HH", 3, 3) + b"a|b" + b"<f4"
+                   + st.pack("<B", 1) + st.pack("<q", 4)
+                   + st.pack("<Q", 16) + arr.tobytes())
+        p = tmp_path / "foreign.kftr"
+        with RecordWriter(p) as w:
+            for _ in range(4):
+                w.write(payload)
+        (batch,) = RecordDataset([p]).stacked_batches(4)
+        assert batch["a|b"].shape == (4, 4)
+        np.testing.assert_array_equal(batch["a|b"][0], arr)
+
+    def test_shuffle_composes(self, shard_dir):
+        _, paths = shard_dir
+        nat = list(RecordDataset(paths, num_threads=1, shuffle_buffer=64,
+                                 seed=3).stacked_batches(
+                                     10, drop_remainder=False))
+        plain = list(RecordDataset(paths, num_threads=1)
+                     .stacked_batches(10, drop_remainder=False))
+        ys = np.concatenate([b["y"] for b in nat])
+        ys_plain = np.concatenate([b["y"] for b in plain])
+        assert not np.array_equal(ys, ys_plain)
+        np.testing.assert_array_equal(np.sort(ys), np.sort(ys_plain))

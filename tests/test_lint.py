@@ -1,0 +1,39 @@
+"""The lint gate runs as part of every test run — formatting-as-a-CI-step,
+the reference's own policy (scripts/autoformat_jsonnet.sh:17-30,
+build/check_boilerplate.sh via Makefile:15-18)."""
+
+import pathlib
+import subprocess
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+class TestLintGate:
+    def test_repo_is_lint_clean(self):
+        proc = subprocess.run(
+            [sys.executable, str(REPO / "ci" / "lint.py"), "--root",
+             str(REPO)],
+            capture_output=True, text=True,
+        )
+        assert proc.returncode == 0, (
+            f"lint problems:\n{proc.stdout}\n{proc.stderr}")
+
+    def test_gate_catches_violations(self, tmp_path):
+        """The gate must actually fire — a sabotaged tree fails."""
+        bad = tmp_path / "kubeflow_tpu"
+        bad.mkdir()
+        (bad / "mod.py").write_text(
+            "import datetime\n"
+            "x = datetime.utcnow()  # TODO fix\n"
+            "y = 1\t\n"
+        )
+        proc = subprocess.run(
+            [sys.executable, str(REPO / "ci" / "lint.py"), "--root",
+             str(tmp_path)],
+            capture_output=True, text=True,
+        )
+        assert proc.returncode == 1
+        assert "docstring required" in proc.stdout
+        assert "banned" in proc.stdout
+        assert "trailing whitespace" in proc.stdout

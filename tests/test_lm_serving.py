@@ -1,0 +1,92 @@
+"""LM serving path: export the flagship transformer, serve it, decode
+over REST, and diff against a committed golden.
+
+Round-2 gap (VERDICT #5): `loaders:lm_generate` was write-only code.
+This is the golden-serving pattern the reference applied to its flagship
+(Inception gRPC golden, testing/test_tf_serving.py +
+components/k8s-model-server/images/test-worker/result.txt), applied to
+THIS framework's flagship: the Transformer LM with KV-cache decode.
+
+Regenerate after an intentional model change:
+    KFT_UPDATE_GOLDEN=1 python -m pytest tests/test_lm_serving.py
+"""
+
+import json
+import os
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+GOLDEN = Path(__file__).parent / "golden" / "lm_generate.json"
+SEED = 20260730
+VOCAB, PROMPT_LEN, NEW_TOKENS = 128, 8, 12
+
+
+@pytest.fixture(scope="module")
+def served(tmp_path_factory):
+    import jax
+
+    from kubeflow_tpu.models.transformer import Transformer
+    from kubeflow_tpu.serving.export import export
+    from kubeflow_tpu.serving.http import ServingAPI
+    from kubeflow_tpu.serving.loaders import _model_config
+    from kubeflow_tpu.serving.model_server import ModelServer
+
+    model_overrides = {
+        "vocab_size": VOCAB, "d_model": 32, "n_layers": 2, "n_heads": 4,
+        "n_kv_heads": 2, "d_ff": 64, "head_dim": 8, "max_seq_len": 64,
+        "dtype": "float32",  # bit-stable across CPU/TPU for the golden
+    }
+    cfg = _model_config(model_overrides)
+    model = Transformer(cfg)
+    tokens = np.zeros((1, PROMPT_LEN), np.int32)
+    variables = model.init(jax.random.key(SEED), tokens)
+    base = tmp_path_factory.mktemp("models") / "lm"
+    export(base, 1, variables,
+           loader="kubeflow_tpu.serving.loaders:lm_generate",
+           config={"model": model_overrides,
+                   "max_new_tokens": NEW_TOKENS, "temperature": 0.0},
+           signature={"inputs": ["tokens"], "outputs": ["tokens"]})
+    server = ModelServer()
+    server.add_model("lm", str(base))
+    return ServingAPI(server)
+
+
+def _prompt():
+    rng = np.random.RandomState(SEED)
+    return rng.randint(1, VOCAB, size=(PROMPT_LEN,)).tolist()
+
+
+class TestLMServing:
+    def test_decode_over_rest_matches_golden(self, served):
+        out = served.predict("lm", {"instances": [{"tokens": _prompt()}]})
+        tokens = out["predictions"][0]["tokens"]
+        assert len(tokens) == PROMPT_LEN + NEW_TOKENS
+        assert tokens[:PROMPT_LEN] == _prompt()  # prompt preserved
+        got = {"tokens": tokens}
+        if os.environ.get("KFT_UPDATE_GOLDEN"):
+            GOLDEN.parent.mkdir(exist_ok=True)
+            GOLDEN.write_text(json.dumps(got, indent=2) + "\n")
+            pytest.skip("golden updated")
+        assert GOLDEN.exists(), (
+            "golden missing; regenerate with KFT_UPDATE_GOLDEN=1")
+        want = json.loads(GOLDEN.read_text())
+        assert got["tokens"] == want["tokens"], (
+            "greedy decode drifted from the committed golden")
+
+    def test_batched_decode(self, served):
+        instances = [{"tokens": _prompt()}, {"tokens": _prompt()[::-1]}]
+        out = served.predict("lm", {"instances": instances})
+        assert len(out["predictions"]) == 2
+        # Greedy decode is deterministic per row: identical prompts in a
+        # batch produce identical continuations.
+        same = served.predict(
+            "lm", {"instances": [{"tokens": _prompt()}] * 2})
+        rows = [p["tokens"] for p in same["predictions"]]
+        assert rows[0] == rows[1]
+
+    def test_metadata_reports_lm_loader(self, served):
+        meta = served.metadata("lm")
+        assert meta["metadata"]["loader"].endswith("lm_generate")
+        assert meta["metadata"]["signature"]["inputs"] == ["tokens"]
