@@ -25,7 +25,8 @@ from __future__ import annotations
 import dataclasses
 import logging
 from collections import deque
-from typing import Any, Callable, Deque, Dict, Iterable, Optional, Tuple
+from typing import (Any, Callable, Deque, Dict, Iterable, Optional,
+                    Sequence, Tuple)
 
 import jax
 import jax.numpy as jnp
@@ -140,6 +141,8 @@ class Trainer:
 
     def __post_init__(self) -> None:
         self._train_step = None
+        self._multi_steps: Dict[int, Callable] = {}
+        self._stackers: Dict[Any, Callable] = {}
 
     # -- state ------------------------------------------------------------
 
@@ -187,41 +190,135 @@ class Trainer:
 
     # -- step -------------------------------------------------------------
 
+    def _step_body(self, state: TrainState, batch: Any):
+        rng, step_rng = jax.random.split(state.rng)
+
+        def loss(params):
+            # Mesh + rule contexts make the models' logical sharding
+            # constraints (nn.with_logical_constraint) bind at trace
+            # time; without them constraints are silent no-ops.
+            with self.mesh, nn.logical_axis_rules(list(self.rules)):
+                return self.loss_fn(params, state.mutable, batch, step_rng)
+
+        (loss_val, (aux, new_mutable)), grads = jax.value_and_grad(
+            loss, has_aux=True
+        )(state.params)
+        updates, new_opt = self.tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        new_state = state.replace(
+            step=state.step + 1,
+            params=new_params,
+            opt_state=new_opt,
+            rng=rng,
+            mutable=new_mutable,
+        )
+        metrics = {
+            "loss": loss_val,
+            "grad_norm": optax.global_norm(grads),
+            **aux,
+        }
+        return new_state, metrics
+
     def compile_step(self) -> Callable[[TrainState, Any], Tuple[TrainState, Dict]]:
         if self._train_step is not None:
             return self._train_step
-
-        def step(state: TrainState, batch: Any):
-            rng, step_rng = jax.random.split(state.rng)
-
-            def loss(params):
-                # Mesh + rule contexts make the models' logical sharding
-                # constraints (nn.with_logical_constraint) bind at trace
-                # time; without them constraints are silent no-ops.
-                with self.mesh, nn.logical_axis_rules(list(self.rules)):
-                    return self.loss_fn(params, state.mutable, batch, step_rng)
-
-            (loss_val, (aux, new_mutable)), grads = jax.value_and_grad(
-                loss, has_aux=True
-            )(state.params)
-            updates, new_opt = self.tx.update(grads, state.opt_state, state.params)
-            new_params = optax.apply_updates(state.params, updates)
-            new_state = state.replace(
-                step=state.step + 1,
-                params=new_params,
-                opt_state=new_opt,
-                rng=rng,
-                mutable=new_mutable,
-            )
-            metrics = {
-                "loss": loss_val,
-                "grad_norm": optax.global_norm(grads),
-                **aux,
-            }
-            return new_state, metrics
-
-        self._train_step = jax.jit(step, donate_argnums=(0,))
+        self._train_step = jax.jit(self._step_body, donate_argnums=(0,))
         return self._train_step
+
+    def compile_multi_step(
+        self, k: int
+    ) -> Callable[[TrainState, Any], Tuple[TrainState, Dict]]:
+        """K train steps fused into one device program (host-loop fusion).
+
+        ``lax.scan`` over batches stacked on a leading [k, ...] axis: one
+        dispatch, one readiness check, and one metrics read amortize over
+        k steps.  For short step times — or high-latency dispatch paths
+        (a remote/tunneled chip, a busy host) — per-step host overhead is
+        what separates the measured step from the device step; fusing
+        divides it by k.  Returned metrics are the last step's (losses of
+        the k steps differ only by one step of optimizer progress).
+        """
+        if k in self._multi_steps:
+            return self._multi_steps[k]
+
+        def multi(state: TrainState, batches: Any):
+            def body(st, b):
+                return self._step_body(st, b)
+
+            return jax.lax.scan(body, state, batches)
+
+        def multi_repeat(state: TrainState, batch: Any):
+            # Same k-step program but over ONE batch used k times (no
+            # stacked xs, no per-iteration slice materialization) — the
+            # steady-state benchmarking shape, where every chunk batch
+            # is the same staged buffer.
+            def body(st, _):
+                return self._step_body(st, batch)
+
+            return jax.lax.scan(body, state, None, length=k)
+
+        multi_jit = jax.jit(multi, donate_argnums=(0,))
+        repeat_jit = jax.jit(multi_repeat, donate_argnums=(0,))
+
+        def run(state: TrainState, batches: Any,
+                _leaves=jax.tree_util.tree_leaves):
+            if isinstance(batches, (list, tuple)):
+                # Repeated-batch detection is by LEAF identity: a
+                # re-sharded staged batch comes back as a fresh dict
+                # around the identical device buffers (device_put
+                # short-circuits per leaf, tree_map rebuilds the
+                # container), so container identity would never match.
+                first = _leaves(batches[0])
+                if all(
+                    len(ls) == len(first)
+                    and all(a is b for a, b in zip(ls, first))
+                    for ls in (_leaves(x) for x in batches[1:])
+                ):
+                    state, metrics = repeat_jit(state, batches[0])
+                else:
+                    state, metrics = multi_jit(
+                        state, self.stack_batches(list(batches)))
+            else:  # already stacked [k, ...]
+                state, metrics = multi_jit(state, batches)
+            return state, jax.tree_util.tree_map(lambda a: a[-1], metrics)
+
+        self._multi_steps[k] = run
+        return run
+
+    def stack_batches(self, batches: Sequence[Any]) -> Any:
+        """Stack k sharded batches on a new leading steps axis [k, ...]
+        for compile_multi_step's scan.  Device-side stack (one small
+        program; scan slices restore the per-batch layout), explicit
+        out-shardings so the batch dim stays sharded over the dp axes
+        on axis 1."""
+        def spec(x):
+            # One source of truth for the batch-over-dp convention:
+            # mesh.batch_sharding, with a leading None for the new
+            # steps axis.  0-d leaves stack to rank 1, unsharded.
+            ndim = getattr(x, "ndim", 0)
+            if ndim == 0:
+                return NamedSharding(self.mesh, PartitionSpec(None))
+            inner = batch_sharding(self.mesh, ndim=ndim).spec
+            return NamedSharding(self.mesh, PartitionSpec(None, *inner))
+
+        # The jit wrapper must be cached: a fresh jax.jit per call is a
+        # fresh trace cache, i.e. a recompile of the (trivial) stack
+        # program on every chunk — ruinous on remote-compile backends.
+        key = (len(batches),
+               jax.tree_util.tree_structure(batches[0]),
+               tuple((getattr(x, "shape", None), str(getattr(x, "dtype",
+                     None)))
+                     for x in jax.tree_util.tree_leaves(batches[0])))
+        stacker = self._stackers.get(key)
+        if stacker is None:
+            out_shardings = jax.tree_util.tree_map(spec, batches[0])
+            stacker = jax.jit(
+                lambda *xs: jax.tree_util.tree_map(
+                    lambda *ys: jnp.stack(ys), *xs),
+                out_shardings=out_shardings,
+            )
+            self._stackers[key] = stacker
+        return stacker(*batches)
 
     def shard_batch(self, batch: Any) -> Any:
         """Place a host batch onto the mesh, batch-dim sharded over dp axes.
@@ -252,6 +349,7 @@ class Trainer:
         state: Optional[TrainState] = None,
         examples_per_step: int = 0,
         log_every: int = 10,
+        steps_per_call: int = 1,
     ) -> TrainState:
         """Run the train loop with metrics + periodic async checkpoints.
 
@@ -270,10 +368,19 @@ class Trainer:
           - step time is averaged over the window since the last sync —
             a per-step host sync would measure host<->device round-trip
             latency, not device throughput;
-          - dispatch depth is bounded at 2 steps: the host blocks on the
-            result from two steps ago, so at most two batches are ever in
-            flight no matter how `log_every` is set (an unbounded loop
-            would queue every batch's HBM buffer ahead of the device).
+          - dispatch depth is bounded at 2 CALLS: the host blocks on the
+            result from two calls ago, so at most two calls' input
+            buffers are ever in flight no matter how `log_every` is set
+            (an unbounded loop would queue every batch's HBM buffer
+            ahead of the device).  With steps_per_call=1 that is two
+            batches; with steps_per_call=k it is up to two stacked
+            [k, ...] chunks (~2k batches of HBM) — size k to headroom;
+          - ``steps_per_call=k`` fuses k steps into one device program
+            (compile_multi_step's lax.scan): one dispatch, one readiness
+            check, and one possible metrics read per k steps.  Use when
+            per-step host overhead is visible next to the device step —
+            short steps, busy hosts, or high-latency dispatch paths.
+            Logging and checkpoints land on call boundaries.
         """
         if state is None:
             state = self.create_state()
@@ -297,15 +404,27 @@ class Trainer:
                 for _ in range(start_step):
                     next(it)
         final_metrics: Dict[str, Any] = {}
+        k = max(1, int(steps_per_call))
+        multi_fn = self.compile_multi_step(k) if k > 1 else None
         batch = self.shard_batch(next(it))
         timer = Timer()
         timer.start()
         window_steps = 0
         inflight: Deque[Any] = deque()
-        for i in range(start_step, num_steps):
-            state, metrics = step_fn(state, batch)
-            window_steps += 1
-            if i + 1 < num_steps:
+        i = start_step
+        while i < num_steps:
+            if multi_fn is not None and i + k <= num_steps:
+                chunk = [batch]
+                for _ in range(k - 1):
+                    chunk.append(self.shard_batch(next(it)))
+                state, metrics = multi_fn(state, chunk)
+                advance = k
+            else:
+                state, metrics = step_fn(state, batch)
+                advance = 1
+            i_next = i + advance
+            window_steps += advance
+            if i_next < num_steps:
                 # Overlaps with the async step above.
                 batch = self.shard_batch(next(it))
             inflight.append(metrics["loss"])
@@ -313,13 +432,15 @@ class Trainer:
                 # Backpressure: in steady state this result is already
                 # done, so the wait is free — it only paces the host.
                 jax.block_until_ready(inflight.popleft())
-            if log_every and (i % log_every == 0 or i == num_steps - 1):
+            last = i_next - 1
+            if log_every and (i_next // log_every > i // log_every
+                              or i_next == num_steps):
                 loss = float(metrics["loss"])  # device sync
                 dt = timer.stop() / window_steps
                 timer.start()
                 window_steps = 0
                 self.metrics.step(
-                    step=i,
+                    step=last,
                     step_time_s=dt,
                     examples_per_step=examples_per_step,
                     flops_per_step=self.flops_per_example * examples_per_step * 3
@@ -330,10 +451,11 @@ class Trainer:
                 )
             if (
                 self.checkpoints is not None
-                and (i + 1) % self.checkpoint_every == 0
+                and i_next // self.checkpoint_every > i // self.checkpoint_every
             ):
-                self.checkpoints.save(i, state)
+                self.checkpoints.save(last, state)
             final_metrics = metrics
+            i = i_next
         if self.checkpoints is not None:
             self.checkpoints.save(num_steps - 1, state, force=True)
             self.checkpoints.wait()

@@ -78,6 +78,78 @@ class TestResNetTraining:
         assert 0.0 <= float(metrics["accuracy"]) <= 1.0
 
 
+class TestMultiStepFusion:
+    """fit(steps_per_call=k): k steps fused into one lax.scan program
+    must follow the same trajectory as the per-step loop (same data
+    order, same rng chain), on the sharded 8-device mesh."""
+
+    def _run(self, trainer_model, steps_per_call):
+        trainer, _ = trainer_model
+        state = trainer.create_state(seed=7)
+        state = trainer.fit(
+            fake_data(3), 8, state=state, log_every=8,
+            steps_per_call=steps_per_call,
+        )
+        return state, trainer.metrics.history[-1]["loss"]
+
+    def test_fused_matches_per_step_trajectory(self, trainer):
+        state1, loss1 = self._run(trainer, 1)
+        state4, loss4 = self._run(trainer, 4)
+        assert int(state1.step) == int(state4.step) == 8
+        # Same data order, same rng chain; the residual difference is
+        # compilation numerics (the scan program reassociates float ops
+        # differently from the straight-line step), not semantics.
+        np.testing.assert_allclose(loss1, loss4, rtol=1e-2)
+        l1 = jax.tree_util.tree_leaves(state1.params)
+        l4 = jax.tree_util.tree_leaves(state4.params)
+        for a, b in zip(l1, l4):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=5e-3)
+
+    def test_remainder_steps_run_per_step(self, trainer):
+        """num_steps not divisible by k: the tail runs through the
+        single-step program; total step count is exact."""
+        trainer_obj, _ = trainer
+        state = trainer_obj.create_state(seed=9)
+        state = trainer_obj.fit(
+            fake_data(4), 7, state=state, log_every=7, steps_per_call=3,
+        )
+        assert int(state.step) == 7
+
+    def test_repeated_staged_batch_skips_stacking(self, trainer,
+                                                  monkeypatch):
+        """The repeat fast path must actually fire for a staged batch
+        fed through an iterator: shard_batch rebuilds the dict but the
+        LEAVES are identical, and that's what the dispatcher compares
+        (review finding r3: container identity never matched)."""
+        trainer_obj, _ = trainer
+        state = trainer_obj.create_state(seed=11)
+        b = trainer_obj.shard_batch(next(fake_data(6)))
+
+        def rep(x):
+            while True:
+                yield x
+
+        def boom(*a, **k):
+            raise AssertionError(
+                "stack_batches must not run for repeated staged batches")
+
+        monkeypatch.setattr(trainer_obj, "stack_batches", boom)
+        state = trainer_obj.fit(rep(b), 4, state=state, log_every=4,
+                                steps_per_call=4)
+        assert int(state.step) == 4
+
+    def test_stack_batches_sharding(self, trainer):
+        trainer_obj, _ = trainer
+        batches = [trainer_obj.shard_batch(b)
+                   for b, _ in zip(fake_data(5), range(3))]
+        stacked = trainer_obj.stack_batches(batches)
+        assert stacked["image"].shape == (3, BATCH, IMG, IMG, 3)
+        # Batch dim (axis 1) stays sharded over the data axis.
+        spec = stacked["image"].sharding.spec
+        assert spec[0] is None and spec[1] is not None
+
+
 class TestCheckpointResume:
     def test_restore_or_init_roundtrip(self, trainer, tmp_path):
         tr, _ = trainer
