@@ -68,7 +68,11 @@ def measure_fit(trainer, state, dev_batch, warmup: int, steps: int,
     # Warm both programs the measured fit will use: the fused k-step
     # scan, plus the single-step remainder program when steps % k != 0
     # (otherwise its first compile would land inside the timed window).
-    warm = max(warmup, k) + (1 if steps % k else 0)
+    # The warmup fit only runs single steps for its own warm % k tail,
+    # so warm itself must not be a multiple of k in that case.
+    warm = max(warmup, k)
+    if steps % k and warm % k == 0:
+        warm += 1
     state = trainer.fit(
         repeat(dev_batch), warm, state=state,
         examples_per_step=0, log_every=warm, steps_per_call=k,
@@ -188,6 +192,8 @@ def bench_lm(args, devices, n_chips, on_tpu):
             n_kv_heads=8, d_ff=2816, head_dim=128, max_seq_len=seq,
             dtype=jnp.bfloat16, attention=args.attention,
             remat=not args.no_remat,
+            remat_policy=args.remat_policy,
+            save_attn_residuals=not args.no_save_attn,
             flash_block_q=args.flash_block_q,
             flash_block_k=args.flash_block_k,
         )
@@ -670,6 +676,12 @@ def main() -> None:
                          "1024 measured best on v5e @ seq 2048)")
     ap.add_argument("--no-remat", action="store_true",
                     help="disable per-block remat in the lm bench")
+    ap.add_argument("--remat-policy", default="nobatch",
+                    choices=["nobatch", "dots"],
+                    help="lm remat checkpoint policy (on-chip sweep knob)")
+    ap.add_argument("--no-save-attn", action="store_true",
+                    help="drop flash (out, lse) residuals at the remat "
+                         "boundary (recompute the fwd kernel in bwd)")
     ap.add_argument("--fake-devices", type=int, default=0,
                     help="run on an N-device virtual CPU slice")
     args = ap.parse_args()

@@ -171,3 +171,67 @@ class TestFlashBackwardKernels:
         ref_o = dot_product_attention(q, k, v, causal=False)
         np.testing.assert_allclose(np.asarray(o), np.asarray(ref_o),
                                    atol=2e-5)
+
+
+class TestFlashRematResiduals:
+    """The flash fwd names its (out, lse) residuals (checkpoint_name) so a
+    remat policy can keep them instead of re-running the forward kernel
+    inside the backward pass — the policy composition models/transformer.py
+    installs when save_attn_residuals is set."""
+
+    def _policy(self):
+        return jax.checkpoint_policies.save_from_both_policies(
+            jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+            jax.checkpoint_policies.save_only_these_names(
+                "flash_out", "flash_lse"),
+        )
+
+    def test_grads_identical_with_saved_residuals(self):
+        rng = np.random.RandomState(11)
+        q, k, v = rand_qkv(rng, b=2, s=64, h=2, d=32)
+
+        def attend(q, k, v):
+            out = flash_attention(q, k, v, causal=True, block_q=16,
+                                  block_k=16, interpret=True)
+            return (out * out).sum()
+
+        plain = jax.grad(attend, argnums=(0, 1, 2))(q, k, v)
+        saved = jax.grad(
+            jax.checkpoint(attend, policy=self._policy())
+        , argnums=(0, 1, 2))(q, k, v)
+        recomputed = jax.grad(
+            jax.checkpoint(
+                attend,
+                policy=jax.checkpoint_policies
+                .dots_with_no_batch_dims_saveable,
+            ), argnums=(0, 1, 2))(q, k, v)
+        for a, b, c in zip(plain, saved, recomputed):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-6)
+            np.testing.assert_allclose(np.asarray(a), np.asarray(c),
+                                       atol=1e-6)
+
+    def test_policy_elides_fwd_recompute(self):
+        """With the residuals saved, the backward jaxpr must not contain a
+        second forward kernel call (the lse-producing pallas call)."""
+        rng = np.random.RandomState(12)
+        q, k, v = rand_qkv(rng, b=1, s=32, h=2, d=16)
+
+        def attend(q, k, v):
+            out = flash_attention(q, k, v, causal=True, block_q=16,
+                                  block_k=16, interpret=True)
+            return (out * out).sum()
+
+        def n_pallas_calls(policy):
+            fn = jax.checkpoint(attend, policy=policy) if policy else attend
+            jaxpr = jax.make_jaxpr(
+                jax.grad(fn, argnums=(0, 1, 2)))(q, k, v)
+            return str(jaxpr).count("pallas_call")
+
+        # Ungated grad: fwd + dq + dkv = 3 kernel launches.  Saving the
+        # named residuals keeps it at 3 under remat; dropping them forces
+        # a 4th launch (the fwd recompute inside the backward).
+        assert n_pallas_calls(None) == 3
+        assert n_pallas_calls(self._policy()) == 3
+        assert n_pallas_calls(
+            jax.checkpoint_policies.dots_with_no_batch_dims_saveable) == 4
