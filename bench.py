@@ -196,13 +196,19 @@ def bench_lm(args, devices, n_chips, on_tpu):
             save_attn_residuals=not args.no_save_attn,
             flash_block_q=args.flash_block_q,
             flash_block_k=args.flash_block_k,
+            moe_experts=args.moe_experts,
+            moe_group_size=args.moe_group_size,
         )
         batch = args.batch or 8 * n_chips
     else:  # tiny hermetic config for --fake-devices runs
         cfg = TransformerConfig(
             vocab_size=512, d_model=64, n_layers=2, n_heads=4, n_kv_heads=4,
             d_ff=128, head_dim=16, max_seq_len=seq, dtype=jnp.float32,
-            attention="dot",
+            attention="dot",  # flash falls back off-TPU anyway
+            remat=not args.no_remat,
+            remat_policy=args.remat_policy,
+            save_attn_residuals=not args.no_save_attn,
+            moe_experts=args.moe_experts,
         )
         batch = args.batch or 4 * n_chips
     print(
@@ -244,6 +250,8 @@ def bench_lm(args, devices, n_chips, on_tpu):
             "n_chips": n_chips,
             "mfu": round(achieved_mfu, 4),
             "device": devices[0].device_kind,
+            **({"moe_experts": cfg.moe_experts,
+                "moe_top_k": cfg.moe_top_k} if cfg.moe_experts else {}),
         },
     }
 
@@ -676,6 +684,13 @@ def main() -> None:
                          "1024 measured best on v5e @ seq 2048)")
     ap.add_argument("--no-remat", action="store_true",
                     help="disable per-block remat in the lm bench")
+    ap.add_argument("--moe-experts", type=int, default=0,
+                    help="lm bench: replace the dense MLP with an N-expert "
+                         "MoE layer (0 = dense); single-chip this measures "
+                         "the dispatch/combine einsum path, multi-chip the "
+                         "expert axis shards it")
+    ap.add_argument("--moe-group-size", type=int, default=256,
+                    help="GShard routing group (tokens) for --moe-experts")
     ap.add_argument("--remat-policy", default="nobatch",
                     choices=["nobatch", "dots"],
                     help="lm remat checkpoint policy (on-chip sweep knob)")

@@ -5,14 +5,24 @@ GShard-style dense dispatch, shaped for the TPU:
 
   - routing, dispatch and combine are einsums (MXU work, no gather/scatter
     with dynamic shapes — XLA keeps static tiling);
+  - **grouped dispatch**: tokens are routed in fixed-size groups, each
+    filling its own per-group expert slots (GShard's group dimension).
+    The one-hot dispatch/combine einsums cost O(tokens * E*C * d); with a
+    single group E*C grows with top_k * tokens, making dispatch O(N^2 d)
+    — measured 675 ms/step at the bench config, dwarfing the experts
+    themselves.  Fixed groups make E*C a constant (group * top_k *
+    capacity_factor), so dispatch is linear in N;
+  - fixed per-group expert capacity C = ceil(group * top_k / E *
+    capacity_factor) (slots scale with top_k, the GShard convention —
+    otherwise uniform top-2 routing already drops second choices):
+    tokens over capacity are dropped (residual connection carries them),
+    the standard trade for static shapes;
   - expert weight tensors carry the ("expert", ...) logical axis, so the
     rule table places experts on the `expert` mesh axis and XLA inserts
     the all-to-alls implied by the dispatch einsums;
-  - fixed expert capacity C = ceil(tokens/E * capacity_factor): tokens
-    over capacity are dropped (residual connection carries them), the
-    standard trade for static shapes;
-  - Switch-style load-balancing aux loss, sown into the "losses"
-    collection (models/transformer.py threads it into the train loss).
+  - Switch-style load-balancing aux loss over ALL tokens (not per group),
+    sown into the "losses" collection (models/transformer.py threads it
+    into the train loss).
 """
 
 from __future__ import annotations
@@ -34,6 +44,12 @@ class MoEMLP(nn.Module):
     num_experts: int
     top_k: int = 2
     capacity_factor: float = 1.25
+    # Routing group size (tokens): dispatch cost per token is
+    # proportional to it, capacity granularity inversely.  The effective
+    # size is a divisor of the token count <= this (gcd fallback), so any
+    # batch shape works.  256 measured best on v5e (TransformerConfig
+    # .moe_group_size documents the sweep).
+    group_size: int = 256
     dtype: object = jnp.bfloat16
 
     @nn.compact
@@ -41,9 +57,12 @@ class MoEMLP(nn.Module):
         cfg_e, d, f = self.num_experts, self.d_model, self.d_ff
         b, s, _ = x.shape
         n_tokens = b * s
+        g = n_tokens if n_tokens <= self.group_size \
+            else math.gcd(n_tokens, self.group_size)
+        n_groups = n_tokens // g
         capacity = max(
             self.top_k,
-            int(math.ceil(n_tokens / cfg_e * self.capacity_factor)),
+            int(math.ceil(g * self.top_k / cfg_e * self.capacity_factor)),
         )
 
         wr = self.param(
@@ -64,56 +83,63 @@ class MoEMLP(nn.Module):
             (cfg_e, f, d), jnp.float32,
         )
 
-        tokens = x.reshape(n_tokens, d)
+        tokens = x.reshape(n_groups, g, d)
         # Routing in fp32 (softmax stability matters more than MXU here).
-        logits = tokens.astype(jnp.float32) @ wr          # [N, E]
+        logits = jnp.einsum(
+            "gnd,de->gne", tokens.astype(jnp.float32), wr)
         probs = jax.nn.softmax(logits, axis=-1)
 
-        # Top-k dispatch with capacity. Greedy per-choice cumsum positions.
-        gate_vals, gate_idx = jax.lax.top_k(probs, self.top_k)  # [N, k]
+        # Top-k dispatch with per-group capacity.  Greedy per-choice
+        # cumsum positions along the token axis of each group.
+        gate_vals, gate_idx = jax.lax.top_k(probs, self.top_k)  # [G, g, k]
         # Renormalise the kept gates.
         gate_vals = gate_vals / jnp.maximum(
             gate_vals.sum(-1, keepdims=True), 1e-9)
 
-        dispatch = jnp.zeros((n_tokens, cfg_e, capacity), jnp.bfloat16)
-        combine = jnp.zeros((n_tokens, cfg_e, capacity), jnp.float32)
-        counts = jnp.zeros((cfg_e,), jnp.int32)
+        dispatch = jnp.zeros(
+            (n_groups, g, cfg_e, capacity), jnp.bfloat16)
+        combine = jnp.zeros(
+            (n_groups, g, cfg_e, capacity), jnp.float32)
+        counts = jnp.zeros((n_groups, cfg_e), jnp.int32)
         for choice in range(self.top_k):
-            idx = gate_idx[:, choice]                      # [N]
+            idx = gate_idx[..., choice]                    # [G, g]
             onehot = jax.nn.one_hot(idx, cfg_e, dtype=jnp.int32)
-            pos = counts[None, :] + jnp.cumsum(onehot, axis=0) - 1
+            pos = counts[:, None, :] + jnp.cumsum(onehot, axis=1) - 1
             my_pos = jnp.take_along_axis(
-                pos, idx[:, None], axis=1)[:, 0]           # [N]
+                pos, idx[..., None], axis=2)[..., 0]       # [G, g]
             keep = my_pos < capacity
-            counts = counts + onehot.sum(0)
+            counts = counts + onehot.sum(1)
             pos_onehot = jax.nn.one_hot(
                 jnp.where(keep, my_pos, capacity), capacity + 1,
-                dtype=jnp.float32)[:, :capacity]           # [N, C]
-            contrib = (onehot.astype(jnp.float32)[:, :, None]
-                       * pos_onehot[:, None, :])           # [N, E, C]
+                dtype=jnp.float32)[..., :capacity]         # [G, g, C]
+            contrib = (onehot.astype(jnp.float32)[..., :, None]
+                       * pos_onehot[..., None, :])         # [G, g, E, C]
             dispatch = dispatch + contrib.astype(jnp.bfloat16)
-            combine = combine + contrib * gate_vals[:, choice, None, None]
+            combine = combine + contrib * gate_vals[..., choice, None, None]
 
-        # Expert compute: [E, C, d] batched SwiGLU — one big MXU batch.
+        # Expert compute: [G, E, C, d] batched SwiGLU — one big MXU batch.
         expert_in = jnp.einsum(
-            "nec,nd->ecd", dispatch, tokens.astype(jnp.bfloat16))
+            "gnec,gnd->gecd", dispatch, tokens.astype(jnp.bfloat16))
         expert_in = nn.with_logical_constraint(
-            expert_in, ("expert", None, None))
+            expert_in, (None, "expert", None, None))
         dt = self.dtype
-        gate = jnp.einsum("ecd,edf->ecf", expert_in, wi[:, 0].astype(dt))
-        up = jnp.einsum("ecd,edf->ecf", expert_in, wi[:, 1].astype(dt))
+        gate = jnp.einsum("gecd,edf->gecf", expert_in, wi[:, 0].astype(dt))
+        up = jnp.einsum("gecd,edf->gecf", expert_in, wi[:, 1].astype(dt))
         h = nn.silu(gate) * up
-        h = nn.with_logical_constraint(h, ("expert", None, "mlp"))
-        expert_out = jnp.einsum("ecf,efd->ecd", h, wo.astype(dt))
+        h = nn.with_logical_constraint(h, (None, "expert", None, "mlp"))
+        expert_out = jnp.einsum("gecf,efd->gecd", h, wo.astype(dt))
 
         out = jnp.einsum(
-            "nec,ecd->nd", combine.astype(dt), expert_out)
+            "gnec,gecd->gnd", combine.astype(dt), expert_out)
 
         # Switch load-balance loss: E * sum_e (fraction of tokens routed
         # to e) * (mean router prob of e); minimised by uniform routing.
-        top1 = jax.nn.one_hot(gate_idx[:, 0], cfg_e, dtype=jnp.float32)
+        # Global over all tokens — routing balance is a model property,
+        # not a per-group one.
+        top1 = jax.nn.one_hot(
+            gate_idx[..., 0].reshape(n_tokens), cfg_e, dtype=jnp.float32)
         fraction = top1.mean(0)
-        mean_prob = probs.mean(0)
+        mean_prob = probs.reshape(n_tokens, cfg_e).mean(0)
         aux = cfg_e * jnp.sum(fraction * mean_prob)
         self.sow("losses", "moe_aux", aux)
 

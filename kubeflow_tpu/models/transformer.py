@@ -83,6 +83,11 @@ class TransformerConfig:
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
     moe_aux_coef: float = 0.01
+    # GShard routing group (tokens); dispatch-einsum cost per token is
+    # proportional to it, capacity granularity inversely.  On-chip sweep
+    # at the bench config (4 experts, ms/step): 128 -> 516, 256 -> 471,
+    # 512 -> 495, 1024 -> 528 — see models/moe.py.
+    moe_group_size: int = 256
 
     def __post_init__(self):
         assert self.n_heads % self.n_kv_heads == 0
@@ -94,6 +99,11 @@ class TransformerConfig:
             self.n_heads + 2 * self.n_kv_heads
         ) + self.n_heads * self.head_dim * self.d_model
         p_mlp = 3 * self.d_model * self.d_ff
+        if self.moe_experts > 0:
+            # Useful MLP flops per token = the top_k experts it routes to
+            # plus the router matmul; idle experts' weights are not work.
+            p_mlp = self.moe_top_k * p_mlp \
+                + self.d_model * self.moe_experts
         p_embed = self.vocab_size * self.d_model
         matmul = 2 * (self.n_layers * (p_attn + p_mlp) + p_embed)
         attn = 2 * 2 * self.n_layers * self.n_heads * self.head_dim \
@@ -247,7 +257,8 @@ class Block(nn.Module):
             y = MoEMLP(
                 d_model=cfg.d_model, d_ff=cfg.d_ff,
                 num_experts=cfg.moe_experts, top_k=cfg.moe_top_k,
-                capacity_factor=cfg.moe_capacity_factor, dtype=cfg.dtype,
+                capacity_factor=cfg.moe_capacity_factor,
+                group_size=cfg.moe_group_size, dtype=cfg.dtype,
                 name="moe",
             )(y)
         else:
