@@ -209,6 +209,7 @@ def bench_lm(args, devices, n_chips, on_tpu):
             remat_policy=args.remat_policy,
             save_attn_residuals=not args.no_save_attn,
             moe_experts=args.moe_experts,
+            moe_group_size=args.moe_group_size,
         )
         batch = args.batch or 4 * n_chips
     print(
@@ -251,7 +252,9 @@ def bench_lm(args, devices, n_chips, on_tpu):
             "mfu": round(achieved_mfu, 4),
             "device": devices[0].device_kind,
             **({"moe_experts": cfg.moe_experts,
-                "moe_top_k": cfg.moe_top_k} if cfg.moe_experts else {}),
+                "moe_top_k": cfg.moe_top_k,
+                "moe_group_size": cfg.moe_group_size}
+               if cfg.moe_experts else {}),
         },
     }
 
@@ -501,14 +504,14 @@ def bench_lm_decode(args, devices, n_chips, on_tpu):
             "n_heads": 8, "n_kv_heads": 8, "d_ff": 2816, "head_dim": 128,
             "max_seq_len": 2048, "dtype": "bfloat16",
         }
-        prompt_len, new_tokens, batch = 128, 128, 8
+        prompt_len, new_tokens, batch = 128, 128, args.batch or 8
     else:
         overrides = {
             "vocab_size": 256, "d_model": 64, "n_layers": 2, "n_heads": 4,
             "n_kv_heads": 4, "d_ff": 128, "head_dim": 16,
             "max_seq_len": 128, "dtype": "float32",
         }
-        prompt_len, new_tokens, batch = 16, 16, 4
+        prompt_len, new_tokens, batch = 16, 16, args.batch or 4
     print(f"bench: lm decode, d_model={overrides['d_model']} "
           f"L{overrides['n_layers']}, prompt {prompt_len} + {new_tokens} "
           f"new, {devices[0].device_kind}", file=sys.stderr)

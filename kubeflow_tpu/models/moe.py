@@ -57,8 +57,14 @@ class MoEMLP(nn.Module):
         cfg_e, d, f = self.num_experts, self.d_model, self.d_ff
         b, s, _ = x.shape
         n_tokens = b * s
-        g = n_tokens if n_tokens <= self.group_size \
-            else math.gcd(n_tokens, self.group_size)
+        # Largest divisor of n_tokens <= group_size (bounded scan at
+        # trace time; a gcd shortcut degenerates badly for token counts
+        # sharing few factors with a power-of-two group size — e.g.
+        # gcd(2046, 256) = 2 would give per-2-token groups whose
+        # capacity clamps to top_k, inflating expert compute to E slots
+        # per token and never dropping anything).
+        g = next(cand for cand in range(min(self.group_size, n_tokens), 0, -1)
+                 if n_tokens % cand == 0)
         n_groups = n_tokens // g
         capacity = max(
             self.top_k,

@@ -75,3 +75,21 @@ class TestMoETransformer:
                        log_every=0)
         assert np.isfinite(tr._last_metrics["loss"])
         assert "moe_aux" in tr._last_metrics
+
+
+class TestGroupFit:
+    def test_odd_token_count_gets_largest_divisor_group(self):
+        # 2 x 33 = 66 tokens, group_size 16 -> largest divisor 11 (a gcd
+        # shortcut would give 2, collapsing capacity to top_k).
+        layer = MoEMLP(d_model=8, d_ff=16, num_experts=2, group_size=16)
+        x = jnp.asarray(np.random.RandomState(2).randn(2, 33, 8),
+                        jnp.bfloat16)
+        variables = layer.init(jax.random.key(0), x)
+        out, _ = layer.apply(variables, x, mutable=["losses"])
+        assert out.shape == (2, 33, 8)
+        assert np.isfinite(np.asarray(out, np.float32)).all()
+        # The dispatch tensor shape pins the fitted group: [G, g, E, C].
+        jaxpr = str(jax.make_jaxpr(
+            lambda v, x: layer.apply(v, x, mutable=["losses"]))(
+                variables, x))
+        assert "6,11,2," in jaxpr, "expected 6 groups of 11 tokens"
