@@ -521,10 +521,13 @@ def bench_lm_decode(args, devices, n_chips, on_tpu):
     init_tokens = jnp.zeros((1, prompt_len), jnp.int32)
     variables = model.init(jax.random.key(0), init_tokens)
     with tempfile.TemporaryDirectory() as tmp:
+        config = {"model": overrides, "max_new_tokens": new_tokens,
+                  "temperature": 0.0}
+        if args.quantize:
+            config["quantize"] = args.quantize
         export(f"{tmp}/lm", 1, variables,
                loader="kubeflow_tpu.serving.loaders:lm_generate",
-               config={"model": overrides, "max_new_tokens": new_tokens,
-                       "temperature": 0.0})
+               config=config)
         server = ModelServer()
         server.add_model("lm", f"{tmp}/lm")
 
@@ -570,6 +573,7 @@ def bench_lm_decode(args, devices, n_chips, on_tpu):
             "d_model": overrides["d_model"],
             "n_layers": overrides["n_layers"],
             "device": devices[0].device_kind,
+            **({"quantize": args.quantize} if args.quantize else {}),
         },
     }
 
@@ -692,6 +696,8 @@ def main() -> None:
                          "MoE layer (0 = dense); single-chip this measures "
                          "the dispatch/combine einsum path, multi-chip the "
                          "expert axis shards it")
+    ap.add_argument("--quantize", default=None, choices=[None, "int8"],
+                    help="lm-decode: weight-only quantization mode")
     ap.add_argument("--moe-group-size", type=int, default=256,
                     help="GShard routing group (tokens) for --moe-experts")
     ap.add_argument("--remat-policy", default="nobatch",

@@ -31,6 +31,7 @@ from kubeflow_tpu.models.transformer import (
     rope,
 )
 from kubeflow_tpu.ops.attention import dot_product_attention
+from kubeflow_tpu.ops.quantize import embed_lookup, qeinsum
 
 
 @dataclasses.dataclass(frozen=True)
@@ -61,9 +62,11 @@ def _layer_step(cfg: TransformerConfig, layer_params, x, cache_kv,
         return (normed * scale).astype(dt)
 
     y = norm(x, layer_params["attn_norm"]["scale"])
-    q = jnp.einsum("bse,ehd->bshd", y, attn["wq"].astype(dt))
-    k = jnp.einsum("bse,ehd->bshd", y, attn["wkv"][0].astype(dt))
-    v = jnp.einsum("bse,ehd->bshd", y, attn["wkv"][1].astype(dt))
+    # qeinsum keeps int8 serving weights quantized through the dot
+    # (per-output-channel scales applied after; ops/quantize.py).
+    q = qeinsum("bse,ehd->bshd", y, attn["wq"], dt)
+    k = qeinsum("bse,ehd->bshd", y, attn["wkv"][0], dt)
+    v = qeinsum("bse,ehd->bshd", y, attn["wkv"][1], dt)
     q = rope(q, positions, cfg.rope_theta)
     k = rope(k, positions, cfg.rope_theta)
 
@@ -79,14 +82,14 @@ def _layer_step(cfg: TransformerConfig, layer_params, x, cache_kv,
     out = dot_product_attention(
         q, ck, cv, causal=True, kv_offset=cache_len,
     )
-    y = jnp.einsum("bshd,hde->bse", out, attn["wo"].astype(dt))
+    y = qeinsum("bshd,hde->bse", out, attn["wo"], dt)
     x = x + y
     y = norm(x, layer_params["mlp_norm"]["scale"])
     mlp = layer_params["mlp"]
-    gate = jnp.einsum("bse,ef->bsf", y, mlp["wi"][0].astype(dt))
-    up = jnp.einsum("bse,ef->bsf", y, mlp["wi"][1].astype(dt))
+    gate = qeinsum("bse,ef->bsf", y, mlp["wi"][0], dt)
+    up = qeinsum("bse,ef->bsf", y, mlp["wi"][1], dt)
     h = jax.nn.silu(gate) * up
-    y = jnp.einsum("bsf,fe->bse", h, mlp["wo"].astype(dt))
+    y = qeinsum("bsf,fe->bse", h, mlp["wo"], dt)
     return x + y, (ck, cv)
 
 
@@ -98,7 +101,7 @@ def _forward_with_cache(cfg: TransformerConfig, params, tokens, cache,
     params = nn.unbox(params)  # accept raw model.init output
     dt = cfg.dtype
     embed = params["embed"]
-    x = embed.astype(dt)[tokens]
+    x = embed_lookup(embed, tokens, dt)  # int8-aware row gather
     positions = cache_len + jnp.arange(tokens.shape[1])[None, :]
     positions = jnp.broadcast_to(positions, tokens.shape)
 
@@ -128,9 +131,9 @@ def _forward_with_cache(cfg: TransformerConfig, params, tokens, cache,
         jnp.mean(x32 * x32, axis=-1, keepdims=True) + 1e-6) * scale
     ).astype(dt)
     if cfg.tied_embeddings:
-        logits = jnp.einsum("bse,ve->bsv", x, embed.astype(dt))
+        logits = qeinsum("bse,ve->bsv", x, embed, dt)
     else:
-        logits = jnp.einsum("bse,ev->bsv", x, params["w_out"].astype(dt))
+        logits = qeinsum("bse,ev->bsv", x, params["w_out"], dt)
     return logits.astype(jnp.float32), (cache_k, cache_v)
 
 

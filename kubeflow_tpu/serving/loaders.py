@@ -110,7 +110,8 @@ def lm_generate(config: Dict[str, Any]) -> Callable:
     """Autoregressive generation loader.
 
     config: {"model": TransformerConfig overrides,
-             "max_new_tokens": int, "temperature": float}
+             "max_new_tokens": int, "temperature": float,
+             "quantize": "int8" (optional, weight-only)}
     Signature: {"tokens": [b, t] int32} -> {"tokens": [b, t+new] int32}
     """
     from kubeflow_tpu.models.generate import DecodeConfig, generate
@@ -121,6 +122,9 @@ def lm_generate(config: Dict[str, Any]) -> Callable:
         temperature=float(config.get("temperature", 0.0)),
         eos_token=int(config.get("eos_token", -1)),
     )
+    quantize = config.get("quantize")
+    if quantize not in (None, "int8"):
+        raise ValueError(f"unknown quantize mode {quantize!r}")
 
     def make_predict(variables):
         # Stage weights into HBM ONCE at load.  They are an argument to
@@ -128,7 +132,26 @@ def lm_generate(config: Dict[str, Any]) -> Callable:
         # re-transfers host-numpy arguments on every call — measured as
         # ~40 s/request for a 188M model through the bench harness's
         # slow host link vs ~0.1 ms/token with resident params.
-        params = jax.device_put(variables["params"])
+        # Weight-only int8 quantization happens host-side BEFORE the
+        # staging transfer (fewer bytes over the link, fewer HBM reads
+        # per decoded token; ops/quantize.py).  Without it, matmul
+        # weights are narrowed to the model compute dtype at staging:
+        # checkpoints carry float32 masters, and serving float32 would
+        # double every per-token weight read just to feed casts the
+        # matmuls do anyway.  1D params (norm scales) stay float32 —
+        # byte-free and precision-relevant.
+        params = variables["params"]
+        if quantize == "int8":
+            from kubeflow_tpu.ops.quantize import quantize_params
+
+            params = quantize_params(params)
+        else:
+            params = jax.tree.map(
+                lambda x: x.astype(cfg.dtype)
+                if jnp.issubdtype(x.dtype, jnp.floating) and x.ndim > 1
+                else x,
+                params)
+        params = jax.device_put(params)
 
         def predict(inputs: Dict[str, Any]) -> Dict[str, Any]:
             tokens = jnp.asarray(inputs["tokens"], jnp.int32)

@@ -1,0 +1,130 @@
+"""Weight-only int8 serving quantization: roundtrip error, decode path,
+loader integration.  New TPU-first capability — the reference served
+float SavedModels only (kubeflow/tf-serving/tf-serving.libsonnet)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubeflow_tpu.models.generate import DecodeConfig, generate
+from kubeflow_tpu.models.transformer import Transformer, TransformerConfig
+from kubeflow_tpu.ops.quantize import (
+    QTensor,
+    embed_lookup,
+    qeinsum,
+    quantize_params,
+)
+
+CFG = TransformerConfig(
+    vocab_size=128, d_model=32, n_layers=2, n_heads=4, n_kv_heads=4,
+    d_ff=64, head_dim=8, max_seq_len=64, dtype=jnp.float32,
+)
+
+
+def _params(seed=0):
+    from flax import linen as nn
+
+    model = Transformer(CFG)
+    toks = jnp.zeros((1, 8), jnp.int32)
+    # Unboxed, like orbax-restored serving checkpoints; quantize_params
+    # also works through flax partitioning boxes (loader test covers it).
+    return nn.unbox(model.init(jax.random.key(seed), toks)["params"])
+
+
+class TestQuantizeParams:
+    def test_known_weights_become_qtensors(self):
+        q = quantize_params(_params())
+        layers = q["layers"]
+        assert isinstance(layers["attn"]["wq"], QTensor)
+        assert isinstance(layers["mlp"]["wi"], QTensor)
+        assert isinstance(q["embed"], QTensor)
+        # Norm scales stay full precision.
+        assert not isinstance(layers["attn_norm"]["scale"], QTensor)
+        assert layers["attn"]["wq"].values.dtype == jnp.int8
+
+    def test_per_channel_roundtrip_error_bounded(self):
+        p = _params()
+        q = quantize_params(p)
+        for name in ("wq", "wo"):
+            orig = np.asarray(p["layers"]["attn"][name], np.float32)
+            deq = np.asarray(
+                q["layers"]["attn"][name].astype(jnp.float32))
+            # Symmetric int8: error <= scale/2 = amax/254 per channel.
+            err = np.abs(orig - deq)
+            assert err.max() <= np.abs(orig).max() / 254 + 1e-7
+
+    def test_qeinsum_matches_dequantized_dense(self):
+        p = _params()
+        q = quantize_params(p)
+        x = jnp.asarray(
+            np.random.RandomState(0).randn(2, 3, CFG.d_model), jnp.float32)
+        wq = q["layers"]["attn"]["wq"][0]       # one layer [e, h, d]
+        got = qeinsum("bse,ehd->bshd", x, wq, jnp.float32)
+        want = jnp.einsum(
+            "bse,ehd->bshd", x, wq.astype(jnp.float32))
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5)
+
+    def test_embed_lookup_matches_dequant_gather(self):
+        q = quantize_params(_params())
+        toks = jnp.asarray([[1, 5, 7]], jnp.int32)
+        got = embed_lookup(q["embed"], toks, jnp.float32)
+        want = q["embed"].astype(jnp.float32)[toks]
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=1e-6)
+
+
+class TestQuantizedDecode:
+    def test_generate_runs_and_tracks_fp32(self):
+        p = _params()
+        q = quantize_params(p)
+        prompt = jnp.asarray(
+            np.random.RandomState(1).randint(1, CFG.vocab_size, (2, 8)),
+            jnp.int32)
+        dec = DecodeConfig(max_new_tokens=8)
+        toks_f, logits_f = generate(CFG, p, prompt, dec)
+        toks_q, logits_q = generate(CFG, q, prompt, dec)
+        assert toks_q.shape == toks_f.shape == (2, 16)
+        assert np.isfinite(np.asarray(logits_q)).all()
+        # Per-channel int8 keeps final logits close on a tiny model; the
+        # decode trajectory may legitimately diverge after sampling, so
+        # compare one prefill-step's logits instead of token ids.
+        _, l_f = generate(CFG, p, prompt, DecodeConfig(max_new_tokens=1))
+        _, l_q = generate(CFG, q, prompt, DecodeConfig(max_new_tokens=1))
+        cos = np.sum(np.asarray(l_f) * np.asarray(l_q)) / (
+            np.linalg.norm(l_f) * np.linalg.norm(l_q) + 1e-9)
+        assert cos > 0.99, cos
+
+
+class TestLoaderIntegration:
+    def test_lm_generate_quantize_config(self, tmp_path):
+        from kubeflow_tpu.serving.export import export
+        from kubeflow_tpu.serving.model_server import ModelServer
+
+        model = Transformer(CFG)
+        variables = model.init(jax.random.key(0),
+                               jnp.zeros((1, 8), jnp.int32))
+        overrides = {
+            "vocab_size": CFG.vocab_size, "d_model": CFG.d_model,
+            "n_layers": CFG.n_layers, "n_heads": CFG.n_heads,
+            "n_kv_heads": CFG.n_kv_heads, "d_ff": CFG.d_ff,
+            "head_dim": CFG.head_dim, "max_seq_len": CFG.max_seq_len,
+            "dtype": "float32",
+        }
+        export(str(tmp_path / "lm"), 1, variables,
+               loader="kubeflow_tpu.serving.loaders:lm_generate",
+               config={"model": overrides, "max_new_tokens": 4,
+                       "temperature": 0.0, "quantize": "int8"})
+        server = ModelServer()
+        server.add_model("lm", str(tmp_path / "lm"))
+        out = server.predict(
+            "lm", {"tokens": np.asarray([[3, 1, 4]], np.int32)})
+        assert np.asarray(out["tokens"]).shape == (1, 7)
+
+    def test_unknown_quantize_mode_rejected(self):
+        import pytest
+
+        from kubeflow_tpu.serving.loaders import lm_generate
+
+        with pytest.raises(ValueError, match="quantize"):
+            lm_generate({"quantize": "fp4"})
