@@ -186,10 +186,18 @@ def bench_lm(args, devices, n_chips, on_tpu):
     from kubeflow_tpu.runtime.train import Trainer
 
     seq = args.seq_len if on_tpu else min(args.seq_len, 128)
+    # Size presets (per-chip batch chosen to fit v5e HBM with the
+    # memory-minimal remat policy).
+    sizes = {
+        "188m": dict(d_model=1024, n_layers=12, n_heads=8, n_kv_heads=8,
+                     d_ff=2816, head_dim=128, batch=8),
+        "470m": dict(d_model=1536, n_layers=16, n_heads=12, n_kv_heads=12,
+                     d_ff=4224, head_dim=128, batch=4),
+    }[args.lm_size]
     if on_tpu:
         cfg = TransformerConfig(
-            vocab_size=32_000, d_model=1024, n_layers=12, n_heads=8,
-            n_kv_heads=8, d_ff=2816, head_dim=128, max_seq_len=seq,
+            vocab_size=32_000, max_seq_len=seq,
+            **{k: v for k, v in sizes.items() if k != "batch"},
             dtype=jnp.bfloat16, attention=args.attention,
             remat=not args.no_remat,
             remat_policy=args.remat_policy,
@@ -199,7 +207,7 @@ def bench_lm(args, devices, n_chips, on_tpu):
             moe_experts=args.moe_experts,
             moe_group_size=args.moe_group_size,
         )
-        batch = args.batch or 8 * n_chips
+        batch = args.batch or sizes["batch"] * n_chips
     else:  # tiny hermetic config for --fake-devices runs
         cfg = TransformerConfig(
             vocab_size=512, d_model=64, n_layers=2, n_heads=4, n_kv_heads=4,
@@ -251,6 +259,7 @@ def bench_lm(args, devices, n_chips, on_tpu):
             "n_chips": n_chips,
             "mfu": round(achieved_mfu, 4),
             "device": devices[0].device_kind,
+            "lm_size": args.lm_size,
             **({"moe_experts": cfg.moe_experts,
                 "moe_top_k": cfg.moe_top_k,
                 "moe_group_size": cfg.moe_group_size}
@@ -696,6 +705,8 @@ def main() -> None:
                          "MoE layer (0 = dense); single-chip this measures "
                          "the dispatch/combine einsum path, multi-chip the "
                          "expert axis shards it")
+    ap.add_argument("--lm-size", default="188m", choices=["188m", "470m"],
+                    help="lm bench model size preset (on-TPU only)")
     ap.add_argument("--quantize", default=None, choices=[None, "int8"],
                     help="lm-decode: weight-only quantization mode")
     ap.add_argument("--moe-group-size", type=int, default=256,

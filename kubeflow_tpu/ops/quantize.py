@@ -127,6 +127,31 @@ def quantize_params(params: Any, bits: int = 8) -> Any:
     return jax.tree_util.tree_unflatten(treedef, leaves)
 
 
+def narrow_params(params: Any, dtype) -> Any:
+    """Cast the known matmul weights (CONTRACTIONS table) to ``dtype``.
+
+    The staging-precision counterpart of quantize_params: checkpoints
+    carry float32 masters, and serving them as-is doubles every HBM
+    weight read just to feed casts the matmuls do anyway.  Norm scales
+    and anything else off the table keep their checkpoint dtype —
+    including the nn.scan-stacked per-layer norm scales, which are 2-D
+    and would be miscaught by any rank-based heuristic.
+    """
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+
+    def visit(path, leaf):
+        names = tuple(
+            p.key for p in path
+            if isinstance(p, jax.tree_util.DictKey)
+        )
+        if _match(names) is None:
+            return leaf
+        return leaf.astype(dtype)
+
+    return jax.tree_util.tree_unflatten(
+        treedef, [visit(path, leaf) for path, leaf in flat])
+
+
 def qeinsum(eq: str, x: jax.Array, w: Any, dtype) -> jax.Array:
     """einsum with an optionally-quantized second operand.
 

@@ -146,11 +146,9 @@ def lm_generate(config: Dict[str, Any]) -> Callable:
 
             params = quantize_params(params)
         else:
-            params = jax.tree.map(
-                lambda x: x.astype(cfg.dtype)
-                if jnp.issubdtype(x.dtype, jnp.floating) and x.ndim > 1
-                else x,
-                params)
+            from kubeflow_tpu.ops.quantize import narrow_params
+
+            params = narrow_params(params, cfg.dtype)
         params = jax.device_put(params)
 
         def predict(inputs: Dict[str, Any]) -> Dict[str, Any]:

@@ -128,3 +128,18 @@ class TestLoaderIntegration:
 
         with pytest.raises(ValueError, match="quantize"):
             lm_generate({"quantize": "fp4"})
+
+
+class TestNarrowParams:
+    def test_matmul_weights_narrow_norms_stay_f32(self):
+        from kubeflow_tpu.ops.quantize import narrow_params
+
+        p = _params()
+        n = narrow_params(p, jnp.bfloat16)
+        assert n["layers"]["attn"]["wq"].dtype == jnp.bfloat16
+        assert n["embed"].dtype == jnp.bfloat16
+        # nn.scan-stacked per-layer norm scales are 2-D [L, d] — a rank
+        # heuristic would narrow them; the contraction table must not.
+        assert n["layers"]["attn_norm"]["scale"].dtype == jnp.float32
+        assert n["layers"]["attn_norm"]["scale"].ndim == 2
+        assert n["final_norm"]["scale"].dtype == jnp.float32
