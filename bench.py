@@ -534,6 +534,8 @@ def bench_lm_decode(args, devices, n_chips, on_tpu):
                   "temperature": 0.0}
         if args.quantize:
             config["quantize"] = args.quantize
+        if args.kv_cache:
+            config["kv_cache"] = args.kv_cache
         export(f"{tmp}/lm", 1, variables,
                loader="kubeflow_tpu.serving.loaders:lm_generate",
                config=config)
@@ -583,6 +585,7 @@ def bench_lm_decode(args, devices, n_chips, on_tpu):
             "n_layers": overrides["n_layers"],
             "device": devices[0].device_kind,
             **({"quantize": args.quantize} if args.quantize else {}),
+            **({"kv_cache": args.kv_cache} if args.kv_cache else {}),
         },
     }
 
@@ -709,6 +712,9 @@ def main() -> None:
                     help="lm bench model size preset (on-TPU only)")
     ap.add_argument("--quantize", default=None, choices=[None, "int8"],
                     help="lm-decode: weight-only quantization mode")
+    ap.add_argument("--kv-cache", default=None, choices=[None, "int8"],
+                    help="lm-decode: quantized KV cache "
+                         "(per-position scales)")
     ap.add_argument("--moe-group-size", type=int, default=256,
                     help="GShard routing group (tokens) for --moe-experts")
     ap.add_argument("--remat-policy", default="nobatch",

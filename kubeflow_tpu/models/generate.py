@@ -31,7 +31,12 @@ from kubeflow_tpu.models.transformer import (
     rope,
 )
 from kubeflow_tpu.ops.attention import dot_product_attention
-from kubeflow_tpu.ops.quantize import embed_lookup, qeinsum
+from kubeflow_tpu.ops.quantize import (
+    QTensor,
+    embed_lookup,
+    qeinsum,
+    quantize_array,
+)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -39,6 +44,11 @@ class DecodeConfig:
     max_new_tokens: int = 64
     temperature: float = 0.0   # 0 = greedy
     eos_token: int = -1        # -1 = never stop early
+    # "model" = the model compute dtype; "int8" = quantized cache with
+    # per-(position, head) scales (halves cache HBM traffic and memory —
+    # the binding resource for batched decode; ops/attention.py folds the
+    # scales through both matmuls so nothing dequantized materializes).
+    kv_cache_dtype: str = "model"
 
 
 def _layer_step(cfg: TransformerConfig, layer_params, x, cache_kv,
@@ -72,10 +82,24 @@ def _layer_step(cfg: TransformerConfig, layer_params, x, cache_kv,
 
     ck, cv = cache_kv
     t = x.shape[1]
-    ck = jax.lax.dynamic_update_slice_in_dim(ck, k.astype(ck.dtype),
-                                             cache_len, axis=1)
-    cv = jax.lax.dynamic_update_slice_in_dim(cv, v.astype(cv.dtype),
-                                             cache_len, axis=1)
+    if isinstance(ck, QTensor):
+        def store(c, new):
+            vals, s = quantize_array(new, (-1,))    # [b, t, hk, d]
+            return QTensor(
+                jax.lax.dynamic_update_slice_in_dim(
+                    c.values, vals, cache_len, axis=1),
+                jax.lax.dynamic_update_slice_in_dim(
+                    c.scale, s, cache_len, axis=1),
+                c.axes,
+            )
+
+        ck = store(ck, k)
+        cv = store(cv, v)
+    else:
+        ck = jax.lax.dynamic_update_slice_in_dim(ck, k.astype(ck.dtype),
+                                                 cache_len, axis=1)
+        cv = jax.lax.dynamic_update_slice_in_dim(cv, v.astype(cv.dtype),
+                                                 cache_len, axis=1)
     # Attend over the whole buffer; positions beyond cache_len + t are
     # masked by the causal rule (their k_pos > any live q_pos... they are
     # zeros at positions >= cache_len+t, masked via kv_offset arithmetic).
@@ -137,8 +161,20 @@ def _forward_with_cache(cfg: TransformerConfig, params, tokens, cache,
     return logits.astype(jnp.float32), (cache_k, cache_v)
 
 
-def init_cache(cfg: TransformerConfig, batch: int, max_len: int):
+def init_cache(cfg: TransformerConfig, batch: int, max_len: int,
+               kv_cache_dtype: str = "model"):
     shape = (cfg.n_layers, batch, max_len, cfg.n_kv_heads, cfg.head_dim)
+    if kv_cache_dtype == "int8":
+        def buf():
+            return QTensor(
+                jnp.zeros(shape, jnp.int8),
+                jnp.zeros(shape[:-1], jnp.float32),
+                (-1,),
+            )
+
+        return (buf(), buf())
+    if kv_cache_dtype != "model":
+        raise ValueError(f"unknown kv_cache_dtype {kv_cache_dtype!r}")
     return (jnp.zeros(shape, cfg.dtype), jnp.zeros(shape, cfg.dtype))
 
 
@@ -157,7 +193,7 @@ def generate(
     """
     b, t = prompt.shape
     max_len = t + decode.max_new_tokens
-    cache = init_cache(cfg, b, max_len)
+    cache = init_cache(cfg, b, max_len, decode.kv_cache_dtype)
     if rng is None:
         rng = jax.random.key(0)
 

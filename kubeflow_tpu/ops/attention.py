@@ -37,8 +37,8 @@ def _repeat_kv(k: jax.Array, q_heads: int) -> jax.Array:
 
 def dot_product_attention(
     q: jax.Array,
-    k: jax.Array,
-    v: jax.Array,
+    k,
+    v,
     *,
     causal: bool = True,
     segment_ids: Optional[jax.Array] = None,
@@ -49,15 +49,38 @@ def dot_product_attention(
     kv_offset: absolute position of k[0] relative to q[0]'s frame — used by
     ring attention (rotating kv blocks) and decode (single-query vs cache).
     Softmax accumulates in fp32 regardless of input dtype (bf16-safe).
+
+    k/v may be int8 ``QTensor``s with per-(position, head) scales (the
+    quantized decode KV cache): scales commute through both matmuls —
+    the key scale multiplies score columns, the value scale folds into
+    the softmax weights — so the int8 values feed the dots directly and
+    nothing dequantized materializes.
     """
+    from kubeflow_tpu.ops.quantize import QTensor
+
     orig_dtype = q.dtype
     q_heads = q.shape[2]
-    k = _repeat_kv(k, q_heads)
-    v = _repeat_kv(v, q_heads)
+    k_scale = v_scale = None
+    if isinstance(k, QTensor):
+        # _repeat_kv repeats axis 2, which is heads for the [b, sk, hkv]
+        # scale exactly as for the 4-D values.
+        k, k_scale = _repeat_kv(k.values, q_heads), _repeat_kv(
+            k.scale, q_heads)
+    else:
+        k = _repeat_kv(k, q_heads)
+    if isinstance(v, QTensor):
+        v, v_scale = _repeat_kv(v.values, q_heads), _repeat_kv(
+            v.scale, q_heads)
+    else:
+        v = _repeat_kv(v, q_heads)
     scale = q.shape[-1] ** -0.5
     scores = jnp.einsum(
-        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+        "bqhd,bkhd->bhqk", q, k.astype(orig_dtype),
+        preferred_element_type=jnp.float32,
     ) * scale
+    if k_scale is not None:
+        # [b, sk, h] -> [b, h, 1, sk] column scales.
+        scores = scores * k_scale.transpose(0, 2, 1)[:, :, None, :]
     mask = _build_mask(
         q_len=q.shape[1], k_len=k.shape[1], causal=causal,
         segment_ids=segment_ids, kv_offset=kv_offset,
@@ -65,8 +88,11 @@ def dot_product_attention(
     if mask is not None:
         scores = jnp.where(mask, scores, jnp.finfo(jnp.float32).min)
     weights = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+    if v_scale is not None:
+        weights = weights * v_scale.transpose(0, 2, 1)[:, :, None, :]
     out = jnp.einsum(
-        "bhqk,bkhd->bqhd", weights.astype(orig_dtype), v,
+        "bhqk,bkhd->bqhd", weights.astype(orig_dtype),
+        v.astype(orig_dtype),
         preferred_element_type=jnp.float32,
     )
     return out.astype(orig_dtype)

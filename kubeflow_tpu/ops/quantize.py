@@ -96,14 +96,9 @@ def _match(path: Tuple[str, ...]):
     return None
 
 
-def quantize_params(params: Any, bits: int = 8) -> Any:
-    """Quantize known matmul weights of an LM param tree to QTensor.
-
-    Runs host-side (numpy) so the halved byte count also applies to the
-    host->device staging transfer.  Unknown leaves pass through.
-    """
-    assert bits == 8, "int8 is the only wired width"
-
+def map_matmul_weights(params: Any, fn) -> Any:
+    """Apply ``fn(leaf, contraction_axes)`` to every CONTRACTIONS-table
+    weight in the tree; other leaves pass through untouched."""
     flat, treedef = jax.tree_util.tree_flatten_with_path(params)
 
     def visit(path, leaf):
@@ -112,19 +107,40 @@ def quantize_params(params: Any, bits: int = 8) -> Any:
             if isinstance(p, jax.tree_util.DictKey)
         )
         axes = _match(names)
-        if axes is None:
-            return leaf
-        w = np.asarray(leaf, np.float32)
-        amax = np.max(np.abs(w), axis=axes, keepdims=True)
-        scale = np.maximum(amax, 1e-12) / 127.0
-        q = np.clip(np.rint(w / scale), -127, 127).astype(np.int8)
-        return QTensor(
-            jnp.asarray(q), jnp.asarray(np.squeeze(scale, axis=axes)),
-            axes,
-        )
+        return leaf if axes is None else fn(leaf, axes)
 
-    leaves = [visit(path, leaf) for path, leaf in flat]
-    return jax.tree_util.tree_unflatten(treedef, leaves)
+    return jax.tree_util.tree_unflatten(
+        treedef, [visit(path, leaf) for path, leaf in flat])
+
+
+def quantize_array(x, axes: Tuple[int, ...], eps: float = 1e-8, xp=jnp):
+    """Symmetric int8: (values, scale) with amax/127 scales over ``axes``.
+
+    Pass ``xp=numpy`` to run host-side (weight staging — jnp would route
+    the work through the device); the single definition keeps the weight
+    path and the KV-cache path on the same scheme.
+    """
+    x32 = x.astype("float32")
+    amax = xp.max(xp.abs(x32), axis=axes, keepdims=True)
+    scale = xp.maximum(amax, eps) / 127.0
+    vals = xp.clip(xp.round(x32 / scale), -127, 127).astype("int8")
+    return vals, xp.squeeze(scale, axis=axes)
+
+
+def quantize_params(params: Any, bits: int = 8) -> Any:
+    """Quantize known matmul weights of an LM param tree to QTensor.
+
+    Runs before device staging, so the reduced byte count also applies
+    to the host->device transfer.  Unknown leaves pass through.
+    """
+    assert bits == 8, "int8 is the only wired width"
+
+    def q(leaf, axes):
+        vals, scale = quantize_array(
+            np.asarray(leaf, np.float32), axes, eps=1e-12, xp=np)
+        return QTensor(jnp.asarray(vals), jnp.asarray(scale), axes)
+
+    return map_matmul_weights(params, q)
 
 
 def narrow_params(params: Any, dtype) -> Any:
@@ -137,19 +153,7 @@ def narrow_params(params: Any, dtype) -> Any:
     including the nn.scan-stacked per-layer norm scales, which are 2-D
     and would be miscaught by any rank-based heuristic.
     """
-    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
-
-    def visit(path, leaf):
-        names = tuple(
-            p.key for p in path
-            if isinstance(p, jax.tree_util.DictKey)
-        )
-        if _match(names) is None:
-            return leaf
-        return leaf.astype(dtype)
-
-    return jax.tree_util.tree_unflatten(
-        treedef, [visit(path, leaf) for path, leaf in flat])
+    return map_matmul_weights(params, lambda leaf, _: leaf.astype(dtype))
 
 
 def qeinsum(eq: str, x: jax.Array, w: Any, dtype) -> jax.Array:

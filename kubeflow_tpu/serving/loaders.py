@@ -111,16 +111,21 @@ def lm_generate(config: Dict[str, Any]) -> Callable:
 
     config: {"model": TransformerConfig overrides,
              "max_new_tokens": int, "temperature": float,
-             "quantize": "int8" (optional, weight-only)}
+             "quantize": "int8" (optional, weight-only),
+             "kv_cache": "int8" (optional, quantized decode cache)}
     Signature: {"tokens": [b, t] int32} -> {"tokens": [b, t+new] int32}
     """
     from kubeflow_tpu.models.generate import DecodeConfig, generate
 
     cfg = _model_config(config.get("model", {}))
+    kv_cache = config.get("kv_cache")
+    if kv_cache not in (None, "int8"):
+        raise ValueError(f"unknown kv_cache mode {kv_cache!r}")
     decode = DecodeConfig(
         max_new_tokens=int(config.get("max_new_tokens", 64)),
         temperature=float(config.get("temperature", 0.0)),
         eos_token=int(config.get("eos_token", -1)),
+        kv_cache_dtype=kv_cache or "model",
     )
     quantize = config.get("quantize")
     if quantize not in (None, "int8"):

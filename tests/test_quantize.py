@@ -143,3 +143,53 @@ class TestNarrowParams:
         assert n["layers"]["attn_norm"]["scale"].dtype == jnp.float32
         assert n["layers"]["attn_norm"]["scale"].ndim == 2
         assert n["final_norm"]["scale"].dtype == jnp.float32
+
+
+class TestInt8KVCache:
+    def test_quantized_cache_decode_tracks_native(self):
+        p = _params()
+        prompt = jnp.asarray(
+            np.random.RandomState(3).randint(1, CFG.vocab_size, (2, 8)),
+            jnp.int32)
+        _, l_native = generate(
+            CFG, p, prompt, DecodeConfig(max_new_tokens=4))
+        toks, l_q8 = generate(
+            CFG, p, prompt,
+            DecodeConfig(max_new_tokens=4, kv_cache_dtype="int8"))
+        assert toks.shape == (2, 12)
+        assert np.isfinite(np.asarray(l_q8)).all()
+        cos = np.sum(np.asarray(l_native) * np.asarray(l_q8)) / (
+            np.linalg.norm(l_native) * np.linalg.norm(l_q8) + 1e-9)
+        assert cos > 0.99, cos
+
+    def test_loader_kv_cache_config(self, tmp_path):
+        from kubeflow_tpu.serving.export import export
+        from kubeflow_tpu.serving.model_server import ModelServer
+
+        model = Transformer(CFG)
+        variables = model.init(jax.random.key(0),
+                               jnp.zeros((1, 8), jnp.int32))
+        overrides = {
+            "vocab_size": CFG.vocab_size, "d_model": CFG.d_model,
+            "n_layers": CFG.n_layers, "n_heads": CFG.n_heads,
+            "n_kv_heads": CFG.n_kv_heads, "d_ff": CFG.d_ff,
+            "head_dim": CFG.head_dim, "max_seq_len": CFG.max_seq_len,
+            "dtype": "float32",
+        }
+        export(str(tmp_path / "lm"), 1, variables,
+               loader="kubeflow_tpu.serving.loaders:lm_generate",
+               config={"model": overrides, "max_new_tokens": 4,
+                       "quantize": "int8", "kv_cache": "int8"})
+        server = ModelServer()
+        server.add_model("lm", str(tmp_path / "lm"))
+        out = server.predict(
+            "lm", {"tokens": np.asarray([[3, 1, 4]], np.int32)})
+        assert np.asarray(out["tokens"]).shape == (1, 7)
+
+    def test_unknown_kv_cache_mode_rejected(self):
+        import pytest
+
+        from kubeflow_tpu.serving.loaders import lm_generate
+
+        with pytest.raises(ValueError, match="kv_cache"):
+            lm_generate({"kv_cache": "fp8"})
