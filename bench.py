@@ -31,6 +31,36 @@ import sys
 import time
 
 
+def closed_loop_clients(batcher, make_inputs, n_clients, per_client):
+    """Drive a MicroBatcher with closed-loop client threads.
+
+    Returns (requests_per_sec, stats, n_failures): failed submits are
+    counted, not silently folded into throughput — both the serving and
+    lm-decode benches report through this one loop.
+    """
+    import threading
+
+    failures = []
+
+    def client():
+        for _ in range(per_client):
+            try:
+                batcher.submit(make_inputs())
+            except Exception as exc:  # noqa: BLE001 — recorded, reported
+                failures.append(exc)
+
+    threads = [threading.Thread(target=client) for _ in range(n_clients)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    stats = batcher.stats()
+    ok = n_clients * per_client - len(failures)
+    return ok / wall, stats, len(failures)
+
+
 def peak_flops(device) -> float:
     """Per-chip peak bf16 FLOPs from the device kind (v5e default)."""
     kind = device.device_kind.lower()
@@ -332,22 +362,13 @@ def bench_serving(args, devices, n_chips, on_tpu):
             allowed_batch_sizes=sizes,
             in_flight=4,
         )
-
-        def client():
-            for _ in range(per_client):
-                batcher.submit({"image": image})
-
-        threads = [threading.Thread(target=client)
-                   for _ in range(n_clients)]
-        t0 = time.perf_counter()
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join()
-        wall = time.perf_counter() - t0
-        stats = batcher.stats()
+        req_s, stats, failures = closed_loop_clients(
+            batcher, lambda: {"image": image}, n_clients, per_client)
         batcher.close()
-        return n_clients * per_client / wall, stats
+        if failures:
+            print(f"batcher_run: {failures} failed requests",
+                  file=sys.stderr)
+        return req_s, stats
 
     rng = np.random.RandomState(0)
     with tempfile.TemporaryDirectory() as tmp:
@@ -564,6 +585,30 @@ def bench_lm_decode(args, devices, n_chips, on_tpu):
             decode(batch)
             latb.append(time.perf_counter() - t0)
         latb_s = sorted(latb)[len(latb) // 2]
+
+        # Concurrent clients through the shape-grouped MicroBatcher:
+        # uniform-length batch-1 requests coalesce into the SAME batched
+        # generate program measured above (allowed sizes reuse its
+        # compile), so this measures the serving plane's coalescing, not
+        # a new program.
+        from kubeflow_tpu.serving.model_server import MicroBatcher
+
+        mb = MicroBatcher(
+            server.get("lm").predict, max_batch_size=batch,
+            batch_timeout_s=0.02, allowed_batch_sizes=[1, batch],
+            in_flight=2,
+        )
+        n_clients, per_client = batch, 2 if on_tpu else 1
+        batcher_req_s, mb_stats, mb_failures = closed_loop_clients(
+            mb,
+            lambda: {"tokens": rng.randint(
+                1, cfg.vocab_size, size=(1, prompt_len)
+            ).astype(np.int32)},
+            n_clients, per_client)
+        mb.close()
+        if mb_failures:
+            print(f"lm batcher: {mb_failures} failed requests",
+                  file=sys.stderr)
     tok_s_b1 = new_tokens / lat1_s
     tok_s = batch * new_tokens / latb_s
     print(f"lm decode: batch-1 {lat1_s*1e3:.1f} ms ({tok_s_b1:.1f} tok/s,"
@@ -584,6 +629,11 @@ def bench_lm_decode(args, devices, n_chips, on_tpu):
             "d_model": overrides["d_model"],
             "n_layers": overrides["n_layers"],
             "device": devices[0].device_kind,
+            "batcher_requests_per_sec": round(batcher_req_s, 1),
+            "batcher_clients": n_clients,
+            "batcher_mean_batch_size": mb_stats["mean_batch_size"],
+            "batcher_tokens_per_sec": round(
+                batcher_req_s * new_tokens, 1),
             **({"quantize": args.quantize} if args.quantize else {}),
             **({"kv_cache": args.kv_cache} if args.kv_cache else {}),
         },
@@ -774,6 +824,22 @@ def main() -> None:
             result["detail"]["lm_decode"] = lmd["detail"]
         except Exception as e:
             print(f"lm-decode sub-benchmark failed: {e}", file=sys.stderr)
+        try:
+            # The quantized serving story, captured in the same record:
+            # int8 weights + int8 KV cache (where each pays is analyzed
+            # in BASELINE.md).  Skipped when the base run was already
+            # fully int8 — the numbers would be byte-identical.
+            if (args.quantize, args.kv_cache) != ("int8", "int8"):
+                import copy
+
+                qargs = copy.copy(args)
+                qargs.quantize = "int8"
+                qargs.kv_cache = "int8"
+                lmq = bench_lm_decode(qargs, devices, n_chips, on_tpu)
+                result["detail"]["lm_decode_int8"] = lmq["detail"]
+        except Exception as e:
+            print(f"lm-decode-int8 sub-benchmark failed: {e}",
+                  file=sys.stderr)
         try:
             data = bench_data(args, devices, n_chips, on_tpu)
             result["detail"]["data"] = data["detail"]

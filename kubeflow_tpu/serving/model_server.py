@@ -185,8 +185,11 @@ class MicroBatcher:
 
     def submit(self, inputs: Dict[str, Any]) -> Dict[str, Any]:
         """One logical request of batch-dim 1 (or [1, ...] rows)."""
-        entry = {"inputs": inputs, "event": threading.Event(), "out": None,
-                 "err": None}
+        # Signature computed once, outside the lock: runners re-scan
+        # pending entries every dispatch cycle, and np.asarray on
+        # list-typed payloads (the REST JSON path) is O(payload).
+        entry = {"inputs": inputs, "sig": self._shape_sig(inputs),
+                 "event": threading.Event(), "out": None, "err": None}
         with self._lock:
             self._pending.append(entry)
             self._flusher.notify()
@@ -216,6 +219,13 @@ class MicroBatcher:
         for r in self._runners:
             r.join(timeout=5)
 
+    @staticmethod
+    def _shape_sig(inputs: Dict[str, Any]):
+        return tuple(
+            (k, np.asarray(v).shape, np.asarray(v).dtype.str)
+            for k, v in sorted(inputs.items())
+        )
+
     def _run(self) -> None:
         while True:
             with self._lock:
@@ -230,8 +240,25 @@ class MicroBatcher:
                     if remaining <= 0:
                         break
                     self._flusher.wait(timeout=remaining)
-                batch = self._pending[:self.max_batch_size]
-                del self._pending[:len(batch)]
+                # Only rows of one shape signature can share a device
+                # batch (they are concatenated on axis 0): take the
+                # oldest request's shape and collect its matches, leaving
+                # the rest for the next runner.  Without this, one
+                # odd-shaped request poisons the whole batch — every
+                # waiter got the concatenate error.  Shape diversity is
+                # real for LMs (prompt lengths); uniform-length decode
+                # requests batch into one generate program.
+                batch, kept = [], []
+                sig0 = None
+                for e in self._pending:
+                    if sig0 is None:
+                        sig0 = e["sig"]
+                    if e["sig"] == sig0 and \
+                            len(batch) < self.max_batch_size:
+                        batch.append(e)
+                    else:
+                        kept.append(e)
+                self._pending = kept
                 if batch:
                     self._batch_sizes[len(batch)] = \
                         self._batch_sizes.get(len(batch), 0) + 1

@@ -419,3 +419,92 @@ class TestBatcherPadTable:
             assert all(c in (1, 2, 4) for c in calls)  # never unpadded 8
         finally:
             b.close()
+
+
+class TestShapeGroupedBatching:
+    def test_mixed_shapes_batch_separately_and_all_succeed(self):
+        """One odd-shaped request must not poison the batch: rows only
+        share a device batch with shape-identical peers (LM prompts come
+        in many lengths)."""
+        shapes_seen = []
+
+        def predict(inputs):
+            shapes_seen.append(inputs["x"].shape)
+            return {"y": inputs["x"] * 2}
+
+        mb = MicroBatcher(predict, max_batch_size=8, batch_timeout_s=0.05,
+                          allowed_batch_sizes=[1, 2, 4, 8])
+        results = {}
+
+        def worker(i):
+            width = 2 if i % 2 == 0 else 3   # two shape groups
+            results[i] = mb.submit({"x": np.full((1, width), float(i))})
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        mb.close()
+        for i in range(8):
+            width = 2 if i % 2 == 0 else 3
+            np.testing.assert_allclose(
+                results[i]["y"], np.full((1, width), 2.0 * i))
+        # No device batch ever mixed the two widths.
+        assert all(s[1] in (2, 3) for s in shapes_seen)
+        assert {s[1] for s in shapes_seen} == {2, 3}
+
+    def test_lm_generate_batches_uniform_prompts(self, tmp_path):
+        """Uniform-length decode requests coalesce into one batched
+        generate program and every caller gets its own row back."""
+        import jax
+        import jax.numpy as jnp
+
+        from kubeflow_tpu.models.transformer import (
+            Transformer,
+            TransformerConfig,
+        )
+        from kubeflow_tpu.serving.export import export
+
+        cfg = TransformerConfig(
+            vocab_size=64, d_model=16, n_layers=1, n_heads=2, n_kv_heads=2,
+            d_ff=32, head_dim=8, max_seq_len=32, dtype=jnp.float32)
+        model = Transformer(cfg)
+        variables = model.init(jax.random.key(0),
+                               jnp.zeros((1, 4), jnp.int32))
+        export(str(tmp_path / "lm"), 1, variables,
+               loader="kubeflow_tpu.serving.loaders:lm_generate",
+               config={"model": {
+                   "vocab_size": 64, "d_model": 16, "n_layers": 1,
+                   "n_heads": 2, "n_kv_heads": 2, "d_ff": 32,
+                   "head_dim": 8, "max_seq_len": 32, "dtype": "float32"},
+                   "max_new_tokens": 4, "temperature": 0.0})
+        server = ModelServer()
+        server.add_model("lm", str(tmp_path / "lm"))
+        predict = server.get("lm").predict
+
+        prompts = [np.random.RandomState(i).randint(1, 64, (1, 4))
+                   .astype(np.int32) for i in range(4)]
+        direct = [np.asarray(predict({"tokens": p})["tokens"])
+                  for p in prompts]
+
+        mb = MicroBatcher(predict, max_batch_size=4, batch_timeout_s=0.1,
+                          allowed_batch_sizes=[1, 2, 4])
+        results = {}
+
+        def worker(i):
+            results[i] = mb.submit({"tokens": prompts[i]})
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        stats = mb.stats()
+        mb.close()
+        for i in range(4):
+            np.testing.assert_array_equal(
+                np.asarray(results[i]["tokens"]), direct[i])
+        assert stats["mean_batch_size"] > 1, stats
