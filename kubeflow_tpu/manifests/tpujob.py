@@ -90,7 +90,9 @@ def operator_manifests(name: str = "tpujob-operator",
                 command=["python", "-m", "kubeflow_tpu.operator.main"],
                 args=["--namespace", namespace,
                       "--controller-config-file",
-                      "/etc/config/controller_config_file.yaml"],
+                      "/etc/config/controller_config_file.yaml",
+                      "--metrics-port", "9090"],
+                ports=[9090],
                 volume_mounts=[{"name": "config-volume",
                                 "mountPath": "/etc/config"}],
             )],

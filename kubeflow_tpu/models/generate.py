@@ -43,7 +43,23 @@ from kubeflow_tpu.ops.quantize import (
 class DecodeConfig:
     max_new_tokens: int = 64
     temperature: float = 0.0   # 0 = greedy
+    # Sampling filters (applied in this order when temperature > 0):
+    # top_k keeps the k highest-logit tokens (0 = off); top_p keeps the
+    # smallest set of tokens whose probability mass reaches p (1.0 =
+    # off, i.e. nucleus sampling).  Both are static-shape TPU code: a
+    # top_k threshold compare and a sorted-cumsum mask — no dynamic
+    # vocabulary subsets.
+    top_k: int = 0
+    top_p: float = 1.0
     eos_token: int = -1        # -1 = never stop early
+
+    def __post_init__(self):
+        if not 0.0 < self.top_p <= 1.0:
+            raise ValueError(
+                f"top_p must be in (0, 1], got {self.top_p} "
+                "(1.0 disables nucleus filtering)")
+        if self.top_k < 0:
+            raise ValueError(f"top_k must be >= 0, got {self.top_k}")
     # "model" = the model compute dtype; "int8" = quantized cache with
     # per-(position, head) scales (halves cache HBM traffic and memory —
     # the binding resource for batched decode; ops/attention.py folds the
@@ -203,8 +219,25 @@ def generate(
     def sample(logits, key):
         if decode.temperature <= 0.0:
             return jnp.argmax(logits, axis=-1)
-        return jax.random.categorical(
-            key, logits / decode.temperature, axis=-1)
+        logits = logits / decode.temperature
+        if decode.top_k > 0:
+            kth = jax.lax.top_k(logits, decode.top_k)[0][..., -1:]
+            logits = jnp.where(logits >= kth, logits, -jnp.inf)
+        if decode.top_p < 1.0:
+            sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+            cum = jnp.cumsum(
+                jax.nn.softmax(sorted_logits, axis=-1), axis=-1)
+            # Keep every token whose PRECEDING mass is < p (so the
+            # boundary token crossing p stays in, matching the
+            # standard nucleus definition), then threshold by the
+            # smallest kept logit.
+            keep = cum - jax.nn.softmax(sorted_logits, axis=-1) \
+                < decode.top_p
+            cutoff = jnp.min(
+                jnp.where(keep, sorted_logits, jnp.inf),
+                axis=-1, keepdims=True)
+            logits = jnp.where(logits >= cutoff, logits, -jnp.inf)
+        return jax.random.categorical(key, logits, axis=-1)
 
     def step(carry, _):
         cache, last_logits, cache_len, key, done = carry

@@ -42,6 +42,8 @@ def main(argv=None) -> int:
                     help="stop after N reconcile passes (0 = forever)")
     ap.add_argument("--fake-kube", action="store_true",
                     help="run against the in-memory cluster (demo/tests)")
+    ap.add_argument("--metrics-port", type=int, default=0,
+                    help="serve Prometheus /metrics on this port (0=off)")
     args = ap.parse_args(argv)
 
     logging.basicConfig(level=logging.INFO, stream=sys.stderr)
@@ -71,6 +73,11 @@ def main(argv=None) -> int:
             )
             return 1
     controller = TPUJobController(kube, GangScheduler(inventory))
+    if args.metrics_port:
+        from kubeflow_tpu.runtime.prom import serve_metrics
+
+        serve_metrics(args.metrics_port)
+        logging.info("metrics on :%d/metrics", args.metrics_port)
     logging.info("operator up; inventory=%s", inventory)
     controller.run(poll_interval_s=args.poll_interval_s,
                    max_iterations=args.max_iterations)

@@ -161,18 +161,36 @@ class TPUJobController:
             time.sleep(poll_interval_s)
 
     def reconcile_all(self) -> None:
+        from kubeflow_tpu.runtime.prom import REGISTRY
+
+        phases: dict = {}
         for cr_obj in self.kube.list_custom():
             if cr_obj.get("kind") != crd.KIND:
                 continue
             try:
-                self.reconcile_once(cr_obj)
+                phase = self.reconcile_once(cr_obj)
+                phases[phase] = phases.get(phase, 0) + 1
             except ValueError as e:  # SpecError + topology parse errors
                 self._set_phase(cr_obj, JOB_FAILED, reason="InvalidSpec",
                                 message=str(e))
+                phases[JOB_FAILED] = phases.get(JOB_FAILED, 0) + 1
             except Exception:
                 log.exception(
                     "reconcile of %s failed", cr_obj["metadata"]["name"]
                 )
+                REGISTRY.counter(
+                    "kft_operator_reconcile_errors_total",
+                    "reconcile passes that raised",
+                ).inc()
+        REGISTRY.counter(
+            "kft_operator_reconcile_passes_total",
+            "full reconcile sweeps over all TPUJobs",
+        ).inc()
+        gauge = REGISTRY.gauge(
+            "kft_operator_jobs", "TPUJobs by phase at last sweep")
+        for phase in (QUEUED, STARTING, JOB_RUNNING, JOB_SUCCEEDED,
+                      JOB_FAILED):
+            gauge.set(phases.get(phase, 0), phase=phase)
 
     # -- single-job reconcile --------------------------------------------
 

@@ -1,0 +1,200 @@
+"""Minimal Prometheus text-format metrics (exposition format 0.0.4).
+
+The reference had no metrics endpoints at all — observability was
+Spartakus usage pings and operator glog (SURVEY.md §5 "No Prometheus,
+no metrics endpoints").  This closes that gap for both first-party
+daemons: the model server exposes `/metrics` on its REST port and the
+operator serves one on `--metrics-port`.  stdlib-only by design (the
+environment bakes no prometheus_client, and the text format is three
+line shapes), thread-safe, and small enough to audit.
+
+Usage:
+    REGISTRY.counter("kft_requests_total", "...").inc(model="m")
+    REGISTRY.gauge("kft_jobs", "...").set(3, phase="Running")
+    REGISTRY.histogram("kft_latency_seconds", "...").observe(0.2)
+    text = REGISTRY.render()
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+_DEFAULT_BUCKETS = (
+    0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+)
+
+
+def _label_key(labels: Dict[str, str]) -> Tuple[Tuple[str, str], ...]:
+    return tuple(sorted((k, str(v)) for k, v in labels.items()))
+
+
+def _escape(value: str) -> str:
+    """Label-value escaping per exposition format 0.0.4 — one bad value
+    must not corrupt the whole scrape."""
+    return (value.replace("\\", r"\\").replace('"', r"\"")
+            .replace("\n", r"\n"))
+
+
+def _fmt_labels(key: Tuple[Tuple[str, str], ...], extra: str = "") -> str:
+    parts = [f'{k}="{_escape(v)}"' for k, v in key]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+class _Metric:
+    def __init__(self, name: str, help_: str, kind: str):
+        self.name = name
+        self.help = help_
+        self.kind = kind
+        self._lock = threading.Lock()
+
+
+class Counter(_Metric):
+    def __init__(self, name: str, help_: str):
+        super().__init__(name, help_, "counter")
+        self._values: Dict[Tuple, float] = {}
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        key = _label_key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def _render(self) -> List[str]:
+        with self._lock:
+            return [
+                f"{self.name}{_fmt_labels(k)} {v}"
+                for k, v in sorted(self._values.items())
+            ] or [f"{self.name} 0"]
+
+
+class Gauge(_Metric):
+    def __init__(self, name: str, help_: str):
+        super().__init__(name, help_, "gauge")
+        self._values: Dict[Tuple, float] = {}
+
+    def set(self, value: float, **labels) -> None:
+        with self._lock:
+            self._values[_label_key(labels)] = float(value)
+
+    def _render(self) -> List[str]:
+        with self._lock:
+            return [
+                f"{self.name}{_fmt_labels(k)} {v}"
+                for k, v in sorted(self._values.items())
+            ] or [f"{self.name} 0"]
+
+
+class Histogram(_Metric):
+    def __init__(self, name: str, help_: str,
+                 buckets: Tuple[float, ...] = _DEFAULT_BUCKETS):
+        super().__init__(name, help_, "histogram")
+        self.buckets = tuple(sorted(buckets))
+        self._counts: Dict[Tuple, List[int]] = {}
+        self._sums: Dict[Tuple, float] = {}
+
+    def observe(self, value: float, **labels) -> None:
+        key = _label_key(labels)
+        with self._lock:
+            counts = self._counts.setdefault(
+                key, [0] * (len(self.buckets) + 1))
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    counts[i] += 1
+            counts[-1] += 1  # +Inf
+            self._sums[key] = self._sums.get(key, 0.0) + value
+
+    def _render(self) -> List[str]:
+        out: List[str] = []
+        with self._lock:
+            for key, counts in sorted(self._counts.items()):
+                for i, b in enumerate(self.buckets):
+                    out.append(
+                        f"{self.name}_bucket"
+                        f"{_fmt_labels(key, f'le=\"{b}\"')} {counts[i]}")
+                out.append(
+                    f"{self.name}_bucket"
+                    f"{_fmt_labels(key, 'le=\"+Inf\"')} {counts[-1]}")
+                out.append(
+                    f"{self.name}_sum{_fmt_labels(key)} {self._sums[key]}")
+                out.append(
+                    f"{self.name}_count{_fmt_labels(key)} {counts[-1]}")
+        return out
+
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, _Metric] = {}
+
+    def _get(self, cls, name: str, help_: str, **kwargs):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = cls(name, help_, **kwargs)
+                self._metrics[name] = m
+            elif not isinstance(m, cls):
+                raise ValueError(
+                    f"{name} already registered as {m.kind}")
+            return m
+
+    def counter(self, name: str, help_: str = "") -> Counter:
+        return self._get(Counter, name, help_)
+
+    def gauge(self, name: str, help_: str = "") -> Gauge:
+        return self._get(Gauge, name, help_)
+
+    def histogram(self, name: str, help_: str = "",
+                  buckets: Optional[Tuple[float, ...]] = None) -> Histogram:
+        if buckets is None:
+            return self._get(Histogram, name, help_)
+        return self._get(Histogram, name, help_, buckets=buckets)
+
+    def render(self) -> str:
+        with self._lock:
+            metrics = list(self._metrics.values())
+        lines: List[str] = []
+        for m in metrics:
+            if m.help:
+                lines.append(f"# HELP {m.name} {m.help}")
+            lines.append(f"# TYPE {m.name} {m.kind}")
+            lines.extend(m._render())
+        return "\n".join(lines) + "\n"
+
+
+REGISTRY = Registry()
+
+
+def serve_metrics(port: int, registry: Optional[Registry] = None,
+                  host: str = "0.0.0.0"):
+    """Start a daemon-thread HTTP server exposing /metrics.
+
+    Returns (httpd, thread); pass port from the daemon's --metrics-port.
+    """
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    reg = registry or REGISTRY
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):
+            pass
+
+        def do_GET(self):
+            if self.path != "/metrics":
+                self.send_response(404)
+                self.end_headers()
+                return
+            data = reg.render().encode()
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+    httpd = ThreadingHTTPServer((host, port), Handler)
+    thread = threading.Thread(target=httpd.serve_forever, daemon=True,
+                              name="metrics-http")
+    thread.start()
+    return httpd, thread

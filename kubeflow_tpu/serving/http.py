@@ -42,6 +42,7 @@ _ROUTES = [
      "classify"),
     ("GET", re.compile(r"^/$"), "index"),
     ("GET", re.compile(r"^/healthz$"), "health"),
+    ("GET", re.compile(r"^/metrics$"), "metrics"),
 ]
 
 
@@ -176,13 +177,44 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(200, WELCOME, raw=True)
         elif action == "health":
             self._send(200, {"status": "ok", "models": self.api.server.models()})
+        elif action == "metrics":
+            from kubeflow_tpu.runtime.prom import REGISTRY
+
+            self._send(200, REGISTRY.render(), raw=True)
         elif action == "metadata":
             self._send(200, self.api.metadata(groups["name"]))
         else:
+            import time as _time
+
+            from kubeflow_tpu.runtime.prom import REGISTRY
+
             length = int(self.headers.get("Content-Length", 0))
             body = json.loads(self.rfile.read(length) or b"{}")
             fn = getattr(self.api, action)
-            self._send(200, fn(groups["name"], body, version))
+            # Only KNOWN model names become label values: the URL is
+            # attacker-controlled, and each distinct label value is a
+            # permanent series — scanner probes must not grow /metrics.
+            name = groups["name"]
+            model_label = name if name in self.api.server.models() \
+                else "_unknown_"
+            t0 = _time.perf_counter()
+            try:
+                out = fn(name, body, version)
+            except Exception:
+                REGISTRY.counter(
+                    "kft_serving_requests_total",
+                    "REST requests by model/route/outcome",
+                ).inc(model=model_label, route=action, outcome="error")
+                raise
+            REGISTRY.counter(
+                "kft_serving_requests_total",
+                "REST requests by model/route/outcome",
+            ).inc(model=model_label, route=action, outcome="ok")
+            REGISTRY.histogram(
+                "kft_serving_request_seconds",
+                "REST request latency by route",
+            ).observe(_time.perf_counter() - t0, route=action)
+            self._send(200, out)
 
     def _send(self, code: int, payload: Any, raw: bool = False) -> None:
         data = (payload if raw else json.dumps(payload)).encode()

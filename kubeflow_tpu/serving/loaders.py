@@ -111,8 +111,13 @@ def lm_generate(config: Dict[str, Any]) -> Callable:
 
     config: {"model": TransformerConfig overrides,
              "max_new_tokens": int, "temperature": float,
+             "top_k": int (0 = off), "top_p": float (1.0 = off),
              "quantize": "int8" (optional, weight-only),
              "kv_cache": "int8" (optional, quantized decode cache)}
+
+    Sampling is deterministic per request (fixed seed): identical
+    prompts return identical completions, the reproducibility contract
+    a versioned model server wants.
     Signature: {"tokens": [b, t] int32} -> {"tokens": [b, t+new] int32}
     """
     from kubeflow_tpu.models.generate import DecodeConfig, generate
@@ -124,6 +129,8 @@ def lm_generate(config: Dict[str, Any]) -> Callable:
     decode = DecodeConfig(
         max_new_tokens=int(config.get("max_new_tokens", 64)),
         temperature=float(config.get("temperature", 0.0)),
+        top_k=int(config.get("top_k", 0)),
+        top_p=float(config.get("top_p", 1.0)),
         eos_token=int(config.get("eos_token", -1)),
         kv_cache_dtype=kv_cache or "model",
     )

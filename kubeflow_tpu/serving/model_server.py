@@ -221,10 +221,11 @@ class MicroBatcher:
 
     @staticmethod
     def _shape_sig(inputs: Dict[str, Any]):
-        return tuple(
-            (k, np.asarray(v).shape, np.asarray(v).dtype.str)
-            for k, v in sorted(inputs.items())
-        )
+        sig = []
+        for k, v in sorted(inputs.items()):
+            a = np.asarray(v)  # once: O(payload) for list-typed values
+            sig.append((k, a.shape, a.dtype.str))
+        return tuple(sig)
 
     def _run(self) -> None:
         while True:

@@ -71,3 +71,71 @@ def test_temperature_sampling_runs():
                          DecodeConfig(max_new_tokens=3, temperature=1.0),
                          rng=jax.random.key(7))
     assert tokens.shape == (2, 11)
+
+
+def test_top_k_one_equals_greedy():
+    model, params, prompt = setup()
+    greedy, _ = generate(CFG, params, prompt,
+                         DecodeConfig(max_new_tokens=5))
+    topk1, _ = generate(
+        CFG, params, prompt,
+        DecodeConfig(max_new_tokens=5, temperature=0.7, top_k=1),
+        rng=jax.random.key(3))
+    np.testing.assert_array_equal(np.asarray(greedy), np.asarray(topk1))
+
+
+def test_top_k_samples_stay_in_top_set():
+    model, params, prompt = setup()
+    k = 3
+    # One decode step at high temperature: the sampled token must be one
+    # of the top-k next-token candidates of the prefill logits.
+    _, prefill_logits = generate(
+        CFG, params, prompt, DecodeConfig(max_new_tokens=1))
+    del prefill_logits  # logits returned are post-sample; recompute:
+    model2 = Transformer(CFG)
+    full = model2.apply({"params": params}, prompt)
+    allowed = np.asarray(
+        jax.lax.top_k(full[:, -1], k)[1])           # [b, k] token ids
+    for seed in range(5):
+        toks, _ = generate(
+            CFG, params, prompt,
+            DecodeConfig(max_new_tokens=1, temperature=2.0, top_k=k),
+            rng=jax.random.key(seed))
+        first_new = np.asarray(toks[:, prompt.shape[1]])
+        for b in range(prompt.shape[0]):
+            assert first_new[b] in allowed[b], (first_new, allowed)
+
+
+def test_top_p_tiny_equals_greedy():
+    # p smaller than any single token's probability keeps only the
+    # argmax -> nucleus sampling degenerates to greedy.
+    model, params, prompt = setup()
+    greedy, _ = generate(CFG, params, prompt,
+                         DecodeConfig(max_new_tokens=5))
+    nucleus, _ = generate(
+        CFG, params, prompt,
+        DecodeConfig(max_new_tokens=5, temperature=1.0, top_p=1e-9),
+        rng=jax.random.key(11))
+    np.testing.assert_array_equal(np.asarray(greedy), np.asarray(nucleus))
+
+
+def test_top_p_one_matches_plain_sampling():
+    model, params, prompt = setup()
+    plain, _ = generate(
+        CFG, params, prompt,
+        DecodeConfig(max_new_tokens=4, temperature=1.0),
+        rng=jax.random.key(5))
+    nucleus, _ = generate(
+        CFG, params, prompt,
+        DecodeConfig(max_new_tokens=4, temperature=1.0, top_p=1.0),
+        rng=jax.random.key(5))
+    np.testing.assert_array_equal(np.asarray(plain), np.asarray(nucleus))
+
+
+def test_invalid_top_p_rejected():
+    import pytest
+
+    with pytest.raises(ValueError, match="top_p"):
+        DecodeConfig(top_p=0.0)
+    with pytest.raises(ValueError, match="top_k"):
+        DecodeConfig(top_k=-1)

@@ -1,0 +1,86 @@
+"""Prometheus exposition: the metrics endpoints the reference never had
+(SURVEY.md §5 "No Prometheus, no metrics endpoints")."""
+
+import urllib.request
+
+import numpy as np
+
+from kubeflow_tpu.runtime.prom import Registry, serve_metrics
+
+
+class TestRegistry:
+    def test_counter_gauge_render(self):
+        reg = Registry()
+        reg.counter("reqs_total", "requests").inc(model="m1")
+        reg.counter("reqs_total").inc(2.0, model="m1")
+        reg.gauge("jobs", "by phase").set(3, phase="Running")
+        text = reg.render()
+        assert "# TYPE reqs_total counter" in text
+        assert 'reqs_total{model="m1"} 3.0' in text
+        assert "# HELP jobs by phase" in text
+        assert 'jobs{phase="Running"} 3.0' in text
+
+    def test_histogram_buckets_cumulative(self):
+        reg = Registry()
+        h = reg.histogram("lat_seconds", "latency", buckets=(0.1, 1.0))
+        for v in (0.05, 0.5, 5.0):
+            h.observe(v)
+        text = reg.render()
+        assert 'lat_seconds_bucket{le="0.1"} 1' in text
+        assert 'lat_seconds_bucket{le="1.0"} 2' in text
+        assert 'lat_seconds_bucket{le="+Inf"} 3' in text
+        assert "lat_seconds_count 3" in text
+        np.testing.assert_allclose(
+            float(text.split("lat_seconds_sum ")[1].split("\n")[0]), 5.55)
+
+    def test_kind_conflict_rejected(self):
+        import pytest
+
+        reg = Registry()
+        reg.counter("x")
+        with pytest.raises(ValueError, match="registered"):
+            reg.gauge("x")
+
+
+class TestServeMetrics:
+    def test_http_endpoint(self):
+        reg = Registry()
+        reg.counter("ticks_total").inc()
+        httpd, _ = serve_metrics(0, reg, host="127.0.0.1")
+        port = httpd.server_address[1]
+        try:
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=5
+            ).read().decode()
+            assert "ticks_total 1.0" in body
+        finally:
+            httpd.shutdown()
+
+
+class TestOperatorMetrics:
+    def test_fake_kube_run_exports_job_gauges(self):
+        from kubeflow_tpu.operator.gang import GangScheduler
+        from kubeflow_tpu.operator.kube import FakeKube
+        from kubeflow_tpu.operator.reconciler import TPUJobController
+        from kubeflow_tpu.runtime.prom import REGISTRY
+
+        kube = FakeKube()
+        kube.create_custom({
+            "apiVersion": "kubeflow-tpu.org/v1", "kind": "TPUJob",
+            "metadata": {"name": "m", "namespace": "default"},
+            "spec": {"sliceType": "v5e-1", "numWorkers": 1,
+                     "worker": {"image": "img", "command": ["true"]}},
+        })
+        TPUJobController(kube, GangScheduler({"v5e-1": 1})).reconcile_all()
+        text = REGISTRY.render()
+        assert "kft_operator_reconcile_passes_total" in text
+        assert 'kft_operator_jobs{phase="Running"}' in text \
+            or 'kft_operator_jobs{phase="Starting"}' in text, text
+
+
+class TestLabelEscaping:
+    def test_quote_backslash_newline_escaped(self):
+        reg = Registry()
+        reg.counter("c").inc(model='a"b\\c\nd')
+        text = reg.render()
+        assert r'model="a\"b\\c\nd"' in text

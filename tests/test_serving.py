@@ -225,6 +225,14 @@ class TestHTTPEndToEnd:
             ) as resp:
                 health = json.loads(resp.read())
             assert health["models"] == {"tiny": [1]}
+
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=30
+            ) as resp:
+                metrics = resp.read().decode()
+            assert ('kft_serving_requests_total{model="tiny",'
+                    'outcome="ok",route="predict"}') in metrics
+            assert "kft_serving_request_seconds_bucket" in metrics
         finally:
             httpd.shutdown()
 
