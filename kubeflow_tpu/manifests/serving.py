@@ -160,13 +160,20 @@ def _generate_serving(component_name: str, **p: Any) -> List[dict]:
             "sidecar.istio.io/inject": "true",
         }
 
-    annotations = None
+    # The REST port doubles as the Prometheus endpoint (serving/http.py
+    # /metrics); standard scrape annotations so a cluster Prometheus
+    # discovers it without config.
+    annotations = {
+        "prometheus.io/scrape": "true",
+        "prometheus.io/port": str(SERVE_PORT),
+        "prometheus.io/path": "/metrics",
+    }
     if p["ambassador_route"]:
         # Same prefix scheme as the reference proxy route
         # (tf-serving.libsonnet:247-267): /models/NAME/ -> service:8000.
-        annotations = {"getambassador.io/config": base.ambassador_route(
+        annotations["getambassador.io/config"] = base.ambassador_route(
             name, f"/models/{p['model_name']}/", name, SERVE_PORT,
-        )}
+        )
     svc = base.service(
         name=name, namespace=namespace, selector=labels,
         ports=[base.port(SERVE_PORT, "http"),

@@ -101,6 +101,13 @@ def operator_manifests(name: str = "tpujob-operator",
             service_account=name,
         ),
     )
+    # Scrape annotations on the pod template: the operator has no
+    # Service of its own, so Prometheus pod discovery finds :9090.
+    deploy["spec"]["template"]["metadata"]["annotations"] = {
+        "prometheus.io/scrape": "true",
+        "prometheus.io/port": "9090",
+        "prometheus.io/path": "/metrics",
+    }
     return [tpujob_crd(), controller_config(namespace), sa, role, binding, deploy]
 
 

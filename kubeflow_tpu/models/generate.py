@@ -221,7 +221,10 @@ def generate(
             return jnp.argmax(logits, axis=-1)
         logits = logits / decode.temperature
         if decode.top_k > 0:
-            kth = jax.lax.top_k(logits, decode.top_k)[0][..., -1:]
+            # Clamp to the vocabulary: an oversized k means "no filter",
+            # not a trace-time lax.top_k error on the first request.
+            k = min(decode.top_k, logits.shape[-1])
+            kth = jax.lax.top_k(logits, k)[0][..., -1:]
             logits = jnp.where(logits >= kth, logits, -jnp.inf)
         if decode.top_p < 1.0:
             sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]

@@ -50,15 +50,33 @@ class PredictionServicer:
 
     def Predict(self, request: pb.PredictRequest,
                 context: grpc.ServicerContext) -> pb.PredictResponse:
-        model = self._resolve(request.model_spec)
-        inputs = {k: tensor_to_numpy(t) for k, t in request.inputs.items()}
-        outputs = model.predict(inputs)
-        resp = pb.PredictResponse()
-        resp.model_spec.name = model.name
-        resp.model_spec.version = model.version
-        for key, value in outputs.items():
-            resp.outputs[key].CopyFrom(numpy_to_tensor(np.asarray(value)))
-        return resp
+        from kubeflow_tpu.runtime.prom import REGISTRY
+        from kubeflow_tpu.serving.model_server import (
+            REQUESTS_HELP,
+            REQUESTS_TOTAL,
+        )
+
+        # Only resolved model names become label values (unbounded
+        # client-supplied names must not grow /metrics series).
+        name, outcome = "_unknown_", "error"
+        try:
+            model = self._resolve(request.model_spec)
+            name = model.name
+            inputs = {
+                k: tensor_to_numpy(t) for k, t in request.inputs.items()
+            }
+            outputs = model.predict(inputs)
+            resp = pb.PredictResponse()
+            resp.model_spec.name = model.name
+            resp.model_spec.version = model.version
+            for key, value in outputs.items():
+                resp.outputs[key].CopyFrom(
+                    numpy_to_tensor(np.asarray(value)))
+            outcome = "ok"
+            return resp
+        finally:
+            REGISTRY.counter(REQUESTS_TOTAL, REQUESTS_HELP).inc(
+                model=name, route="grpc_predict", outcome=outcome)
 
     def Classify(self, request: pb.ClassifyRequest,
                  context: grpc.ServicerContext) -> pb.ClassifyResponse:

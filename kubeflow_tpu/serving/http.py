@@ -187,6 +187,10 @@ class _Handler(BaseHTTPRequestHandler):
             import time as _time
 
             from kubeflow_tpu.runtime.prom import REGISTRY
+            from kubeflow_tpu.serving.model_server import (
+                REQUESTS_HELP,
+                REQUESTS_TOTAL,
+            )
 
             length = int(self.headers.get("Content-Length", 0))
             body = json.loads(self.rfile.read(length) or b"{}")
@@ -195,25 +199,22 @@ class _Handler(BaseHTTPRequestHandler):
             # attacker-controlled, and each distinct label value is a
             # permanent series — scanner probes must not grow /metrics.
             name = groups["name"]
-            model_label = name if name in self.api.server.models() \
+            model_label = name if self.api.server.has_model(name) \
                 else "_unknown_"
+            outcome = "error"
             t0 = _time.perf_counter()
             try:
                 out = fn(name, body, version)
-            except Exception:
-                REGISTRY.counter(
-                    "kft_serving_requests_total",
-                    "REST requests by model/route/outcome",
-                ).inc(model=model_label, route=action, outcome="error")
-                raise
-            REGISTRY.counter(
-                "kft_serving_requests_total",
-                "REST requests by model/route/outcome",
-            ).inc(model=model_label, route=action, outcome="ok")
-            REGISTRY.histogram(
-                "kft_serving_request_seconds",
-                "REST request latency by route",
-            ).observe(_time.perf_counter() - t0, route=action)
+                outcome = "ok"
+            finally:
+                REGISTRY.counter(REQUESTS_TOTAL, REQUESTS_HELP).inc(
+                    model=model_label, route=action, outcome=outcome)
+                # Failures included: the slowest requests in an incident
+                # are usually the failing ones.
+                REGISTRY.histogram(
+                    "kft_serving_request_seconds",
+                    "REST request latency by route",
+                ).observe(_time.perf_counter() - t0, route=action)
             self._send(200, out)
 
     def _send(self, code: int, payload: Any, raw: bool = False) -> None:

@@ -27,6 +27,12 @@ from kubeflow_tpu.serving.export import list_versions, load_version
 log = logging.getLogger(__name__)
 
 
+# One name/help for the request counter shared by the REST and gRPC
+# faces — divergent literals would silently create a second series.
+REQUESTS_TOTAL = "kft_serving_requests_total"
+REQUESTS_HELP = "serving requests by model/route/outcome (REST + gRPC)"
+
+
 @dataclasses.dataclass
 class LoadedModel:
     name: str
@@ -121,6 +127,10 @@ class ModelServer:
     def models(self) -> Dict[str, List[int]]:
         with self._lock:
             return {n: sorted(v) for n, v in self._models.items()}
+
+    def has_model(self, name: str) -> bool:
+        with self._lock:
+            return name in self._models
 
     def predict(
         self, name: str, inputs: Dict[str, Any],

@@ -139,3 +139,16 @@ def test_invalid_top_p_rejected():
         DecodeConfig(top_p=0.0)
     with pytest.raises(ValueError, match="top_k"):
         DecodeConfig(top_k=-1)
+
+
+def test_top_k_larger_than_vocab_is_no_filter():
+    model, params, prompt = setup()
+    plain, _ = generate(
+        CFG, params, prompt,
+        DecodeConfig(max_new_tokens=3, temperature=1.0),
+        rng=jax.random.key(9))
+    big_k, _ = generate(
+        CFG, params, prompt,
+        DecodeConfig(max_new_tokens=3, temperature=1.0, top_k=10_000),
+        rng=jax.random.key(9))
+    np.testing.assert_array_equal(np.asarray(plain), np.asarray(big_k))
