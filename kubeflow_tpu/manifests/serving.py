@@ -153,21 +153,24 @@ def _generate_serving(component_name: str, **p: Any) -> List[dict]:
 
         deploy["spec"]["template"]["spec"]["nodeSelector"] = \
             parse_slice_type(p["slice_type"]).k8s_node_selector()
-    if p["istio_enable"]:
-        # Sidecar injection is requested per-pod, exactly as the reference
-        # did (examples/prototypes/tf-serving-with-istio.jsonnet:106).
-        deploy["spec"]["template"]["metadata"]["annotations"] = {
-            "sidecar.istio.io/inject": "true",
-        }
-
     # The REST port doubles as the Prometheus endpoint (serving/http.py
-    # /metrics); standard scrape annotations so a cluster Prometheus
-    # discovers it without config.
-    annotations = {
+    # /metrics); standard scrape annotations on BOTH the Service and the
+    # pod template so either Prometheus discovery mode (kubernetes-
+    # service-endpoints or kubernetes-pods) finds it without config.
+    scrape = {
         "prometheus.io/scrape": "true",
         "prometheus.io/port": str(SERVE_PORT),
         "prometheus.io/path": "/metrics",
     }
+    template_annotations = dict(scrape)
+    if p["istio_enable"]:
+        # Sidecar injection is requested per-pod, exactly as the reference
+        # did (examples/prototypes/tf-serving-with-istio.jsonnet:106).
+        template_annotations["sidecar.istio.io/inject"] = "true"
+    deploy["spec"]["template"]["metadata"]["annotations"] = \
+        template_annotations
+
+    annotations = dict(scrape)
     if p["ambassador_route"]:
         # Same prefix scheme as the reference proxy route
         # (tf-serving.libsonnet:247-267): /models/NAME/ -> service:8000.

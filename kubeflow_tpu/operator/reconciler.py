@@ -283,6 +283,20 @@ class TPUJobController:
                     "event": "gang_running", "job": key,
                     "schedule_to_running_s": latency,
                 })
+                from kubeflow_tpu.runtime.prom import REGISTRY
+
+                # The BASELINE north-star, scrapeable: p50 comes from
+                # the histogram on the operator's --metrics-port.
+                # Buckets sized for gang startup (image pull + TPU node
+                # provisioning: seconds to minutes), not request
+                # latency — the registry caches the first registration,
+                # so defaults here could never be widened later.
+                REGISTRY.histogram(
+                    "kft_gang_schedule_to_running_seconds",
+                    "gang admission to all-workers-running latency",
+                    buckets=(1.0, 2.0, 5.0, 10.0, 30.0, 60.0, 120.0,
+                             300.0, 600.0),
+                ).observe(latency)
                 self._set_phase(cr_obj, JOB_RUNNING, reason="GangRunning",
                                 message="all workers running",
                                 extra={"restarts": restarts})

@@ -50,33 +50,15 @@ class PredictionServicer:
 
     def Predict(self, request: pb.PredictRequest,
                 context: grpc.ServicerContext) -> pb.PredictResponse:
-        from kubeflow_tpu.runtime.prom import REGISTRY
-        from kubeflow_tpu.serving.model_server import (
-            REQUESTS_HELP,
-            REQUESTS_TOTAL,
-        )
-
-        # Only resolved model names become label values (unbounded
-        # client-supplied names must not grow /metrics series).
-        name, outcome = "_unknown_", "error"
-        try:
-            model = self._resolve(request.model_spec)
-            name = model.name
-            inputs = {
-                k: tensor_to_numpy(t) for k, t in request.inputs.items()
-            }
-            outputs = model.predict(inputs)
-            resp = pb.PredictResponse()
-            resp.model_spec.name = model.name
-            resp.model_spec.version = model.version
-            for key, value in outputs.items():
-                resp.outputs[key].CopyFrom(
-                    numpy_to_tensor(np.asarray(value)))
-            outcome = "ok"
-            return resp
-        finally:
-            REGISTRY.counter(REQUESTS_TOTAL, REQUESTS_HELP).inc(
-                model=name, route="grpc_predict", outcome=outcome)
+        model = self._resolve(request.model_spec)
+        inputs = {k: tensor_to_numpy(t) for k, t in request.inputs.items()}
+        outputs = model.predict(inputs)
+        resp = pb.PredictResponse()
+        resp.model_spec.name = model.name
+        resp.model_spec.version = model.version
+        for key, value in outputs.items():
+            resp.outputs[key].CopyFrom(numpy_to_tensor(np.asarray(value)))
+        return resp
 
     def Classify(self, request: pb.ClassifyRequest,
                  context: grpc.ServicerContext) -> pb.ClassifyResponse:
@@ -123,14 +105,41 @@ _METHODS = {
 
 def _wrap(servicer: PredictionServicer, name: str):
     method = getattr(servicer, name)
+    route = f"grpc_{name.lower()}"
 
     def handler(request, context):
+        # Every method counted + timed centrally (the REST face records
+        # the same series); only KNOWN model names become label values —
+        # client-supplied names must not grow /metrics series.
+        import time as _time
+
+        from kubeflow_tpu.runtime.prom import REGISTRY
+        from kubeflow_tpu.serving.model_server import (
+            LATENCY_HELP,
+            LATENCY_SECONDS,
+            REQUESTS_HELP,
+            REQUESTS_TOTAL,
+        )
+
+        spec_name = request.model_spec.name
+        model_label = spec_name \
+            if servicer.server.has_model(spec_name) else "_unknown_"
+        outcome = "error"
+        t0 = _time.perf_counter()
         try:
-            return method(request, context)
+            resp = method(request, context)
+            outcome = "ok"
+            return resp
         except KeyError as e:
             context.abort(grpc.StatusCode.NOT_FOUND, str(e))
         except ValueError as e:
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        finally:
+            REGISTRY.counter(REQUESTS_TOTAL, REQUESTS_HELP).inc(
+                model=model_label, route=route, outcome=outcome)
+            REGISTRY.histogram(
+                LATENCY_SECONDS, LATENCY_HELP,
+            ).observe(_time.perf_counter() - t0, route=route)
 
     return handler
 

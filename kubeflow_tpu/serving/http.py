@@ -188,6 +188,8 @@ class _Handler(BaseHTTPRequestHandler):
 
             from kubeflow_tpu.runtime.prom import REGISTRY
             from kubeflow_tpu.serving.model_server import (
+                LATENCY_HELP,
+                LATENCY_SECONDS,
                 REQUESTS_HELP,
                 REQUESTS_TOTAL,
             )
@@ -212,8 +214,7 @@ class _Handler(BaseHTTPRequestHandler):
                 # Failures included: the slowest requests in an incident
                 # are usually the failing ones.
                 REGISTRY.histogram(
-                    "kft_serving_request_seconds",
-                    "REST request latency by route",
+                    LATENCY_SECONDS, LATENCY_HELP,
                 ).observe(_time.perf_counter() - t0, route=action)
             self._send(200, out)
 

@@ -31,6 +31,8 @@ log = logging.getLogger(__name__)
 # faces — divergent literals would silently create a second series.
 REQUESTS_TOTAL = "kft_serving_requests_total"
 REQUESTS_HELP = "serving requests by model/route/outcome (REST + gRPC)"
+LATENCY_SECONDS = "kft_serving_request_seconds"
+LATENCY_HELP = "serving request latency by route (REST + gRPC)"
 
 
 @dataclasses.dataclass

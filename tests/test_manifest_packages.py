@@ -181,7 +181,13 @@ class TestServingIstio:
     def test_istio_off_by_default(self):
         objs = default_registry.generate("tpu-serving", "m")
         assert kinds(objs) == ["Deployment", "Service"]
-        assert "annotations" not in objs[0]["spec"]["template"]["metadata"]
+        tmpl = objs[0]["spec"]["template"]["metadata"]["annotations"]
+        # No istio injection by default; prometheus scrape annotations
+        # are always present (pod + Service, either discovery mode).
+        assert "sidecar.istio.io/inject" not in tmpl
+        assert tmpl["prometheus.io/scrape"] == "true"
+        assert objs[1]["metadata"]["annotations"][
+            "prometheus.io/port"] == "8000"
 
 
 class TestCertManager:

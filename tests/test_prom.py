@@ -84,3 +84,28 @@ class TestLabelEscaping:
         reg.counter("c").inc(model='a"b\\c\nd')
         text = reg.render()
         assert r'model="a\"b\\c\nd"' in text
+
+    def test_gang_latency_histogram_recorded(self):
+        from kubeflow_tpu.runtime.prom import REGISTRY
+
+        # The FakeKube gang from the gauge test above reaches Running
+        # via the same controller; a second reconcile records latency
+        # once pods run.  Drive a fresh job to Running explicitly.
+        from kubeflow_tpu.operator.gang import GangScheduler
+        from kubeflow_tpu.operator.kube import RUNNING, FakeKube
+        from kubeflow_tpu.operator.reconciler import TPUJobController
+
+        kube = FakeKube()
+        kube.create_custom({
+            "apiVersion": "kubeflow-tpu.org/v1", "kind": "TPUJob",
+            "metadata": {"name": "lat", "namespace": "default"},
+            "spec": {"sliceType": "v5e-1", "numWorkers": 1,
+                     "worker": {"image": "img", "command": ["true"]}},
+        })
+        ctl = TPUJobController(kube, GangScheduler({"v5e-1": 1}))
+        ctl.reconcile_all()                    # admit + create pods
+        for pod in kube.pods.values():         # fake kubelet: run them
+            pod["status"]["phase"] = RUNNING
+        ctl.reconcile_all()                    # observe gang_running
+        text = REGISTRY.render()
+        assert "kft_gang_schedule_to_running_seconds_count" in text, text

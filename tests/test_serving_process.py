@@ -7,6 +7,7 @@ actually serves what the manifests expose."""
 
 import json
 import os
+import pathlib
 import re
 import subprocess
 import sys
@@ -38,7 +39,12 @@ def served_process(tmp_path_factory):
         signature={"inputs": ["image"],
                    "outputs": ["scores", "top_k_scores", "top_k_classes"]},
     )
-    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    # PYTHONPATH pinned to the repo: the spawned CPU-only server must
+    # not inherit environment-injected jax plugin paths (a dead device
+    # tunnel would hang its jax init; `python -m` plus this keeps the
+    # package importable and the process hermetic).
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=str(pathlib.Path(__file__).parents[1]))
     proc = subprocess.Popen(
         [sys.executable, "-m", "kubeflow_tpu.serving.main",
          "--model_name", "tiny", "--model_base_path", str(base),
