@@ -187,6 +187,18 @@ class MicroBatcher:
         self._stopped = False
         self._batch_sizes: Dict[int, int] = {}
         self._requests = 0
+        from kubeflow_tpu.runtime.prom import REGISTRY
+
+        # Registered at construction so the series exists on /metrics
+        # from the first scrape — an idle or stuck batcher must show a
+        # zero-count histogram, not 'no data'.  Effective batch size is
+        # the first thing to look at when throughput is below
+        # expectation (the round-2 failure mode was mean batch ~1).
+        self._size_hist = REGISTRY.histogram(
+            "kft_serving_batch_size",
+            "occupied micro-batch size at dispatch",
+            buckets=(1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0),
+        )
         self._runners = [
             threading.Thread(target=self._run, daemon=True,
                              name=f"microbatcher-{i}")
@@ -273,9 +285,12 @@ class MicroBatcher:
                         kept.append(e)
                 self._pending = kept
                 if batch:
+                    # stats() and the scrapeable histogram record the
+                    # same quantity at the same site.
                     self._batch_sizes[len(batch)] = \
                         self._batch_sizes.get(len(batch), 0) + 1
                     self._requests += len(batch)
+                    self._size_hist.observe(float(len(batch)))
             if batch:
                 self._process(batch)
 

@@ -109,3 +109,26 @@ class TestLabelEscaping:
         ctl.reconcile_all()                    # observe gang_running
         text = REGISTRY.render()
         assert "kft_gang_schedule_to_running_seconds_count" in text, text
+
+
+class TestBatcherMetrics:
+    def test_dispatch_records_batch_size_histogram(self):
+        from kubeflow_tpu.runtime.prom import REGISTRY
+        from kubeflow_tpu.serving.model_server import MicroBatcher
+
+        mb = MicroBatcher(lambda inputs: {"y": inputs["x"]},
+                          max_batch_size=2, batch_timeout_s=0.01)
+        mb.submit({"x": np.zeros((1, 2))})
+        mb.close()
+        text = REGISTRY.render()
+        assert "kft_serving_batch_size_count" in text
+
+    def test_series_exists_before_first_dispatch(self):
+        from kubeflow_tpu.runtime.prom import REGISTRY
+        from kubeflow_tpu.serving.model_server import MicroBatcher
+
+        mb = MicroBatcher(lambda inputs: inputs, batch_timeout_s=0.01)
+        try:
+            assert "kft_serving_batch_size" in REGISTRY.render()
+        finally:
+            mb.close()
