@@ -360,7 +360,7 @@ def bench_serving(args, devices, n_chips, on_tpu):
             lambda inputs: server.predict(fam, inputs),
             max_batch_size=max_batch, batch_timeout_s=0.005,
             allowed_batch_sizes=sizes,
-            in_flight=4,
+            in_flight=4, name=fam,
         )
         req_s, stats, failures = closed_loop_clients(
             batcher, lambda: {"image": image}, n_clients, per_client)
@@ -596,7 +596,7 @@ def bench_lm_decode(args, devices, n_chips, on_tpu):
         mb = MicroBatcher(
             server.get("lm").predict, max_batch_size=batch,
             batch_timeout_s=0.02, allowed_batch_sizes=[1, batch],
-            in_flight=2,
+            in_flight=2, name="lm",
         )
         n_clients, per_client = batch, 2 if on_tpu else 1
         batcher_req_s, mb_stats, mb_failures = closed_loop_clients(

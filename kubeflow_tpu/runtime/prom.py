@@ -108,6 +108,16 @@ class Histogram(_Metric):
     def _render(self) -> List[str]:
         out: List[str] = []
         with self._lock:
+            if not self._counts:
+                # A registered-but-unobserved histogram must scrape as
+                # zero counts, not as a missing series — 'no data' is
+                # indistinguishable from 'scrape broken' on a dashboard.
+                for b in self.buckets:
+                    out.append(f'{self.name}_bucket{{le="{b}"}} 0')
+                out.append(f'{self.name}_bucket{{le="+Inf"}} 0')
+                out.append(f"{self.name}_sum 0.0")
+                out.append(f"{self.name}_count 0")
+                return out
             for key, counts in sorted(self._counts.items()):
                 for i, b in enumerate(self.buckets):
                     out.append(

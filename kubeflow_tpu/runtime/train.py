@@ -143,6 +143,14 @@ class Trainer:
         self._train_step = None
         self._multi_steps: Dict[int, Callable] = {}
         self._stackers: Dict[Any, Callable] = {}
+        self._last_metrics: Dict[str, float] = {}
+
+    @property
+    def last_metrics(self) -> Dict[str, float]:
+        """Scalar metrics from the final step of the last fit() call
+        (empty before any fit) — the public read for callers that want
+        the end-of-run loss/throughput without streaming the logger."""
+        return dict(self._last_metrics)
 
     # -- state ------------------------------------------------------------
 

@@ -173,6 +173,7 @@ class MicroBatcher:
         batch_timeout_s: float = 0.005,
         allowed_batch_sizes: Optional[List[int]] = None,
         in_flight: int = 2,
+        name: str = "default",
     ):
         self._predict = predict
         self.allowed = sorted(allowed_batch_sizes or [1, 2, 4, 8])
@@ -194,9 +195,12 @@ class MicroBatcher:
         # zero-count histogram, not 'no data'.  Effective batch size is
         # the first thing to look at when throughput is below
         # expectation (the round-2 failure mode was mean batch ~1).
+        # `name` labels the series per batcher (a process may run one
+        # per served model, like the serving-metric model= labels).
+        self._metric_name = name
         self._size_hist = REGISTRY.histogram(
             "kft_serving_batch_size",
-            "occupied micro-batch size at dispatch",
+            "occupied micro-batch size at dispatch, by batcher",
             buckets=(1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0),
         )
         self._runners = [
@@ -290,7 +294,8 @@ class MicroBatcher:
                     self._batch_sizes[len(batch)] = \
                         self._batch_sizes.get(len(batch), 0) + 1
                     self._requests += len(batch)
-                    self._size_hist.observe(float(len(batch)))
+                    self._size_hist.observe(
+                        float(len(batch)), batcher=self._metric_name)
             if batch:
                 self._process(batch)
 

@@ -1,0 +1,30 @@
+"""The quickstart example must stay executable — it is the first thing
+a new user runs (train -> checkpoint -> export -> serve -> query in one
+file; docs/user_guide.md section 1)."""
+
+import os
+import pathlib
+import subprocess
+import sys
+
+REPO = pathlib.Path(__file__).parents[1]
+
+
+def test_quickstart_end_to_end():
+    env = dict(
+        os.environ,
+        # Hermetic spawn: CPU fake slice, no environment-injected jax
+        # plugin paths (same rationale as test_serving_process.py).
+        PYTHONPATH=str(REPO),
+    )
+    env.pop("JAX_PLATFORMS", None)       # the script pins cpu itself
+    env.pop("KFT_QUICKSTART_TPU", None)  # never grab a host's real chip
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "examples" / "quickstart.py")],
+        capture_output=True, text=True, timeout=280, env=env,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "quickstart OK" in proc.stdout
+    # All four stages reported.
+    for stage in ("[1]", "[2]", "[3]", "[4]"):
+        assert stage in proc.stdout, proc.stdout

@@ -123,12 +123,20 @@ class TestBatcherMetrics:
         text = REGISTRY.render()
         assert "kft_serving_batch_size_count" in text
 
-    def test_series_exists_before_first_dispatch(self):
-        from kubeflow_tpu.runtime.prom import REGISTRY
+    def test_series_exists_before_first_dispatch(self, monkeypatch):
+        # Fresh registry (the global one is shared across tests and the
+        # earlier dispatch test already populated it): construction
+        # alone must register a scrapeable ZERO-count series — 'no
+        # data' on a stuck batcher is indistinguishable from a broken
+        # scrape.
+        import kubeflow_tpu.runtime.prom as prom
         from kubeflow_tpu.serving.model_server import MicroBatcher
 
+        fresh = Registry()
+        monkeypatch.setattr(prom, "REGISTRY", fresh)
         mb = MicroBatcher(lambda inputs: inputs, batch_timeout_s=0.01)
         try:
-            assert "kft_serving_batch_size" in REGISTRY.render()
+            text = fresh.render()
+            assert "kft_serving_batch_size_count 0" in text, text
         finally:
             mb.close()
