@@ -236,6 +236,7 @@ def bench_lm(args, devices, n_chips, on_tpu):
             flash_block_k=args.flash_block_k,
             moe_experts=args.moe_experts,
             moe_group_size=args.moe_group_size,
+            ce_dtype=args.ce_dtype,
         )
         batch = args.batch or sizes["batch"] * n_chips
     else:  # tiny hermetic config for --fake-devices runs
@@ -248,6 +249,7 @@ def bench_lm(args, devices, n_chips, on_tpu):
             save_attn_residuals=not args.no_save_attn,
             moe_experts=args.moe_experts,
             moe_group_size=args.moe_group_size,
+            ce_dtype=args.ce_dtype,
         )
         batch = args.batch or 4 * n_chips
     print(
@@ -760,6 +762,11 @@ def main() -> None:
                          "expert axis shards it")
     ap.add_argument("--lm-size", default="188m", choices=["188m", "470m"],
                     help="lm bench model size preset (on-TPU only)")
+    ap.add_argument("--ce-dtype", default="f32",
+                    choices=["f32", "compute"],
+                    help="lm cross-entropy input precision: 'compute' "
+                         "fuses f32 reductions over compute-dtype logits "
+                         "(no 4-byte logits copy in HBM)")
     ap.add_argument("--quantize", default=None, choices=[None, "int8"],
                     help="lm-decode: weight-only quantization mode")
     ap.add_argument("--kv-cache", default=None, choices=[None, "int8"],

@@ -88,9 +88,21 @@ class TransformerConfig:
     # at the bench config (4 experts, ms/step): 128 -> 516, 256 -> 471,
     # 512 -> 495, 1024 -> 528 — see models/moe.py.
     moe_group_size: int = 256
+    # Cross-entropy input precision.  "f32" materializes the full
+    # [b, s, vocab] logits tensor in float32 before the loss (simple,
+    # maximally precise).  "compute" keeps logits in the compute dtype
+    # and evaluates a fused max/logsumexp/gather loss with f32
+    # accumulation — on a bf16 model the 4-byte logits copy (2.1 GB at
+    # the bench config) never exists in HBM, and the loss cotangent is
+    # half the bytes.  Loss differs only in bf16 rounding of individual
+    # logits (reductions still accumulate f32).
+    ce_dtype: str = "f32"
 
     def __post_init__(self):
         assert self.n_heads % self.n_kv_heads == 0
+        if self.ce_dtype not in ("f32", "compute"):
+            raise ValueError(
+                f"ce_dtype={self.ce_dtype!r} not in ('f32', 'compute')")
 
     def flops_per_token(self) -> float:
         """Forward useful FLOPs per token (2*params matmul convention +
@@ -341,7 +353,9 @@ class Transformer(nn.Module):
                 jnp.float32,
             )
             logits = jnp.einsum("bse,ev->bsv", x, w_out.astype(cfg.dtype))
-        return logits.astype(jnp.float32)
+        if cfg.ce_dtype == "f32":
+            return logits.astype(jnp.float32)
+        return logits  # compute dtype; lm_task fuses the f32 reductions
 
 
 def lm_task(cfg: TransformerConfig, mesh=None):
@@ -383,9 +397,30 @@ def lm_task(cfg: TransformerConfig, mesh=None):
                 rngs={"dropout": rng},
             )
         targets = tokens[:, 1:]
-        loss = optax.softmax_cross_entropy_with_integer_labels(
-            logits[:, :-1], targets
-        ).mean()
+        if cfg.ce_dtype == "f32":
+            loss = optax.softmax_cross_entropy_with_integer_labels(
+                logits[:, :-1], targets
+            ).mean()
+        else:
+            # Fused CE on compute-dtype logits: each reduction upcasts
+            # per element inside its own fusion, so the only [b, s, v]
+            # tensors in HBM are the compute-dtype logits — no 4-byte
+            # copy, and the backward's softmax cotangent stays narrow.
+            lg = logits[:, :-1]
+            m = jax.lax.stop_gradient(
+                jnp.max(lg, axis=-1, keepdims=True))
+            # Subtract in f32 (exact; the casts fuse into the reduce —
+            # no [b, s, v] f32 tensor hits HBM): the only precision
+            # difference vs the f32 path is the bf16 storage of the
+            # logits themselves.
+            lse = jnp.log(jnp.sum(
+                jnp.exp(lg.astype(jnp.float32)
+                        - m.astype(jnp.float32)), axis=-1,
+            )) + m[..., 0].astype(jnp.float32)
+            target_logit = jnp.take_along_axis(
+                lg, targets[..., None], axis=-1
+            )[..., 0].astype(jnp.float32)
+            loss = (lse - target_logit).mean()
         metrics = {"perplexity": jnp.exp(loss)}
         if cfg.moe_experts > 0:
             aux = sum(jnp.sum(v) for v in

@@ -187,7 +187,10 @@ def lm(config: Dict[str, Any]) -> Callable:
     def make_predict(variables):
         @jax.jit
         def fwd(tokens):
-            return model.apply(variables, tokens)
+            # Full-precision logits on the wire regardless of the
+            # model's ce_dtype (a training-loss knob that changes the
+            # forward's output dtype; irrelevant to serving).
+            return model.apply(variables, tokens).astype(jnp.float32)
 
         def predict(inputs: Dict[str, Any]) -> Dict[str, Any]:
             tokens = jnp.asarray(inputs["tokens"], jnp.int32)

@@ -109,3 +109,58 @@ class TestFlops:
             **{**CFG.__dict__, "n_layers": 4}
         ).flops_per_token()
         assert 0 < small < big
+
+
+class TestFusedCE:
+    def test_compute_dtype_ce_matches_f32_on_f32_model(self):
+        """On a float32 model the two CE paths are numerically
+        identical (the flag only changes where casts happen)."""
+        rng = np.random.RandomState(5)
+        toks = jnp.asarray(rng.randint(0, CFG.vocab_size, (2, 16)),
+                           jnp.int32)
+        losses = {}
+        for mode in ("f32", "compute"):
+            cfg = TransformerConfig(
+                **{**CFG.__dict__, "dtype": jnp.float32,
+                   "ce_dtype": mode})
+            init_fn, loss_fn = lm_task(cfg)
+            params, _ = init_fn(jax.random.key(0))
+            loss, _ = loss_fn(params, {}, {"tokens": toks},
+                              jax.random.key(1))
+            losses[mode] = float(loss)
+        np.testing.assert_allclose(losses["f32"], losses["compute"],
+                                   rtol=1e-6)
+
+    def test_compute_dtype_ce_close_on_bf16_model(self):
+        """bf16 logits with f32-accumulated reductions track the f32
+        materialization closely; gradients stay finite."""
+        rng = np.random.RandomState(6)
+        toks = jnp.asarray(rng.randint(0, CFG.vocab_size, (2, 16)),
+                           jnp.int32)
+        losses = {}
+        for mode in ("f32", "compute"):
+            cfg = TransformerConfig(
+                **{**CFG.__dict__, "dtype": jnp.bfloat16,
+                   "ce_dtype": mode})
+            init_fn, loss_fn = lm_task(cfg)
+            params, _ = init_fn(jax.random.key(0))
+
+            def scalar_loss(p, loss_fn=loss_fn):
+                loss, _ = loss_fn(p, {}, {"tokens": toks},
+                                  jax.random.key(1))
+                return loss
+
+            loss, grads = jax.value_and_grad(scalar_loss)(params)
+            losses[mode] = float(loss)
+            finite = jax.tree_util.tree_all(jax.tree_util.tree_map(
+                lambda g: bool(np.isfinite(np.asarray(g, np.float32))
+                               .all()), grads))
+            assert finite
+        np.testing.assert_allclose(losses["f32"], losses["compute"],
+                                   rtol=5e-3)
+
+    def test_invalid_ce_dtype_rejected(self):
+        import pytest
+
+        with pytest.raises(ValueError, match="ce_dtype"):
+            TransformerConfig(ce_dtype="fp32")
