@@ -26,6 +26,10 @@ def main(argv=None) -> int:
     ap.add_argument("--attention", default="dot",
                     choices=["dot", "flash", "ring"])
     ap.add_argument("--remat", action="store_true")
+    ap.add_argument("--ce-dtype", default="f32",
+                    choices=["f32", "compute"],
+                    help="cross-entropy input precision (see "
+                         "TransformerConfig.ce_dtype)")
     ap.add_argument("--batch-size-per-device", type=int, default=8)
     ap.add_argument("--steps", type=int, default=100)
     ap.add_argument("--learning-rate", type=float, default=3e-4)
@@ -69,7 +73,7 @@ def main(argv=None) -> int:
         n_kv_heads=args.n_kv_heads, d_ff=args.d_ff,
         head_dim=args.head_dim, max_seq_len=args.seq_len,
         moe_experts=args.moe_experts, attention=args.attention,
-        remat=args.remat,
+        remat=args.remat, ce_dtype=args.ce_dtype,
     )
     init_fn, loss_fn = lm_task(cfg, mesh=mesh)
     batch = args.batch_size_per_device * jax.device_count()
