@@ -8,16 +8,59 @@ reference delegated entirely to the TensorBoard UI (SURVEY.md §5
 installed tensorflow; import stays lazy so the framework itself never
 depends on tf.
 
-Usage: python -m kubeflow_tpu.tools.xplane_summary <trace.xplane.pb> [top_n]
+Two analysis pitfalls this tool handles (both bit the round-3 flash
+investigation before the fix):
+
+  * a device plane has several LINES (op stream, step stream, ...) that
+    cover the same wall time; summing every event everywhere double- or
+    triple-counts.  Only the busiest line — the op stream — is summed;
+  * async ops (``copy-start``/``slice-start`` DMA prefetch) OVERLAP the
+    compute they hide behind; their durations are reported separately,
+    not added to the busy total.
+
+Usage:
+  python -m kubeflow_tpu.tools.xplane_summary <trace.xplane.pb> \
+      [top_n] [--steps N]
+
+--steps N divides every number by N (per-step table for a trace that
+captured N identical steps).
 """
 
 from __future__ import annotations
 
 import collections
+import re
 import sys
 
+# Ops whose duration overlaps other work (asynchronous DMA / transfers):
+# attributing their time to the busy total would double-count the
+# compute running underneath them.  The -done suffix may carry an HLO
+# instance id (all-reduce-done.1), so no trailing-space anchor.
+_ASYNC = re.compile(r"(copy|slice|all-reduce|all-gather|collective"
+                    r"|send|recv)-(start|done)")
 
-def summarize_xplane(path: str, top_n: int = 25) -> None:
+
+def _is_container(name: str) -> bool:
+    """Module/loop/step events re-cover the ops inside them (a bare
+    number is a step-line marker spanning the whole step)."""
+    head = name.split("=")[0]
+    return "while" in head or name.startswith("jit_") \
+        or name.startswith("jit__") or name.strip().isdigit()
+
+
+def _categorize(name: str) -> str:
+    # Only reached for leaf sync ops: async and container events are
+    # diverted before categorization.
+    if "custom-call" in name or "custom_call" in name:
+        return "custom-call (pallas)"
+    if name.startswith("%fusion") or " fusion(" in name:
+        return "fusion"
+    if "convolution" in name or "dot" in name:
+        return "dot/conv"
+    return "other"
+
+
+def summarize_xplane(path: str, top_n: int = 25, steps: int = 1) -> None:
     from tensorflow.tsl.profiler.protobuf import xplane_pb2  # lazy: dev tool
 
     xs = xplane_pb2.XSpace()
@@ -27,30 +70,75 @@ def summarize_xplane(path: str, top_n: int = 25) -> None:
         ne = sum(len(line.events) for line in p.lines)
         print(f"plane: {p.name} lines={len(p.lines)} events={ne}",
               file=sys.stderr)
+    div = max(1, steps)
     for p in xs.planes:
         if "TPU" not in p.name and "device" not in p.name.lower():
             continue
-        stats: collections.Counter = collections.Counter()
-        total = 0.0
+        # Pick the busiest line as the op stream, measured by LEAF
+        # synchronous time only: a DMA line has huge overlapped totals
+        # and a module/step line is one container event spanning the
+        # whole trace — counting either would crown the wrong line and
+        # leave the leaf tables empty.
+        best_line, best_ms = None, -1.0
         for line in p.lines:
+            ms = 0.0
             for ev in line.events:
                 name = p.event_metadata[ev.metadata_id].name
-                dur = ev.duration_ps / 1e9  # ms
-                stats[name] += dur
-                total += dur
-        if not stats:
+                if not _ASYNC.search(name) and not _is_container(name):
+                    ms += ev.duration_ps
+            ms /= 1e9
+            if ms > best_ms:
+                best_line, best_ms = line, ms
+        if best_line is None or not best_line.events:
             continue
-        print(f"== {p.name}: total {total:.1f} ms")
-        for name, ms in stats.most_common(top_n):
-            print(f"  {ms:8.2f} ms  {name[:110]}")
+        sync: collections.Counter = collections.Counter()
+        overlap: collections.Counter = collections.Counter()
+        containers: collections.Counter = collections.Counter()
+        cats: collections.Counter = collections.Counter()
+        busy = 0.0
+        for ev in best_line.events:
+            name = p.event_metadata[ev.metadata_id].name
+            dur = ev.duration_ps / 1e9  # ms
+            if _ASYNC.search(name):
+                overlap[name] += dur
+                continue
+            if _is_container(name):
+                # Containers re-cover the ops inside them — adding them
+                # to busy would double-count.
+                containers[name] += dur
+                continue
+            sync[name] += dur
+            cats[_categorize(name)] += dur
+            busy += dur
+        per = "" if div == 1 else f" ({busy / div:.2f} ms/step x {div})"
+        print(f"== {p.name}: busy (leaf ops) {busy:.1f} ms{per}")
+        print("  -- by category --")
+        for cat, ms in cats.most_common():
+            print(f"  {ms / div:9.2f} ms  {100 * ms / busy:5.1f}%  {cat}")
+        if containers:
+            print("  -- containers (cover the ops above) --")
+            for name, ms in containers.most_common(4):
+                print(f"  {ms / div:9.2f} ms  {name[:105]}")
+        print(f"  -- top {top_n} ops --")
+        for name, ms in sync.most_common(top_n):
+            print(f"  {ms / div:9.2f} ms  {name[:105]}")
+        if overlap:
+            print("  -- overlapped (async DMA; hidden behind compute) --")
+            for name, ms in overlap.most_common(min(top_n, 8)):
+                print(f"  {ms / div:9.2f} ms  {name[:105]}")
 
 
 def main(argv=None) -> int:
-    argv = argv if argv is not None else sys.argv[1:]
-    if not argv:
-        print(__doc__, file=sys.stderr)
-        return 2
-    summarize_xplane(argv[0], int(argv[1]) if len(argv) > 1 else 25)
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="kubeflow-tpu-xplane-summary", description=__doc__)
+    ap.add_argument("trace", help="path to a *.xplane.pb file")
+    ap.add_argument("top_n", nargs="?", type=int, default=25)
+    ap.add_argument("--steps", type=int, default=1,
+                    help="divide every number by N (per-step table)")
+    args = ap.parse_args(argv)
+    summarize_xplane(args.trace, args.top_n, steps=args.steps)
     return 0
 
 
