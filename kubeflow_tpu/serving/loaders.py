@@ -185,16 +185,30 @@ def lm(config: Dict[str, Any]) -> Callable:
     model = Transformer(cfg)
 
     def make_predict(variables):
+        from kubeflow_tpu.ops.quantize import narrow_params
+
+        # Stage weights to HBM once, narrowed to the compute dtype —
+        # the same treatment lm_generate got: raw orbax-restored numpy
+        # leaves passed into jit are re-uploaded per call, and numpy
+        # embedding tables cannot be fancy-indexed by a tracer at all
+        # (the bf16 path crashed before any perf question arose).
+        params = jax.device_put(
+            narrow_params(variables["params"], cfg.dtype))
+
         @jax.jit
-        def fwd(tokens):
-            # Full-precision logits on the wire regardless of the
-            # model's ce_dtype (a training-loss knob that changes the
-            # forward's output dtype; irrelevant to serving).
-            return model.apply(variables, tokens).astype(jnp.float32)
+        def fwd(params, tokens):
+            # Params are a jit ARGUMENT (not a closure constant —
+            # closed-over arrays can be baked into the executable as a
+            # second resident copy; lm_generate passes them the same
+            # way).  Full-precision logits on the wire regardless of
+            # the model's ce_dtype (a training-loss knob that changes
+            # the forward's output dtype; irrelevant to serving).
+            return model.apply(
+                {"params": params}, tokens).astype(jnp.float32)
 
         def predict(inputs: Dict[str, Any]) -> Dict[str, Any]:
             tokens = jnp.asarray(inputs["tokens"], jnp.int32)
-            return {"logits": fwd(tokens)}
+            return {"logits": fwd(params, tokens)}
 
         return predict
 

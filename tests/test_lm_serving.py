@@ -90,3 +90,32 @@ class TestLMServing:
         meta = served.metadata("lm")
         assert meta["metadata"]["loader"].endswith("lm_generate")
         assert meta["metadata"]["signature"]["inputs"] == ["tokens"]
+
+def test_lm_logits_loader_serves_f32_regardless_of_ce_dtype(tmp_path):
+    """ce_dtype='compute' changes the model forward's output dtype (a
+    training-loss knob); the serving `lm` loader must still put float32
+    logits on the wire."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kubeflow_tpu.models.transformer import Transformer, TransformerConfig
+    from kubeflow_tpu.serving.export import export
+    from kubeflow_tpu.serving.model_server import ModelServer
+
+    overrides = {
+        "vocab_size": 64, "d_model": 16, "n_layers": 1, "n_heads": 2,
+        "n_kv_heads": 2, "d_ff": 32, "head_dim": 8, "max_seq_len": 16,
+        "dtype": "bfloat16", "ce_dtype": "compute",
+    }
+    cfg = TransformerConfig(**{**overrides, "dtype": jnp.bfloat16})
+    model = Transformer(cfg)
+    variables = model.init(jax.random.key(0), jnp.zeros((1, 4), jnp.int32))
+    assert model.apply(variables, jnp.zeros((1, 4), jnp.int32)).dtype \
+        == jnp.bfloat16  # the knob really does change the forward dtype
+    export(str(tmp_path / "lm"), 1, variables,
+           loader="kubeflow_tpu.serving.loaders:lm", config=overrides)
+    server = ModelServer()
+    server.add_model("lm", str(tmp_path / "lm"))
+    out = server.predict("lm", {"tokens": np.asarray([[1, 2, 3]], np.int32)})
+    assert np.asarray(out["logits"]).dtype == np.float32
