@@ -31,6 +31,69 @@ import sys
 import time
 
 
+def acquire_devices(get_devices, attempts=5, delays=(5, 10, 20, 40, 80),
+                    sleep=time.sleep, reset=None, log=None):
+    """Bounded retry around backend acquisition.
+
+    The round-3 driver capture died with ``rc=1`` at the bare
+    ``jax.devices()`` call — one transient ``UNAVAILABLE`` from the
+    tunneled TPU backend and the whole round had no perf number of
+    record.  This wraps backend acquisition in a bounded
+    retry-with-backoff (default: 5 attempts, ~2.5 min of waiting) and,
+    if every attempt fails, returns a *structured failure record*
+    instead of letting the traceback escape — stdout still carries
+    exactly one parseable JSON line either way.
+
+    Returns ``(devices, None)`` on success or ``(None, record)`` where
+    ``record`` is the JSON-able failure object to print.  ``reset`` is
+    called between attempts to drop any cached failed backend (JAX
+    caches backend init, so a retry without a reset would just replay
+    the cached error).
+    """
+    log = log or (lambda msg: print(msg, file=sys.stderr))
+    errors = []
+    for attempt in range(attempts):
+        try:
+            return get_devices(), None
+        except RuntimeError as e:  # jax.errors.JaxRuntimeError included
+            errors.append(f"attempt {attempt + 1}: {type(e).__name__}: {e}")
+            log(f"backend acquisition failed ({errors[-1]})")
+            if attempt + 1 < attempts:
+                if reset is not None:
+                    try:
+                        reset()
+                    except Exception as re:
+                        log(f"backend reset failed (non-fatal): {re}")
+                delay = delays[min(attempt, len(delays) - 1)]
+                log(f"retrying in {delay}s "
+                    f"({attempt + 2}/{attempts})")
+                sleep(delay)
+    return None, {
+        "metric": "backend_init_failed",
+        "value": 0.0,
+        "unit": "error",
+        "vs_baseline": 0.0,
+        "detail": {
+            "error": "device backend unavailable after bounded retry",
+            "attempts": attempts,
+            "log": errors,
+        },
+    }
+
+
+def _reset_jax_backend():
+    """Drop JAX's cached backend so the next jax.devices() really retries."""
+    import jax
+
+    try:
+        jax.extend.backend.clear_backends()
+    except Exception:
+        # Fallback for jax versions without the extend API.
+        from jax._src import xla_bridge
+
+        xla_bridge.backends.cache_clear()  # type: ignore[attr-defined]
+
+
 def closed_loop_clients(batcher, make_inputs, n_clients, per_client):
     """Drive a MicroBatcher with closed-loop client threads.
 
@@ -236,6 +299,7 @@ def bench_lm(args, devices, n_chips, on_tpu):
             flash_block_k=args.flash_block_k,
             moe_experts=args.moe_experts,
             moe_group_size=args.moe_group_size,
+            moe_impl=args.moe_impl,
             ce_dtype=args.ce_dtype,
         )
         batch = args.batch or sizes["batch"] * n_chips
@@ -249,6 +313,7 @@ def bench_lm(args, devices, n_chips, on_tpu):
             save_attn_residuals=not args.no_save_attn,
             moe_experts=args.moe_experts,
             moe_group_size=args.moe_group_size,
+            moe_impl=args.moe_impl,
             ce_dtype=args.ce_dtype,
         )
         batch = args.batch or 4 * n_chips
@@ -294,7 +359,8 @@ def bench_lm(args, devices, n_chips, on_tpu):
             "lm_size": args.lm_size,
             **({"moe_experts": cfg.moe_experts,
                 "moe_top_k": cfg.moe_top_k,
-                "moe_group_size": cfg.moe_group_size}
+                "moe_group_size": cfg.moe_group_size,
+                "moe_impl": cfg.moe_impl}
                if cfg.moe_experts else {}),
         },
     }
@@ -611,6 +677,53 @@ def bench_lm_decode(args, devices, n_chips, on_tpu):
         if mb_failures:
             print(f"lm batcher: {mb_failures} failed requests",
                   file=sys.stderr)
+
+        # MIXED-length clients through the BucketedLMBatcher (VERDICT r3
+        # item 7): prompts of three different lengths left-pad to two
+        # buckets, so they still share batched generate programs instead
+        # of degrading to batch-1 per unique shape (the round-3
+        # behavior).  Each bucket compiles once; the target is req/s
+        # within ~2x of the uniform-length number above.
+        import random as _random
+
+        from kubeflow_tpu.serving.model_server import BucketedLMBatcher
+
+        half = max(1, prompt_len // 2)
+        lengths = [half, max(1, (3 * prompt_len) // 4), prompt_len]
+
+        def make_bucketed():
+            return BucketedLMBatcher(
+                server.get("lm").predict,
+                buckets=[half, prompt_len],
+                max_batch_size=batch, batch_timeout_s=0.02,
+                allowed_batch_sizes=[1, batch], in_flight=2,
+                name="lm-bucketed",
+            )
+
+        pick = _random.Random(0)
+
+        def mixed_inputs():
+            return {"tokens": rng.randint(
+                1, cfg.vocab_size, size=(1, pick.choice(lengths))
+            ).astype(np.int32)}
+
+        # Warm pass on a throwaway batcher: the half-length bucket is a
+        # NEW program shape (at batch 1 and the coalesced batch) that
+        # decode() above never compiled; without this, multi-second XLA
+        # compiles land inside the timed window and dominate the
+        # reported req/s.  Jit caches are global, so the timed batcher
+        # starts warm with clean stats.
+        warm = make_bucketed()
+        closed_loop_clients(warm, mixed_inputs, n_clients, 1)
+        warm.close()
+
+        bmb = make_bucketed()
+        mixed_req_s, bmb_stats, bmb_failures = closed_loop_clients(
+            bmb, mixed_inputs, n_clients, per_client)
+        bmb.close()
+        if bmb_failures:
+            print(f"lm bucketed batcher: {bmb_failures} failed requests",
+                  file=sys.stderr)
     tok_s_b1 = new_tokens / lat1_s
     tok_s = batch * new_tokens / latb_s
     print(f"lm decode: batch-1 {lat1_s*1e3:.1f} ms ({tok_s_b1:.1f} tok/s,"
@@ -636,6 +749,10 @@ def bench_lm_decode(args, devices, n_chips, on_tpu):
             "batcher_mean_batch_size": mb_stats["mean_batch_size"],
             "batcher_tokens_per_sec": round(
                 batcher_req_s * new_tokens, 1),
+            "batcher_mixed_requests_per_sec": round(mixed_req_s, 1),
+            "batcher_mixed_mean_batch_size":
+                bmb_stats["mean_batch_size"],
+            "batcher_mixed_lengths": lengths,
             **({"quantize": args.quantize} if args.quantize else {}),
             **({"kv_cache": args.kv_cache} if args.kv_cache else {}),
         },
@@ -772,6 +889,11 @@ def main() -> None:
     ap.add_argument("--kv-cache", default=None, choices=[None, "int8"],
                     help="lm-decode: quantized KV cache "
                          "(per-position scales)")
+    ap.add_argument("--moe-impl", default="gather",
+                    choices=["gather", "einsum"],
+                    help="MoE dispatch/combine implementation "
+                         "(models/moe.py; 'gather' removes the O(g) "
+                         "one-hot contractions)")
     ap.add_argument("--moe-group-size", type=int, default=256,
                     help="GShard routing group (tokens) for --moe-experts")
     ap.add_argument("--remat-policy", default="nobatch",
@@ -796,7 +918,13 @@ def main() -> None:
     if args.fake_devices:
         jax.config.update("jax_platforms", "cpu")
 
-    devices = jax.devices()
+    devices, failure = acquire_devices(jax.devices,
+                                       reset=_reset_jax_backend)
+    if failure is not None:
+        # Structured failure record on stdout (the driver parses it);
+        # rc=0 so the capture is recorded rather than discarded.
+        print(json.dumps(failure))
+        return
     n_chips = len(devices)
     on_tpu = devices[0].platform == "tpu"
     if args.model == "lm":

@@ -1,0 +1,83 @@
+"""bench.py backend-acquisition resilience.
+
+Round 3's driver capture failed with rc=1 because one transient
+``UNAVAILABLE`` from the tunneled TPU backend escaped the bare
+``jax.devices()`` call (VERDICT round 3, item 1).  These tests pin the
+fix: a bounded retry that survives transient failures, resets the cached
+backend between attempts, and degrades to a single parseable JSON
+failure record when the backend never comes up.
+"""
+
+import json
+
+import bench
+
+
+class _FlakyBackend:
+    """Fails n times, then succeeds — the tunnel flake in miniature."""
+
+    def __init__(self, failures, devices=("dev0",)):
+        self.failures = failures
+        self.calls = 0
+        self.devices = list(devices)
+
+    def __call__(self):
+        self.calls += 1
+        if self.calls <= self.failures:
+            raise RuntimeError("UNAVAILABLE: TPU backend setup/compile error")
+        return self.devices
+
+
+def test_retry_survives_two_transient_failures():
+    backend = _FlakyBackend(failures=2)
+    sleeps = []
+    resets = []
+    devices, failure = bench.acquire_devices(
+        backend, attempts=5, delays=(1, 2, 4),
+        sleep=sleeps.append, reset=lambda: resets.append(1),
+        log=lambda m: None)
+    assert failure is None
+    assert devices == ["dev0"]
+    assert backend.calls == 3
+    # Backed off before each retry, and reset the cached backend so the
+    # retry is real rather than a replay of the cached error.
+    assert sleeps == [1, 2]
+    assert len(resets) == 2
+
+
+def test_exhausted_retry_returns_structured_record():
+    backend = _FlakyBackend(failures=99)
+    devices, failure = bench.acquire_devices(
+        backend, attempts=3, delays=(0,),
+        sleep=lambda s: None, log=lambda m: None)
+    assert devices is None
+    assert backend.calls == 3
+    # The record must be JSON-able and carry the one-line bench contract
+    # fields so the driver's parser accepts it.
+    line = json.loads(json.dumps(failure))
+    assert line["metric"] == "backend_init_failed"
+    assert {"metric", "value", "unit", "vs_baseline"} <= set(line)
+    assert line["detail"]["attempts"] == 3
+    assert len(line["detail"]["log"]) == 3
+    assert "UNAVAILABLE" in line["detail"]["log"][0]
+
+
+def test_reset_failure_is_nonfatal():
+    backend = _FlakyBackend(failures=1)
+
+    def bad_reset():
+        raise ValueError("no cached backend")
+
+    devices, failure = bench.acquire_devices(
+        backend, attempts=2, delays=(0,), sleep=lambda s: None,
+        reset=bad_reset, log=lambda m: None)
+    assert failure is None
+    assert devices == ["dev0"]
+
+
+def test_delays_are_bounded():
+    # The whole retry budget must stay within the driver's patience
+    # (~3 minutes): sum of default delays < 180 s even though the last
+    # delay repeats if attempts exceed the table.
+    total = sum(bench.acquire_devices.__defaults__[1])
+    assert total <= 180
