@@ -88,6 +88,11 @@ class TransformerConfig:
     # at the bench config (4 experts, ms/step): 128 -> 516, 256 -> 471,
     # 512 -> 495, 1024 -> 528 — see models/moe.py.
     moe_group_size: int = 256
+    # MoE dispatch/combine implementation: "gather" (slot-index scatter
+    # + row gathers, no O(g) contraction) or "einsum" (GShard one-hot
+    # contractions).  See models/moe.py MoEMLP.impl for the trade and
+    # BASELINE.md for the on-chip sweep.
+    moe_impl: str = "gather"
     # Cross-entropy input precision.  "f32" materializes the full
     # [b, s, vocab] logits tensor in float32 before the loss (simple,
     # maximally precise).  "compute" keeps logits in the compute dtype
@@ -97,12 +102,37 @@ class TransformerConfig:
     # half the bytes.  Loss differs only in bf16 rounding of individual
     # logits (reductions still accumulate f32).
     ce_dtype: str = "f32"
+    # Pipeline parallelism: >0 streams this many microbatches through the
+    # layer stack under the GPipe schedule (parallel/pipeline.py) whenever
+    # the model's mesh has a `pipeline` axis > 1.  The nn.scan param stack
+    # [L, ...] is sharded L/S layers per stage via the ("layers", PIPELINE)
+    # rule; embed / final norm / logits stay replicated across stages.
+    # 0 (or a pipeline-less mesh) runs the plain sequential scan.
+    pipeline_microbatches: int = 0
 
     def __post_init__(self):
         assert self.n_heads % self.n_kv_heads == 0
         if self.ce_dtype not in ("f32", "compute"):
             raise ValueError(
                 f"ce_dtype={self.ce_dtype!r} not in ('f32', 'compute')")
+        if self.pipeline_microbatches:
+            # The GPipe path applies the block functionally per layer
+            # slice inside shard_map; combinations needing rng threading
+            # (dropout), sown collections (MoE aux loss), or a nested
+            # sequence-axis shard_map (ring) are rejected up front.
+            if self.dropout_rate:
+                raise ValueError(
+                    "pipeline_microbatches requires dropout_rate=0")
+            if self.moe_experts:
+                raise ValueError(
+                    "pipeline_microbatches is incompatible with "
+                    "moe_experts>0 (MoE aux losses are sown, which the "
+                    "pipelined functional block does not thread)")
+            if self.attention == "ring":
+                raise ValueError(
+                    "pipeline_microbatches cannot nest ring attention; "
+                    "use attention='dot' or 'flash' inside pipeline "
+                    "stages")
 
     def flops_per_token(self) -> float:
         """Forward useful FLOPs per token (2*params matmul convention +
@@ -271,6 +301,7 @@ class Block(nn.Module):
                 num_experts=cfg.moe_experts, top_k=cfg.moe_top_k,
                 capacity_factor=cfg.moe_capacity_factor,
                 group_size=cfg.moe_group_size, dtype=cfg.dtype,
+                impl=cfg.moe_impl,
                 name="moe",
             )(y)
         else:
@@ -281,6 +312,28 @@ class Block(nn.Module):
         x = x + y
         x = nn.with_logical_constraint(x, ("batch", "seq", "act_embed"))
         return x, None
+
+
+def _remat_policy(cfg: TransformerConfig):
+    """Checkpoint policy for one decoder block under remat (shared by the
+    sequential nn.scan path and the GPipe per-layer body)."""
+    policies = {
+        "dots": jax.checkpoint_policies.dots_saveable,
+        "nobatch":
+            jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+    }
+    if cfg.remat_policy not in policies:
+        raise ValueError(
+            f"remat_policy={cfg.remat_policy!r} not in "
+            f"{sorted(policies)}")
+    policy = policies[cfg.remat_policy]
+    if cfg.attention == "flash" and cfg.save_attn_residuals:
+        policy = jax.checkpoint_policies.save_from_both_policies(
+            policy,
+            jax.checkpoint_policies.save_only_these_names(
+                "flash_out", "flash_lse"),
+        )
+    return policy
 
 
 class Transformer(nn.Module):
@@ -305,6 +358,7 @@ class Transformer(nn.Module):
             (cfg.vocab_size, cfg.d_model),
             jnp.float32,
         )
+        default_positions = positions is None
         if positions is None:
             positions = jnp.broadcast_to(
                 jnp.arange(tokens.shape[1]), tokens.shape
@@ -312,35 +366,33 @@ class Transformer(nn.Module):
         x = embed.astype(cfg.dtype)[tokens]
         x = nn.with_logical_constraint(x, ("batch", "seq", "act_embed"))
 
-        block = Block
-        if cfg.remat:
-            policies = {
-                "dots": jax.checkpoint_policies.dots_saveable,
-                "nobatch":
-                    jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
-            }
-            if cfg.remat_policy not in policies:
+        use_pipeline = (
+            cfg.pipeline_microbatches > 0
+            and self.mesh is not None
+            and self.mesh.shape.get("pipeline", 1) > 1
+            and not self.is_initializing()
+        )
+        if use_pipeline:
+            if not default_positions or segment_ids is not None:
                 raise ValueError(
-                    f"remat_policy={cfg.remat_policy!r} not in "
-                    f"{sorted(policies)}")
-            policy = policies[cfg.remat_policy]
-            if cfg.attention == "flash" and cfg.save_attn_residuals:
-                policy = jax.checkpoint_policies.save_from_both_policies(
-                    policy,
-                    jax.checkpoint_policies.save_only_these_names(
-                        "flash_out", "flash_lse"),
-                )
-            block = nn.remat(Block, policy=policy)
-        # One compiled body for all layers; params gain a leading 'layers'
-        # dim (unsharded by default; a pipeline schedule maps it to `stage`).
-        x, _ = nn.scan(
-            block,
-            variable_axes={"params": 0, "losses": 0},
-            split_rngs={"params": True, "dropout": True},
-            length=cfg.n_layers,
-            metadata_params={nn.PARTITION_NAME: "layers"},
-            in_axes=(nn.broadcast, nn.broadcast),
-        )(cfg, deterministic, self.mesh, name="layers")(x, positions, segment_ids)
+                    "the pipelined layer stack supports only default "
+                    "positions and no segment_ids")
+            x = self._pipelined_layers(x)
+        else:
+            block = nn.remat(Block, policy=_remat_policy(cfg)) \
+                if cfg.remat else Block
+            # One compiled body for all layers; params gain a leading
+            # 'layers' dim, sharded over the `pipeline` mesh axis by the
+            # rule table (a no-op at pipeline=1).
+            x, _ = nn.scan(
+                block,
+                variable_axes={"params": 0, "losses": 0},
+                split_rngs={"params": True, "dropout": True},
+                length=cfg.n_layers,
+                metadata_params={nn.PARTITION_NAME: "layers"},
+                in_axes=(nn.broadcast, nn.broadcast),
+            )(cfg, deterministic, self.mesh, name="layers")(
+                x, positions, segment_ids)
 
         x = RMSNorm(dtype=cfg.dtype, name="final_norm")(x)
         if cfg.tied_embeddings:
@@ -356,6 +408,73 @@ class Transformer(nn.Module):
         if cfg.ce_dtype == "f32":
             return logits.astype(jnp.float32)
         return logits  # compute dtype; lm_task fuses the f32 reductions
+
+    def _pipelined_layers(self, x: jax.Array) -> jax.Array:
+        """GPipe path: parallel/pipeline.py's schedule over the real block.
+
+        The nn.scan param stack [L, ...] (sharded L/S layers per stage over
+        the `pipeline` axis by the ("layers", PIPELINE) rule) runs under
+        ``pipelined_scan``: microbatches stream through the stage ring via
+        ppermute.  shard_map is manual over the pipeline axis ONLY
+        (``axis_names={PIPELINE}``) — batch/fsdp/tensor stay auto, so XLA
+        still inserts the usual data/tensor collectives inside each stage.
+        Embedding, final norm, and logits run replicated across stages
+        (cheap next to the L blocks; the psum at the schedule's end hands
+        every stage the full activations).
+        """
+        import functools
+
+        from jax.sharding import PartitionSpec as P
+
+        from kubeflow_tpu.parallel.mesh import PIPELINE
+        from kubeflow_tpu.parallel.pipeline import (
+            microbatch,
+            pipelined_scan,
+            unmicrobatch,
+        )
+
+        cfg = self.cfg
+        n_micro = cfg.pipeline_microbatches
+        n_stages = self.mesh.shape[PIPELINE]
+        if x.shape[0] % n_micro:
+            raise ValueError(
+                f"batch {x.shape[0]} not divisible by "
+                f"pipeline_microbatches={n_micro}")
+        if cfg.n_layers % n_stages:
+            raise ValueError(
+                f"n_layers={cfg.n_layers} not divisible by "
+                f"pipeline={n_stages} stages")
+        stacked = nn.unbox(self.get_variable("params", "layers"))
+        block = Block(cfg, deterministic=True, mesh=None)
+
+        def body(layer_params, act):
+            pos = jnp.broadcast_to(jnp.arange(act.shape[1]), act.shape[:2])
+            out, _ = block.apply({"params": layer_params}, act, pos, None)
+            return out
+
+        if cfg.remat:
+            body = jax.checkpoint(body, policy=_remat_policy(cfg))
+
+        pipe_specs = jax.tree_util.tree_map(lambda _: P(PIPELINE), stacked)
+
+        @functools.partial(
+            jax.shard_map, mesh=self.mesh,
+            in_specs=(pipe_specs, P()), out_specs=P(),
+            axis_names={PIPELINE},
+        )
+        def run(params, act):
+            act = act.astype(cfg.dtype)
+            out = unmicrobatch(
+                pipelined_scan(body, params, microbatch(act, n_micro)))
+            return out.astype(jnp.float32)
+
+        # Activations cross the shard_map boundary in f32 (cast back to
+        # the compute dtype on each side): the boundary's transpose
+        # inserts a psum over the pipeline axis for the activation
+        # cotangent, and XLA's partitioner aborts on sub-f32 all-reduce
+        # inside a partial-manual region (same bug pipelined_scan works
+        # around for its own output psum).
+        return run(stacked, x.astype(jnp.float32)).astype(cfg.dtype)
 
 
 def lm_task(cfg: TransformerConfig, mesh=None):

@@ -145,6 +145,8 @@ DEFAULT_RULES: LogicalRules = (
     ("vocab", TENSOR),              # embedding/output table split
     ("expert", EXPERT),             # MoE expert dim
     ("stage", PIPELINE),            # pipeline stage dim
+    ("layers", PIPELINE),           # nn.scan layer stack: L/S layers per
+                                    # stage under GPipe (no-op at pipeline=1)
     ("conv_out", None),             # conv channels replicated (ResNet is DP-only)
     ("norm", None),
 )

