@@ -51,6 +51,18 @@ class MoEMLP(nn.Module):
     # .moe_group_size documents the sweep).
     group_size: int = 256
     dtype: object = jnp.bfloat16
+    # Dispatch/combine implementation:
+    #   "einsum" — GShard one-hot einsums: dispatch builds a [g, E, C]
+    #     one-hot tensor and contracts over the g tokens, O(g*E*C*d)
+    #     MACs each way.  Robust, differentiable everywhere, but the
+    #     contraction is pure token MOVEMENT priced as MXU work — it was
+    #     ~1/3 of the measured MoE step at the bench config.
+    #   "gather" — the same routing decisions materialized as indices:
+    #     a [E, C] slot->token scatter, a row gather into the expert
+    #     batch (O(E*C*d) bytes moved, no MACs), and a per-choice row
+    #     gather back out (O(g*top_k*d)).  Identical numerics and drop
+    #     semantics; the g-fold reduction dimension disappears.
+    impl: str = "gather"
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
@@ -65,6 +77,22 @@ class MoEMLP(nn.Module):
         # per token and never dropping anything).
         g = next(cand for cand in range(min(self.group_size, n_tokens), 0, -1)
                  if n_tokens % cand == 0)
+        if g < min(self.group_size, n_tokens) // 4:
+            # The divisor scan itself can degenerate (prime-ish token
+            # counts collapse g to 1-2): capacity then clamps to top_k
+            # and expert compute/memory inflates by up to
+            # num_experts/top_k x.  Trace-time warning so the config is
+            # fixed, not silently paid every step.
+            import warnings
+
+            warnings.warn(
+                f"MoE routing group degenerated: n_tokens={n_tokens} has "
+                f"no divisor near group_size={self.group_size} (fitted "
+                f"g={g}); per-group capacity clamps to top_k and expert "
+                f"compute inflates by up to num_experts/top_k x.  Choose "
+                f"batch*seq with a divisor close to group_size.",
+                stacklevel=2,
+            )
         n_groups = n_tokens // g
         capacity = max(
             self.top_k,
@@ -102,10 +130,9 @@ class MoEMLP(nn.Module):
         gate_vals = gate_vals / jnp.maximum(
             gate_vals.sum(-1, keepdims=True), 1e-9)
 
-        dispatch = jnp.zeros(
-            (n_groups, g, cfg_e, capacity), jnp.bfloat16)
-        combine = jnp.zeros(
-            (n_groups, g, cfg_e, capacity), jnp.float32)
+        # Greedy per-choice routing: slot positions along each group's
+        # token axis via cumsum (shared by both implementations).
+        route_idx, route_pos, route_keep = [], [], []      # [k] x [G, g]
         counts = jnp.zeros((n_groups, cfg_e), jnp.int32)
         for choice in range(self.top_k):
             idx = gate_idx[..., choice]                    # [G, g]
@@ -113,30 +140,80 @@ class MoEMLP(nn.Module):
             pos = counts[:, None, :] + jnp.cumsum(onehot, axis=1) - 1
             my_pos = jnp.take_along_axis(
                 pos, idx[..., None], axis=2)[..., 0]       # [G, g]
-            keep = my_pos < capacity
             counts = counts + onehot.sum(1)
-            pos_onehot = jax.nn.one_hot(
-                jnp.where(keep, my_pos, capacity), capacity + 1,
-                dtype=jnp.float32)[..., :capacity]         # [G, g, C]
-            contrib = (onehot.astype(jnp.float32)[..., :, None]
-                       * pos_onehot[..., None, :])         # [G, g, E, C]
-            dispatch = dispatch + contrib.astype(jnp.bfloat16)
-            combine = combine + contrib * gate_vals[..., choice, None, None]
+            route_idx.append(idx)
+            route_pos.append(my_pos)
+            route_keep.append(my_pos < capacity)
+
+        dt = self.dtype
+        if self.impl == "gather":
+            # Slot -> source-token index map, built by scatter (a [g]
+            # write per choice; dropped tokens write column `capacity`,
+            # which is out of bounds and dropped).  Sentinel g points at
+            # the zero row appended to the token table, so unfilled
+            # slots read zeros exactly as the one-hot contraction gave.
+            slot_src = jnp.full((n_groups, cfg_e, capacity), g, jnp.int32)
+            token_ids = jnp.broadcast_to(
+                jnp.arange(g)[None, :], (n_groups, g))
+            for choice in range(self.top_k):
+                pos_or_oob = jnp.where(
+                    route_keep[choice], route_pos[choice], capacity)
+                slot_src = jax.vmap(
+                    lambda s, e, p, t: s.at[e, p].set(t, mode="drop")
+                )(slot_src, route_idx[choice], pos_or_oob, token_ids)
+            tokens_pad = jnp.concatenate(
+                [tokens.astype(dt),
+                 jnp.zeros((n_groups, 1, d), dt)], axis=1)
+            expert_in = jax.vmap(lambda tp, ss: tp[ss])(
+                tokens_pad, slot_src)                      # [G, E, C, d]
+        else:
+            if self.impl != "einsum":
+                raise ValueError(f"unknown moe impl {self.impl!r}")
+            # One contrib tensor per choice feeds BOTH the dispatch and
+            # combine accumulations — the drop/sentinel logic lives in
+            # exactly one place.
+            dispatch = jnp.zeros(
+                (n_groups, g, cfg_e, capacity), jnp.bfloat16)
+            combine = jnp.zeros(
+                (n_groups, g, cfg_e, capacity), jnp.float32)
+            for choice in range(self.top_k):
+                onehot = jax.nn.one_hot(
+                    route_idx[choice], cfg_e, dtype=jnp.float32)
+                pos_onehot = jax.nn.one_hot(
+                    jnp.where(route_keep[choice], route_pos[choice],
+                              capacity),
+                    capacity + 1, dtype=jnp.float32)[..., :capacity]
+                contrib = onehot[..., :, None] * pos_onehot[..., None, :]
+                dispatch = dispatch + contrib.astype(jnp.bfloat16)
+                combine = combine \
+                    + contrib * gate_vals[..., choice, None, None]
+            expert_in = jnp.einsum(
+                "gnec,gnd->gecd", dispatch, tokens.astype(jnp.bfloat16))
 
         # Expert compute: [G, E, C, d] batched SwiGLU — one big MXU batch.
-        expert_in = jnp.einsum(
-            "gnec,gnd->gecd", dispatch, tokens.astype(jnp.bfloat16))
         expert_in = nn.with_logical_constraint(
             expert_in, (None, "expert", None, None))
-        dt = self.dtype
         gate = jnp.einsum("gecd,edf->gecf", expert_in, wi[:, 0].astype(dt))
         up = jnp.einsum("gecd,edf->gecf", expert_in, wi[:, 1].astype(dt))
         h = nn.silu(gate) * up
         h = nn.with_logical_constraint(h, (None, "expert", None, "mlp"))
         expert_out = jnp.einsum("gecf,efd->gecd", h, wo.astype(dt))
 
-        out = jnp.einsum(
-            "gnec,gecd->gnd", combine.astype(dt), expert_out)
+        if self.impl == "gather":
+            # Each token reads its top_k slots back out: a per-choice
+            # row gather weighted by the (renormalized, kept) gates.
+            out = jnp.zeros((n_groups, g, d), dt)
+            for choice in range(self.top_k):
+                rows = jax.vmap(lambda eo, e, p: eo[e, p])(
+                    expert_out, route_idx[choice],
+                    jnp.clip(route_pos[choice], 0, capacity - 1),
+                )                                          # [G, g, d]
+                w = (gate_vals[..., choice]
+                     * route_keep[choice]).astype(dt)[..., None]
+                out = out + rows * w
+        else:
+            out = jnp.einsum(
+                "gnec,gecd->gnd", combine.astype(dt), expert_out)
 
         # Switch load-balance loss: E * sum_e (fraction of tokens routed
         # to e) * (mean router prob of e); minimised by uniform routing.

@@ -94,6 +94,20 @@ class Histogram(_Metric):
         self._counts: Dict[Tuple, List[int]] = {}
         self._sums: Dict[Tuple, float] = {}
 
+    def declare(self, **labels) -> "Histogram":
+        """Pre-declare a label series so it scrapes as zero counts from
+        the first render.  The bare-name zero fallback in _render only
+        covers the unlabeled series (once any labeled series observes,
+        an unlabeled zero line would vanish and churn staleness);
+        callers that know their label values at construction declare
+        them here — each idle series then shows real zeros rather than
+        'no data'."""
+        key = _label_key(labels)
+        with self._lock:
+            self._counts.setdefault(key, [0] * (len(self.buckets) + 1))
+            self._sums.setdefault(key, 0.0)
+        return self
+
     def observe(self, value: float, **labels) -> None:
         key = _label_key(labels)
         with self._lock:
@@ -112,6 +126,8 @@ class Histogram(_Metric):
                 # A registered-but-unobserved histogram must scrape as
                 # zero counts, not as a missing series — 'no data' is
                 # indistinguishable from 'scrape broken' on a dashboard.
+                # This bare-name guarantee only holds for UNLABELED
+                # histograms; labeled series get it via declare().
                 for b in self.buckets:
                     out.append(f'{self.name}_bucket{{le="{b}"}} 0')
                 out.append(f'{self.name}_bucket{{le="+Inf"}} 0')
@@ -147,6 +163,15 @@ class Registry:
             elif not isinstance(m, cls):
                 raise ValueError(
                     f"{name} already registered as {m.kind}")
+            elif "buckets" in kwargs and tuple(
+                    sorted(kwargs["buckets"])) != m.buckets:
+                # A silent first-registration-wins here would hand the
+                # caller a histogram with someone else's buckets; make
+                # the conflict loud, mirroring the kind-conflict check.
+                raise ValueError(
+                    f"{name} already registered with buckets "
+                    f"{m.buckets}, re-requested with "
+                    f"{tuple(sorted(kwargs['buckets']))}")
             return m
 
     def counter(self, name: str, help_: str = "") -> Counter:

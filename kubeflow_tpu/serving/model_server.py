@@ -183,7 +183,14 @@ class MicroBatcher:
         self.max_batch_size = min(max_batch_size, self.allowed[-1])
         self.batch_timeout_s = batch_timeout_s
         self._lock = threading.Lock()
-        self._pending: List[dict] = []
+        # Pending entries live in per-shape-signature queues: dispatch is
+        # O(#groups) per cycle (not a rescan of every pending entry), and
+        # each shape group ages against its OWN oldest-entry deadline —
+        # under sustained mixed-shape load a minority shape no longer
+        # waits an extra full batch_timeout_s per cycle while majority
+        # batches reset the clock.
+        self._groups: Dict[Any, List[dict]] = {}
+        self._next_deadline: Optional[float] = None
         self._flusher = threading.Condition(self._lock)
         self._stopped = False
         self._batch_sizes: Dict[int, int] = {}
@@ -202,7 +209,7 @@ class MicroBatcher:
             "kft_serving_batch_size",
             "occupied micro-batch size at dispatch, by batcher",
             buckets=(1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0),
-        )
+        ).declare(batcher=name)
         self._runners = [
             threading.Thread(target=self._run, daemon=True,
                              name=f"microbatcher-{i}")
@@ -213,13 +220,14 @@ class MicroBatcher:
 
     def submit(self, inputs: Dict[str, Any]) -> Dict[str, Any]:
         """One logical request of batch-dim 1 (or [1, ...] rows)."""
-        # Signature computed once, outside the lock: runners re-scan
-        # pending entries every dispatch cycle, and np.asarray on
+        # Signature computed once, outside the lock: np.asarray on
         # list-typed payloads (the REST JSON path) is O(payload).
-        entry = {"inputs": inputs, "sig": self._shape_sig(inputs),
+        entry = {"inputs": inputs,
+                 "t": time.monotonic(),
                  "event": threading.Event(), "out": None, "err": None}
+        sig = self._shape_sig(inputs)
         with self._lock:
-            self._pending.append(entry)
+            self._groups.setdefault(sig, []).append(entry)
             self._flusher.notify()
         entry["event"].wait()
         if entry["err"] is not None:
@@ -255,49 +263,67 @@ class MicroBatcher:
             sig.append((k, a.shape, a.dtype.str))
         return tuple(sig)
 
+    def _take_batch_locked(self) -> Optional[List[dict]]:
+        """Pop the next dispatchable shape group, or None with no group
+        ready yet (caller waits until the earliest group deadline).
+
+        Only rows of one shape signature share a device batch (they are
+        concatenated on axis 0) — without the grouping, one odd-shaped
+        request poisoned the whole batch with a concatenate error.  A
+        group becomes dispatchable when it is full or its OLDEST entry
+        has aged past batch_timeout_s (or at shutdown, immediately);
+        among dispatchable groups the oldest head goes first — full
+        groups get no priority over expired ones, or a saturating
+        majority shape would starve minority shapes forever (their
+        clients block in submit with no timeout).
+        """
+        now = time.monotonic()
+        best_sig, best_t = None, None
+        self._next_deadline = None
+        for sig, q in self._groups.items():
+            deadline = q[0]["t"] + self.batch_timeout_s
+            if (len(q) >= self.max_batch_size or deadline <= now
+                    or self._stopped):
+                if best_t is None or q[0]["t"] < best_t:
+                    best_sig, best_t = sig, q[0]["t"]
+            elif (self._next_deadline is None
+                  or deadline < self._next_deadline):
+                self._next_deadline = deadline
+        if best_sig is None:
+            return None
+        q = self._groups[best_sig]
+        batch, rest = q[:self.max_batch_size], q[self.max_batch_size:]
+        if rest:
+            self._groups[best_sig] = rest
+        else:
+            del self._groups[best_sig]
+        return batch
+
     def _run(self) -> None:
         while True:
             with self._lock:
-                while not self._pending and not self._stopped:
-                    self._flusher.wait()
-                if self._stopped and not self._pending:
-                    return
-                deadline = time.monotonic() + self.batch_timeout_s
-                while (len(self._pending) < self.max_batch_size
-                       and not self._stopped):
-                    remaining = deadline - time.monotonic()
-                    if remaining <= 0:
-                        break
-                    self._flusher.wait(timeout=remaining)
-                # Only rows of one shape signature can share a device
-                # batch (they are concatenated on axis 0): take the
-                # oldest request's shape and collect its matches, leaving
-                # the rest for the next runner.  Without this, one
-                # odd-shaped request poisons the whole batch — every
-                # waiter got the concatenate error.  Shape diversity is
-                # real for LMs (prompt lengths); uniform-length decode
-                # requests batch into one generate program.
-                batch, kept = [], []
-                sig0 = None
-                for e in self._pending:
-                    if sig0 is None:
-                        sig0 = e["sig"]
-                    if e["sig"] == sig0 and \
-                            len(batch) < self.max_batch_size:
-                        batch.append(e)
-                    else:
-                        kept.append(e)
-                self._pending = kept
-                if batch:
-                    # stats() and the scrapeable histogram record the
-                    # same quantity at the same site.
-                    self._batch_sizes[len(batch)] = \
-                        self._batch_sizes.get(len(batch), 0) + 1
-                    self._requests += len(batch)
-                    self._size_hist.observe(
-                        float(len(batch)), batcher=self._metric_name)
-            if batch:
-                self._process(batch)
+                batch = None
+                while batch is None:
+                    if not self._groups:
+                        if self._stopped:
+                            return
+                        self._flusher.wait()
+                        continue
+                    batch = self._take_batch_locked()
+                    if batch is None:
+                        # Sleep only until the earliest group's own
+                        # deadline — each shape ages independently.
+                        self._flusher.wait(
+                            timeout=max(0.0, self._next_deadline
+                                        - time.monotonic()))
+                # stats() and the scrapeable histogram record the
+                # same quantity at the same site.
+                self._batch_sizes[len(batch)] = \
+                    self._batch_sizes.get(len(batch), 0) + 1
+                self._requests += len(batch)
+                self._size_hist.observe(
+                    float(len(batch)), batcher=self._metric_name)
+            self._process(batch)
 
     def _pad_size(self, n: int) -> int:
         for size in self.allowed:
@@ -332,3 +358,79 @@ class MicroBatcher:
             for e in batch:
                 e["err"] = exc
                 e["event"].set()
+
+
+class BucketedLMBatcher:
+    """Mixed-length LM decode batching: pad prompts to bucket lengths.
+
+    The MicroBatcher shares a device batch only among requests of one
+    shape signature — correct (concatenation needs it), but it means
+    mixed-length prompts NEVER coalesce and concurrent clients fall
+    back to batch-1 throughput.  This wrapper collapses the signature
+    space to a handful of buckets: each prompt is LEFT-padded to the
+    smallest bucket >= its length and submitted with its real length
+    (``prompt_len``); models/generate.py masks the pad keys and offsets
+    rope so a padded row decodes exactly as it would alone.  The
+    response strips the pad, so callers see their natural shapes.
+
+    The cost is the padded prefill (bucket/len ratio, bounded by the
+    bucket spacing — powers of two cap it at 2x) on prefill FLOPs only;
+    decode steps, where the time goes, are identical.  One jitted
+    generate program per bucket (compiled on first use, like the
+    allowed_batch_sizes table).
+    """
+
+    def __init__(
+        self,
+        predict: Callable[[Dict[str, Any]], Dict[str, Any]],
+        *,
+        buckets: Optional[List[int]] = None,
+        pad_token: int = 0,
+        **batcher_kwargs,
+    ):
+        self.buckets = sorted(buckets or [32, 64, 128, 256, 512, 1024])
+        self.pad_token = pad_token
+        self._inner = MicroBatcher(predict, **batcher_kwargs)
+
+    def bucket_for(self, length: int) -> int:
+        for b in self.buckets:
+            if length <= b:
+                return b
+        raise ValueError(
+            f"prompt length {length} exceeds largest bucket "
+            f"{self.buckets[-1]}")
+
+    def submit(self, inputs: Dict[str, Any]) -> Dict[str, Any]:
+        """One logical request: tokens [t] or [1, t] (the MicroBatcher
+        hands each entry exactly one result row back, so multi-row
+        submissions would silently lose rows — rejected up front)."""
+        tokens = np.asarray(inputs["tokens"])
+        if tokens.ndim == 1:
+            tokens = tokens[None]
+        n, length = tokens.shape
+        if n != 1:
+            raise ValueError(
+                f"BucketedLMBatcher.submit takes one prompt per call "
+                f"(got batch dim {n}); submit rows separately")
+        bucket = self.bucket_for(length)
+        pad = bucket - length
+        if pad:
+            padded = np.concatenate(
+                [np.full((n, pad), self.pad_token, tokens.dtype), tokens],
+                axis=1)
+        else:
+            padded = tokens
+        out = self._inner.submit({
+            "tokens": padded,
+            "prompt_len": np.full((n,), length, np.int32),
+        })
+        return {
+            k: (v[:, pad:] if k == "tokens" and pad else v)
+            for k, v in out.items()
+        }
+
+    def stats(self) -> Dict[str, Any]:
+        return self._inner.stats()
+
+    def close(self) -> None:
+        self._inner.close()

@@ -41,6 +41,28 @@ class TestRegistry:
         with pytest.raises(ValueError, match="registered"):
             reg.gauge("x")
 
+    def test_bucket_conflict_rejected(self):
+        # Silent first-registration-wins would hand the second caller a
+        # histogram with someone else's buckets.
+        import pytest
+
+        reg = Registry()
+        reg.histogram("h", buckets=(1.0, 2.0))
+        reg.histogram("h", buckets=(2.0, 1.0))  # same set: fine
+        reg.histogram("h")  # default-bucket request reuses existing
+        with pytest.raises(ValueError, match="buckets"):
+            reg.histogram("h", buckets=(1.0, 5.0))
+
+    def test_labeled_zero_state_via_declare(self):
+        reg = Registry()
+        h = reg.histogram("h", buckets=(1.0,)).declare(shard="a")
+        assert 'h_count{shard="a"} 0' in reg.render()
+        h.observe(0.5, shard="b")
+        text = reg.render()
+        # Declared-idle series survives another label observing.
+        assert 'h_count{shard="a"} 0' in text
+        assert 'h_count{shard="b"} 1' in text
+
 
 class TestServeMetrics:
     def test_http_endpoint(self):
@@ -128,15 +150,31 @@ class TestBatcherMetrics:
         # earlier dispatch test already populated it): construction
         # alone must register a scrapeable ZERO-count series — 'no
         # data' on a stuck batcher is indistinguishable from a broken
-        # scrape.
+        # scrape.  The zero series carries the batcher label (declare()
+        # at construction), so it survives other batchers observing —
+        # the unlabeled fallback used to vanish the moment ANY labeled
+        # series appeared.
         import kubeflow_tpu.runtime.prom as prom
         from kubeflow_tpu.serving.model_server import MicroBatcher
 
         fresh = Registry()
         monkeypatch.setattr(prom, "REGISTRY", fresh)
-        mb = MicroBatcher(lambda inputs: inputs, batch_timeout_s=0.01)
+        mb = MicroBatcher(lambda inputs: inputs, batch_timeout_s=0.01,
+                          name="idle")
         try:
             text = fresh.render()
-            assert "kft_serving_batch_size_count 0" in text, text
+            assert 'kft_serving_batch_size_count{batcher="idle"} 0' \
+                in text, text
+            # A second batcher observing must not erase the idle one's
+            # zero series.
+            busy = MicroBatcher(lambda inputs: inputs,
+                                batch_timeout_s=0.01, name="busy")
+            busy.submit({"x": np.zeros((1, 2))})
+            busy.close()
+            text = fresh.render()
+            assert 'kft_serving_batch_size_count{batcher="idle"} 0' \
+                in text, text
+            assert 'kft_serving_batch_size_count{batcher="busy"} 1' \
+                in text, text
         finally:
             mb.close()

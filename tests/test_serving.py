@@ -516,3 +516,57 @@ class TestShapeGroupedBatching:
             np.testing.assert_array_equal(
                 np.asarray(results[i]["tokens"]), direct[i])
         assert stats["mean_batch_size"] > 1, stats
+
+
+class TestDispatchFairness:
+    """_take_batch_locked liveness: a saturating majority shape must not
+    starve an expired minority shape (full groups get no priority over
+    older expired heads)."""
+
+    @staticmethod
+    def _bare(max_batch_size=2, timeout=10.0):
+        # Construct the object without starting runner threads so the
+        # dispatch choice is deterministic and directly observable.
+        mb = object.__new__(MicroBatcher)
+        mb.max_batch_size = max_batch_size
+        mb.batch_timeout_s = timeout
+        mb._groups = {}
+        mb._next_deadline = None
+        mb._stopped = False
+        return mb
+
+    def test_expired_minority_beats_full_majority(self):
+        import time as _t
+
+        mb = self._bare(max_batch_size=2, timeout=0.01)
+        now = _t.monotonic()
+        # Majority shape A: full group, fresh heads (sustained load).
+        mb._groups["A"] = [{"t": now, "id": i} for i in range(2)]
+        # Minority shape B: one entry, long expired.
+        mb._groups["B"] = [{"t": now - 1.0, "id": "b"}]
+        batch = mb._take_batch_locked()
+        assert [e["id"] for e in batch] == ["b"], batch
+
+    def test_full_group_dispatches_before_its_own_timeout(self):
+        import time as _t
+
+        mb = self._bare(max_batch_size=2, timeout=10.0)
+        now = _t.monotonic()
+        mb._groups["A"] = [{"t": now, "id": 0}, {"t": now, "id": 1}]
+        mb._groups["B"] = [{"t": now, "id": "b"}]  # neither full nor old
+        batch = mb._take_batch_locked()
+        assert [e["id"] for e in batch] == [0, 1]
+        # B stays queued with its own deadline registered.
+        assert "B" in mb._groups and mb._next_deadline is not None
+
+    def test_nothing_ready_registers_earliest_deadline(self):
+        import time as _t
+
+        mb = self._bare(max_batch_size=4, timeout=10.0)
+        now = _t.monotonic()
+        mb._groups["A"] = [{"t": now, "id": 0}]
+        mb._groups["B"] = [{"t": now - 5.0, "id": "b"}]  # older, not expired
+        batch = mb._take_batch_locked()
+        assert batch is None
+        # Earliest deadline is B's (older head).
+        assert abs(mb._next_deadline - (now - 5.0 + 10.0)) < 0.5
