@@ -68,12 +68,15 @@ class DecodeConfig:
 
 
 def _layer_step(cfg: TransformerConfig, layer_params, x, cache_kv,
-                cache_len, positions):
+                cache_len, positions, pad_amount=None):
     """One decoder block against the KV cache.
 
     x: [b, t, e] new activations (t = prompt len at prefill, 1 at decode);
     cache_kv: (k, v) each [b, max_len, hkv, d];
-    cache_len: number of valid cache positions before this call.
+    cache_len: number of valid cache positions before this call;
+    pad_amount: per-row [b] left-pad width (bucketed mixed-length
+    prompts) — cache columns before it hold pad-token garbage and are
+    masked out of every attention.
     Mirrors models/transformer.py Block but with explicit cache state.
     """
     from kubeflow_tpu.models.transformer import MLP, RMSNorm
@@ -121,6 +124,7 @@ def _layer_step(cfg: TransformerConfig, layer_params, x, cache_kv,
     # zeros at positions >= cache_len+t, masked via kv_offset arithmetic).
     out = dot_product_attention(
         q, ck, cv, causal=True, kv_offset=cache_len,
+        kv_valid_start=pad_amount,
     )
     y = qeinsum("bshd,hde->bse", out, attn["wo"], dt)
     x = x + y
@@ -134,7 +138,7 @@ def _layer_step(cfg: TransformerConfig, layer_params, x, cache_kv,
 
 
 def _forward_with_cache(cfg: TransformerConfig, params, tokens, cache,
-                        cache_len):
+                        cache_len, pad_amount=None):
     """tokens [b, t] -> (logits [b, t, v], new cache)."""
     from flax import linen as nn
 
@@ -144,6 +148,11 @@ def _forward_with_cache(cfg: TransformerConfig, params, tokens, cache,
     x = embed_lookup(embed, tokens, dt)  # int8-aware row gather
     positions = cache_len + jnp.arange(tokens.shape[1])[None, :]
     positions = jnp.broadcast_to(positions, tokens.shape)
+    if pad_amount is not None:
+        # Left-padded rows: real token i of a row sits at buffer column
+        # pad + i but must see rope position i.  Pad columns clamp to 0
+        # — their keys are masked from every attention anyway.
+        positions = jnp.maximum(positions - pad_amount[:, None], 0)
 
     layer_stack = params["layers"]
 
@@ -158,6 +167,7 @@ def _forward_with_cache(cfg: TransformerConfig, params, tokens, cache,
         layer_params, ck, cv = inputs
         x, (ck, cv) = _layer_step(
             cfg, layer_params, x, (ck, cv), cache_len, positions,
+            pad_amount=pad_amount,
         )
         return x, (ck, cv)
 
@@ -201,19 +211,30 @@ def generate(
     prompt: jax.Array,
     decode: DecodeConfig = DecodeConfig(),
     rng: Optional[jax.Array] = None,
+    prompt_len: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """prompt [b, t] -> (tokens [b, t+max_new], logits_last [b, vocab]).
 
     One jitted program: prefill the prompt, then scan max_new_tokens
     single-token steps against the cache.
+
+    prompt_len ([b] int32, optional): per-row real prompt lengths for
+    LEFT-padded prompts — rows shorter than t carry (t - len) pad
+    tokens on the left.  Pad keys are masked out of every attention
+    and rope positions count from the first real token, so a padded
+    row decodes exactly as it would alone at its natural length.
+    This is what lets mixed-length requests share one bucketed batch
+    (serving/model_server.py BucketedLMBatcher).
     """
     b, t = prompt.shape
     max_len = t + decode.max_new_tokens
     cache = init_cache(cfg, b, max_len, decode.kv_cache_dtype)
     if rng is None:
         rng = jax.random.key(0)
+    pad_amount = None if prompt_len is None else t - prompt_len
 
-    logits, cache = _forward_with_cache(cfg, params, prompt, cache, 0)
+    logits, cache = _forward_with_cache(cfg, params, prompt, cache, 0,
+                                        pad_amount=pad_amount)
     last = logits[:, -1]
 
     def sample(logits, key):
@@ -248,7 +269,8 @@ def generate(
         nxt = sample(last_logits, sub)
         nxt = jnp.where(done, jnp.zeros_like(nxt), nxt)
         logits, cache = _forward_with_cache(
-            cfg, params, nxt[:, None], cache, cache_len)
+            cfg, params, nxt[:, None], cache, cache_len,
+            pad_amount=pad_amount)
         done = done | (nxt == decode.eos_token)
         return (cache, logits[:, -1], cache_len + 1, key, done), nxt
 

@@ -43,11 +43,15 @@ def dot_product_attention(
     causal: bool = True,
     segment_ids: Optional[jax.Array] = None,
     kv_offset: int | jax.Array = 0,
+    kv_valid_start: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Reference XLA attention. [b, sq, h, d] x [b, sk, hkv, d] -> [b, sq, h, d].
 
     kv_offset: absolute position of k[0] relative to q[0]'s frame — used by
     ring attention (rotating kv blocks) and decode (single-query vs cache).
+    kv_valid_start: per-row [b] first valid key position — keys before it
+    are masked for every query (left-padded prompts in bucketed decode:
+    pad rows carry garbage keys that must never receive weight).
     Softmax accumulates in fp32 regardless of input dtype (bf16-safe).
 
     k/v may be int8 ``QTensor``s with per-(position, head) scales (the
@@ -84,6 +88,7 @@ def dot_product_attention(
     mask = _build_mask(
         q_len=q.shape[1], k_len=k.shape[1], causal=causal,
         segment_ids=segment_ids, kv_offset=kv_offset,
+        kv_valid_start=kv_valid_start,
     )
     if mask is not None:
         scores = jnp.where(mask, scores, jnp.finfo(jnp.float32).min)
@@ -104,6 +109,7 @@ def _build_mask(
     causal: bool,
     segment_ids: Optional[jax.Array],
     kv_offset: int | jax.Array,
+    kv_valid_start: Optional[jax.Array] = None,
 ) -> Optional[jax.Array]:
     """Boolean keep-mask broadcastable to [b, h, q, k]."""
     mask = None
@@ -111,6 +117,10 @@ def _build_mask(
         q_pos = jnp.arange(q_len)[:, None] + kv_offset
         k_pos = jnp.arange(k_len)[None, :]
         mask = (q_pos >= k_pos)[None, None, :, :]
+    if kv_valid_start is not None:
+        valid = (jnp.arange(k_len)[None, :]
+                 >= kv_valid_start[:, None])[:, None, None, :]
+        mask = valid if mask is None else mask & valid
     if segment_ids is not None:
         seg = segment_ids[:, None, :, None] == segment_ids[:, None, None, :]
         mask = seg if mask is None else mask & seg

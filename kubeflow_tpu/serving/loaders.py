@@ -165,7 +165,15 @@ def lm_generate(config: Dict[str, Any]) -> Callable:
 
         def predict(inputs: Dict[str, Any]) -> Dict[str, Any]:
             tokens = jnp.asarray(inputs["tokens"], jnp.int32)
-            out, _ = generate(cfg, params, tokens, decode)
+            plen = inputs.get("prompt_len")
+            if plen is not None:
+                # Left-padded bucketed batch (BucketedLMBatcher): rows
+                # decode at their real lengths; pad keys are masked.
+                plen = jnp.asarray(plen, jnp.int32).reshape(-1)
+                out, _ = generate(cfg, params, tokens, decode,
+                                  prompt_len=plen)
+            else:
+                out, _ = generate(cfg, params, tokens, decode)
             return {"tokens": out}
 
         return predict
