@@ -1,0 +1,9 @@
+# Default notebook config seeded into a fresh PVC home by
+# kubeflow_tpu/tools/notebook_entry.py (heir of the reference's
+# jupyter_notebook_config.py shipped in
+# components/tensorflow-notebook-image/).
+c = get_config()  # noqa: F821
+c.ServerApp.open_browser = False
+c.ServerApp.allow_origin = "*"
+# Notebooks live under the PVC-backed work dir so they survive restarts.
+c.ServerApp.root_dir = "work"

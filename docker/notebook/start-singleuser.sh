@@ -1,11 +1,7 @@
 #!/bin/bash
-# JupyterHub single-user entry — heir of the reference's
-# start-singleuser.sh (components/tensorflow-notebook-image/): ensure the
-# PVC-mounted home is usable, then exec the hub-managed server.
+# JupyterHub single-user entry — thin exec wrapper; the PVC-home seeding
+# and arg assembly live in kubeflow_tpu/tools/notebook_entry.py (heir of
+# the reference's pvc-check.sh + start-singleuser.sh + start.sh trio,
+# components/tensorflow-notebook-image/), where they are unit-tested.
 set -e
-
-if [ ! -w "$HOME" ]; then
-  echo "warning: $HOME not writable (PVC mount problem?)" >&2
-fi
-
-exec jupyterhub-singleuser --ip=0.0.0.0 "$@"
+exec python -m kubeflow_tpu.tools.notebook_entry "$@"

@@ -1,0 +1,104 @@
+"""JupyterHub single-user notebook entrypoint: PVC home init + launch.
+
+Heir of the reference notebook image's boot trio — ``pvc-check.sh``
+(seed an empty PVC-backed $HOME), ``start-singleuser.sh`` (legacy env →
+CLI args, default bind ip), ``start.sh`` (exec the hub-managed server)
+at /root/reference/components/tensorflow-notebook-image/ — redesigned as
+one testable Python module: the shell scripts' logic lives here, and the
+image's ENTRYPOINT is a two-line exec wrapper.
+
+Behavioral contract kept from the reference:
+  - a freshly-provisioned PVC mounted at $HOME (empty, or containing
+    only ``lost+found``) is seeded with ``work/`` and ``.jupyter/`` plus
+    the image's default notebook config; a HOME with any user content is
+    left untouched (the per-user ``claim-{username}`` PVC survives pod
+    restarts — kubeflow/core/kubeform_spawner.py:114-133);
+  - the server binds 0.0.0.0 unless the caller overrides --ip;
+  - ``NOTEBOOK_DIR`` maps to --notebook-dir (modern JupyterHub passes
+    everything else via JUPYTERHUB_* env vars that jupyterhub-singleuser
+    reads natively, so the JPY_* flag surgery is retired).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence
+
+# Written into the image next to this module's seed data.
+DEFAULT_SEED_CONFIG = "/etc/kubeflow-tpu/jupyter_notebook_config.py"
+
+
+def home_needs_init(home: os.PathLike) -> bool:
+    """True when $HOME is a virgin volume: empty, or only the ext4
+    ``lost+found`` directory a fresh PV carries."""
+    entries = [e for e in os.listdir(home) if e != "lost+found"]
+    return not entries
+
+
+def init_home(home: os.PathLike,
+              seed_config: Optional[str] = None) -> List[str]:
+    """Seed a fresh PVC home; no-op (returns []) if it has content.
+
+    Returns the list of paths created, newest-user-visible first — the
+    entry logs it so a support question ("where did my files go?") has
+    an answer in the pod log.
+    """
+    home = Path(home)
+    if not home_needs_init(home):
+        return []
+    created = []
+    work = home / "work"
+    conf_dir = home / ".jupyter"
+    work.mkdir(exist_ok=True)
+    created.append(str(work))
+    conf_dir.mkdir(exist_ok=True)
+    created.append(str(conf_dir))
+    seed = seed_config or DEFAULT_SEED_CONFIG
+    if os.path.exists(seed):
+        dst = conf_dir / os.path.basename(seed)
+        shutil.copy(seed, dst)
+        created.append(str(dst))
+    return created
+
+
+def build_args(environ: Optional[Dict[str, str]] = None,
+               extra: Sequence[str] = ()) -> List[str]:
+    """argv for jupyterhub-singleuser (argv[0] included)."""
+    env = os.environ if environ is None else environ
+    args = ["jupyterhub-singleuser"]
+    joined = " ".join(extra)
+    if "--ip=" not in joined and "--ip " not in joined:
+        args.append("--ip=0.0.0.0")
+    notebook_dir = env.get("NOTEBOOK_DIR")
+    if notebook_dir:
+        args.append(f"--notebook-dir={notebook_dir}")
+    args.extend(extra)
+    return args
+
+
+def main(argv: Optional[Sequence[str]] = None) -> None:
+    import sys
+
+    extra = list(sys.argv[1:] if argv is None else argv)
+    home = os.environ.get("HOME", os.path.expanduser("~"))
+    try:
+        created = init_home(home)
+    except OSError as e:
+        # A broken PVC mount (missing dir, read-only claim) must not
+        # crashloop the pod — the reference's pvc-check degraded to a
+        # warning and still started the server; keep that contract.
+        print(f"warning: could not seed home {home}: {e}", flush=True)
+    else:
+        if created:
+            print(f"seeded fresh PVC home {home}: {created}", flush=True)
+        else:
+            print(f"home {home} already initialized; leaving as-is",
+                  flush=True)
+    args = build_args(extra=extra)
+    os.execvp(args[0], args)
+
+
+if __name__ == "__main__":
+    main()

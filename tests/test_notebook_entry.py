@@ -1,0 +1,71 @@
+"""Notebook single-user entry: PVC-home seeding + arg assembly.
+
+The reference's pvc-check.sh / start-singleuser.sh logic
+(/root/reference/components/tensorflow-notebook-image/) re-provided as a
+testable module — these tests pin the behavioral contract the shell
+scripts enforced in-image only.
+"""
+
+from kubeflow_tpu.tools.notebook_entry import (
+    build_args,
+    home_needs_init,
+    init_home,
+)
+
+
+class TestHomeInit:
+    def test_empty_home_needs_init(self, tmp_path):
+        assert home_needs_init(tmp_path)
+
+    def test_lost_and_found_only_still_fresh(self, tmp_path):
+        # A newly-provisioned ext4 PV carries lost+found; that alone
+        # must not count as user content (the reference's
+        # `ls --ignore=lost+found` check).
+        (tmp_path / "lost+found").mkdir()
+        assert home_needs_init(tmp_path)
+
+    def test_user_content_blocks_init(self, tmp_path):
+        (tmp_path / "thesis.ipynb").write_text("{}")
+        assert not home_needs_init(tmp_path)
+
+    def test_init_seeds_work_and_config(self, tmp_path):
+        seed = tmp_path / "seed_config.py"
+        seed.write_text("c = get_config()\n")
+        home = tmp_path / "home"
+        home.mkdir()
+        created = init_home(home, seed_config=str(seed))
+        assert (home / "work").is_dir()
+        assert (home / ".jupyter" / "seed_config.py").read_text() \
+            == "c = get_config()\n"
+        assert str(home / "work") in created
+
+    def test_init_is_noop_on_populated_home(self, tmp_path):
+        (tmp_path / "notes.txt").write_text("mine")
+        assert init_home(tmp_path) == []
+        # Nothing else appeared.
+        assert sorted(p.name for p in tmp_path.iterdir()) == ["notes.txt"]
+
+    def test_init_without_seed_config_still_creates_dirs(self, tmp_path):
+        created = init_home(tmp_path, seed_config=str(tmp_path / "nope.py"))
+        assert (tmp_path / "work").is_dir()
+        assert (tmp_path / ".jupyter").is_dir()
+        assert len(created) == 2
+
+
+class TestArgs:
+    def test_default_binds_all_interfaces(self):
+        assert "--ip=0.0.0.0" in build_args(environ={})
+
+    def test_caller_ip_wins(self):
+        args = build_args(environ={}, extra=["--ip=127.0.0.1"])
+        assert args.count("--ip=127.0.0.1") == 1
+        assert "--ip=0.0.0.0" not in args
+
+    def test_notebook_dir_env_mapped(self):
+        args = build_args(environ={"NOTEBOOK_DIR": "/home/jovyan/work"})
+        assert "--notebook-dir=/home/jovyan/work" in args
+
+    def test_extra_args_pass_through_after_defaults(self):
+        args = build_args(environ={}, extra=["--debug"])
+        assert args[0] == "jupyterhub-singleuser"
+        assert args[-1] == "--debug"
