@@ -52,7 +52,12 @@ class PredictionServicer:
                 context: grpc.ServicerContext) -> pb.PredictResponse:
         model = self._resolve(request.model_spec)
         inputs = {k: tensor_to_numpy(t) for k, t in request.inputs.items()}
-        outputs = model.predict(inputs)
+        # Through ModelServer.predict (not model.predict) so request
+        # batching (enable_batching) applies to gRPC traffic exactly as
+        # it does to REST.
+        version = request.model_spec.version \
+            if request.model_spec.version > 0 else None
+        outputs = self.server.predict(model.name, inputs, version)
         resp = pb.PredictResponse()
         resp.model_spec.name = model.name
         resp.model_spec.version = model.version
@@ -64,8 +69,11 @@ class PredictionServicer:
                  context: grpc.ServicerContext) -> pb.ClassifyResponse:
         model = self._resolve(request.model_spec)
         inputs = {k: tensor_to_numpy(t) for k, t in request.inputs.items()}
-        outputs = {k: np.asarray(v)
-                   for k, v in model.predict(inputs).items()}
+        version = request.model_spec.version \
+            if request.model_spec.version > 0 else None
+        outputs = {k: np.asarray(v) for k, v in
+                   self.server.predict(model.name, inputs,
+                                       version).items()}
         resp = pb.ClassifyResponse()
         resp.model_spec.name = model.name
         resp.model_spec.version = model.version

@@ -22,6 +22,42 @@ from kubeflow_tpu.serving.http import make_http_server
 from kubeflow_tpu.serving.model_server import ModelServer
 
 
+def batcher_factory(*, micro_batch_size: int, batch_timeout_s: float,
+                    lm_buckets: str = ""):
+    """ModelServer.enable_batching factory: picks the batcher per model.
+
+    lm_generate models with buckets get the left-padding
+    BucketedLMBatcher (mixed-length prompts share decode programs);
+    everything else gets the shape-grouped MicroBatcher.  Rebuilt around
+    every hot-swapped version by ModelServer.
+    """
+    from kubeflow_tpu.serving.model_server import (
+        BucketedLMBatcher,
+        MicroBatcher,
+    )
+
+    sizes = [s for s in (1, 2, 4, 8, 16, 32, 64, 128)
+             if s <= micro_batch_size]
+    if not sizes or sizes[-1] != micro_batch_size:
+        sizes.append(micro_batch_size)
+    buckets = [int(b) for b in lm_buckets.split(",") if b.strip()]
+
+    def build(model):
+        kwargs = dict(
+            max_batch_size=micro_batch_size,
+            batch_timeout_s=batch_timeout_s,
+            allowed_batch_sizes=sizes,
+            name=f"{model.name}-v{model.version}",
+        )
+        loader = str(model.meta.get("loader", ""))
+        if buckets and loader.endswith("lm_generate"):
+            return BucketedLMBatcher(model.predict, buckets=buckets,
+                                     **kwargs)
+        return MicroBatcher(model.predict, **kwargs)
+
+    return build
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="kubeflow-tpu-serve")
     ap.add_argument("--model_name", required=True)
@@ -34,11 +70,36 @@ def main(argv=None) -> int:
     ap.add_argument("--poll_interval_s", type=float, default=2.0,
                     help="model version poll period (hot-swap latency)")
     ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--micro_batch_size", type=int, default=0,
+                    help="coalesce concurrent single-row requests into "
+                         "device batches up to this size (0 = off) — "
+                         "the TF-Serving batching-parameters idea, "
+                         "TPU-shaped; survives hot-swap")
+    ap.add_argument("--batch_timeout_ms", type=float, default=5.0,
+                    help="micro-batch assembly window per shape group")
+    ap.add_argument("--lm_buckets", default="",
+                    help="comma-separated prompt-length buckets; with "
+                         "--micro_batch_size on an lm_generate model, "
+                         "mixed-length prompts left-pad to these so "
+                         "they share batched decode programs")
     args = ap.parse_args(argv)
 
     logging.basicConfig(level=logging.INFO, stream=sys.stderr)
     server = ModelServer(poll_interval_s=args.poll_interval_s)
     server.add_model(args.model_name, args.model_base_path)
+    if args.micro_batch_size > 0:
+        server.enable_batching(
+            args.model_name,
+            batcher_factory(
+                micro_batch_size=args.micro_batch_size,
+                batch_timeout_s=args.batch_timeout_ms / 1e3,
+                lm_buckets=args.lm_buckets,
+            ),
+        )
+        logging.info("request batching on: size<=%d, window %.1f ms%s",
+                     args.micro_batch_size, args.batch_timeout_ms,
+                     f", lm buckets {args.lm_buckets}"
+                     if args.lm_buckets else "")
     server.start_watcher()
     httpd, _ = make_http_server(server, port=args.port, host=args.host)
     grpc_server = None

@@ -27,6 +27,11 @@ from kubeflow_tpu.serving.export import list_versions, load_version
 log = logging.getLogger(__name__)
 
 
+class BatcherClosed(RuntimeError):
+    """Raised by submit() on a closed batcher — callers holding a stale
+    reference (hot-swap races) retry against the replacement."""
+
+
 # One name/help for the request counter shared by the REST and gRPC
 # faces — divergent literals would silently create a second series.
 REQUESTS_TOTAL = "kft_serving_requests_total"
@@ -53,6 +58,11 @@ class ModelServer:
         self._poll_interval_s = poll_interval_s
         self._watcher: Optional[threading.Thread] = None
         self._stop = threading.Event()
+        # Per-model request batching (enable_batching): factory builds a
+        # batcher around each newly-loaded version's predict, so
+        # hot-swap keeps batching without a restart.
+        self._batcher_factories: Dict[str, Callable] = {}
+        self._batchers: Dict[str, Any] = {}
 
     # -- loading ----------------------------------------------------------
 
@@ -77,12 +87,21 @@ class ModelServer:
                 return False
         predict, meta = load_version(base, latest)
         with self._lock:
-            self._models[name][latest] = LoadedModel(
+            model = LoadedModel(
                 name=name, version=latest, predict=predict, meta=meta
             )
+            self._models[name][latest] = model
             # Keep only the latest (TF-Serving default version policy).
             for v in [v for v in self._models[name] if v != latest]:
                 del self._models[name][v]
+            old_batcher = self._batchers.pop(name, None)
+            factory = self._batcher_factories.get(name)
+            if factory is not None:
+                self._batchers[name] = factory(model)
+        if old_batcher is not None:
+            # Outside the lock: close blocks on in-flight requests, which
+            # themselves may be waiting on get()/predict().
+            old_batcher.close()
         log.info("model %r now serving version %d", name, latest)
         return True
 
@@ -104,11 +123,36 @@ class ModelServer:
                                          name="version-watcher")
         self._watcher.start()
 
+    def enable_batching(
+        self, name: str,
+        factory: Callable[[LoadedModel], Any],
+    ) -> None:
+        """Coalesce concurrent predict() calls for ``name`` through a
+        batcher built by ``factory(loaded_model)`` (anything with
+        submit/close — MicroBatcher or BucketedLMBatcher).  The batcher
+        is rebuilt around every newly-loaded version, so hot-swap keeps
+        batching; explicit-version requests bypass it (debugging a
+        pinned version should not share the live batch path).
+        """
+        with self._lock:
+            self._batcher_factories[name] = factory
+            model = None
+            versions = self._models.get(name)
+            if versions:
+                model = versions[max(versions)]
+            if model is not None:
+                self._batchers[name] = factory(model)
+
     def stop(self) -> None:
         self._stop.set()
         if self._watcher is not None:
             self._watcher.join(timeout=5)
             self._watcher = None
+        with self._lock:
+            batchers = list(self._batchers.values())
+            self._batchers.clear()
+        for b in batchers:
+            b.close()
 
     # -- queries ----------------------------------------------------------
 
@@ -134,10 +178,48 @@ class ModelServer:
         with self._lock:
             return name in self._models
 
+    @staticmethod
+    def _single_row(inputs: Dict[str, Any]) -> bool:
+        """True when every input leaf carries exactly one example — the
+        only shape a batcher entry can represent (each entry gets one
+        result row back; multi-row requests go straight to predict)."""
+        for v in inputs.values():
+            shape = getattr(v, "shape", None)
+            if shape is None:
+                v = np.asarray(v)
+                shape = v.shape
+            if len(shape) == 0 or shape[0] != 1:
+                return False
+        return True
+
     def predict(
         self, name: str, inputs: Dict[str, Any],
         version: Optional[int] = None,
     ) -> Dict[str, Any]:
+        if version is None:
+            # Convert list-typed payloads (raw REST JSON) to arrays ONCE
+            # before the batched path touches them — _single_row,
+            # _shape_sig, and the dispatch concatenate all consume the
+            # same arrays instead of re-materializing the payload.
+            converted = {
+                k: v if hasattr(v, "shape") else np.asarray(v)
+                for k, v in inputs.items()
+            }
+            # Bounded retry: a hot-swap can close the batcher between
+            # the lookup and submit (BatcherClosed); the second lap
+            # picks up the replacement built by reload().
+            for _ in range(2):
+                with self._lock:
+                    batcher = self._batchers.get(name)
+                if batcher is None or not self._single_row(converted):
+                    break
+                accepts = getattr(batcher, "accepts", None)
+                if accepts is not None and not accepts(converted):
+                    break  # e.g. prompt beyond the largest bucket
+                try:
+                    return batcher.submit(converted)
+                except BatcherClosed:
+                    continue
         model = self.get(name, version)
         return model.predict(inputs)
 
@@ -227,6 +309,11 @@ class MicroBatcher:
                  "event": threading.Event(), "out": None, "err": None}
         sig = self._shape_sig(inputs)
         with self._lock:
+            if self._stopped:
+                # After close() the runner threads are gone; an entry
+                # appended now would wait forever on its Event.
+                raise BatcherClosed(f"batcher {self._metric_name!r} "
+                                    "is closed")
             self._groups.setdefault(sig, []).append(entry)
             self._flusher.notify()
         entry["event"].wait()
@@ -399,6 +486,14 @@ class BucketedLMBatcher:
         raise ValueError(
             f"prompt length {length} exceeds largest bucket "
             f"{self.buckets[-1]}")
+
+    def accepts(self, inputs: Dict[str, Any]) -> bool:
+        """ModelServer routing hook: prompts beyond the largest bucket
+        fall back to the direct predict path (they served fine before
+        batching was enabled; enabling it must not break them)."""
+        tokens = np.asarray(inputs.get("tokens", ()))
+        length = tokens.shape[-1] if tokens.ndim else 0
+        return bool(length and length <= self.buckets[-1])
 
     def submit(self, inputs: Dict[str, Any]) -> Dict[str, Any]:
         """One logical request: tokens [t] or [1, t] (the MicroBatcher
