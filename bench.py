@@ -32,7 +32,8 @@ import time
 
 
 def acquire_devices(get_devices, attempts=5, delays=(5, 10, 20, 40, 80),
-                    sleep=time.sleep, reset=None, log=None):
+                    sleep=time.sleep, reset=None, log=None,
+                    attempt_timeout_s=150.0):
     """Bounded retry around backend acquisition.
 
     The round-3 driver capture died with ``rc=1`` at the bare
@@ -44,17 +45,49 @@ def acquire_devices(get_devices, attempts=5, delays=(5, 10, 20, 40, 80),
     instead of letting the traceback escape — stdout still carries
     exactly one parseable JSON line either way.
 
+    Each attempt also runs under a watchdog (``attempt_timeout_s``):
+    a wedged chip grant makes ``jax.devices()`` HANG rather than raise
+    (observed when a prior client was killed mid-claim), and a capture
+    that blocks forever is strictly worse than one that reports
+    failure.  The attempt runs in a daemon thread; on timeout the
+    attempt is treated as failed (the stuck thread is abandoned — it
+    holds no locks the retry path needs).
+
     Returns ``(devices, None)`` on success or ``(None, record)`` where
     ``record`` is the JSON-able failure object to print.  ``reset`` is
     called between attempts to drop any cached failed backend (JAX
     caches backend init, so a retry without a reset would just replay
     the cached error).
     """
+    import threading
+
     log = log or (lambda msg: print(msg, file=sys.stderr))
+
+    def attempt_once():
+        box = {}
+
+        def run():
+            try:
+                box["value"] = get_devices()
+            except BaseException as e:  # noqa: BLE001 — re-raised below
+                box["error"] = e
+
+        th = threading.Thread(target=run, daemon=True,
+                              name="backend-acquire")
+        th.start()
+        th.join(attempt_timeout_s)
+        if th.is_alive():
+            raise RuntimeError(
+                f"backend acquisition hung > {attempt_timeout_s:.0f}s "
+                "(wedged device grant?)")
+        if "error" in box:
+            raise box["error"]
+        return box["value"]
+
     errors = []
     for attempt in range(attempts):
         try:
-            return get_devices(), None
+            return attempt_once(), None
         except RuntimeError as e:  # jax.errors.JaxRuntimeError included
             errors.append(f"attempt {attempt + 1}: {type(e).__name__}: {e}")
             log(f"backend acquisition failed ({errors[-1]})")

@@ -81,3 +81,40 @@ def test_delays_are_bounded():
     # delay repeats if attempts exceed the table.
     total = sum(bench.acquire_devices.__defaults__[1])
     assert total <= 180
+
+
+def test_hung_acquisition_times_out_to_structured_record():
+    """A wedged device grant makes jax.devices() HANG, not raise
+    (observed live: a client killed mid-claim wedges the chip and every
+    later acquisition blocks forever).  The watchdog must convert the
+    hang into a normal failed attempt."""
+    import threading
+
+    never = threading.Event()
+
+    def hang_forever():
+        never.wait()  # blocks until test teardown; daemon thread
+
+    devices, failure = bench.acquire_devices(
+        hang_forever, attempts=2, delays=(0,), sleep=lambda s: None,
+        log=lambda m: None, attempt_timeout_s=0.1)
+    assert devices is None
+    assert failure["metric"] == "backend_init_failed"
+    assert "hung" in failure["detail"]["log"][0]
+    never.set()
+
+
+def test_watchdog_passes_through_success_and_errors():
+    devices, failure = bench.acquire_devices(
+        lambda: ["dev"], attempts=1, log=lambda m: None,
+        attempt_timeout_s=5.0)
+    assert failure is None and devices == ["dev"]
+
+    def boom():
+        raise RuntimeError("UNAVAILABLE")
+
+    devices, failure = bench.acquire_devices(
+        boom, attempts=2, delays=(0,), sleep=lambda s: None,
+        log=lambda m: None, attempt_timeout_s=5.0)
+    assert devices is None
+    assert len(failure["detail"]["log"]) == 2
