@@ -1,0 +1,55 @@
+#!/bin/bash
+# Round-4 on-chip sweep, gated on chip availability: the tunneled chip's
+# grant wedged mid-round (see BASELINE.md "measurement debt"); this
+# probes every 10 min and runs the queued sweeps the moment it clears.
+cd /root/repo
+LOG=/root/repo/artifacts/r4_onchip_sweeps.log
+: > "$LOG"
+echo "waiter started $(date +%H:%M:%S)" >> "$LOG"
+for i in $(seq 1 50); do
+  if timeout 120 python -c "
+import bench
+def get():
+    import jax
+    return jax.devices()
+devs, fail = bench.acquire_devices(get, attempts=1, attempt_timeout_s=90,
+                                   log=lambda m: None)
+raise SystemExit(0 if devs else 1)
+" 2>/dev/null; then
+    echo "chip OK at $(date +%H:%M:%S); starting sweeps" >> "$LOG"
+    break
+  fi
+  echo "probe $i: wedged $(date +%H:%M:%S)" >> "$LOG"
+  sleep 600
+done
+
+run() {
+  desc="$1"; shift
+  echo "=== $desc $(date +%H:%M:%S)" >> "$LOG"
+  timeout 900 python bench.py "$@" 2>>/tmp/sweep_stderr.log \
+    | python -c "
+import json, sys
+try:
+    d = json.load(sys.stdin)
+except Exception as e:
+    print('PARSE-FAIL', e)
+else:
+    det = d.get('detail', {})
+    print('RESULT', '$desc', d['value'], d['unit'],
+          'step_ms', det.get('step_time_ms'), 'mfu', det.get('mfu'),
+          'mixed_req_s', det.get('batcher_mixed_requests_per_sec'),
+          'mixed_mb', det.get('batcher_mixed_mean_batch_size'),
+          'uniform_req_s', det.get('batcher_requests_per_sec'))
+" >> "$LOG"
+}
+
+run ce-f32       --model=lm --steps 60 --ce-dtype f32
+run ce-compute   --model=lm --steps 60 --ce-dtype compute
+run ce-f32-b     --model=lm --steps 60 --ce-dtype f32
+run ce-compute-b --model=lm --steps 60 --ce-dtype compute
+run moe-gather   --model=lm --steps 60 --moe-experts 4 --moe-impl gather
+run moe-einsum   --model=lm --steps 60 --moe-experts 4 --moe-impl einsum
+run moe-gather-b --model=lm --steps 60 --moe-experts 4 --moe-impl gather
+run moe-einsum-b --model=lm --steps 60 --moe-experts 4 --moe-impl einsum
+run lm-decode    --model=lm-decode
+echo "SWEEP_DONE $(date +%H:%M:%S)" >> "$LOG"
