@@ -13,9 +13,10 @@ slices:
 * Instead of per-replica `nvidia.com/gpu` counts, a TPUJob names a slice
   topology; replica count is *derived* (one pod per slice host) — partial
   gangs are meaningless on a slice.
-* The mesh axes {data, fsdp, model, sequence, expert} are part of the job
-  spec, so the operator can validate axis sizes against the slice shape
-  before admission instead of discovering mismatches at runtime.
+* The mesh axes {data, fsdp, pipeline, model, sequence, expert} are part
+  of the job spec, so the operator can validate axis sizes against the
+  slice shape before admission instead of discovering mismatches at
+  runtime.
 """
 
 from __future__ import annotations
@@ -90,11 +91,12 @@ class MeshSpec:
 
     data: int = -1
     fsdp: int = 1
+    pipeline: int = 1
     model: int = 1
     sequence: int = 1
     expert: int = 1
 
-    AXES = ("data", "fsdp", "model", "sequence", "expert")
+    AXES = ("data", "fsdp", "pipeline", "model", "sequence", "expert")
 
     def sizes(self) -> Dict[str, int]:
         return {axis: getattr(self, axis) for axis in self.AXES}
@@ -127,8 +129,25 @@ class MeshSpec:
     def to_dict(self) -> Dict[str, int]:
         return self.sizes()
 
+    def runtime_axes(self) -> Dict[str, int]:
+        """This spec in the runtime vocabulary of parallel/mesh.py
+        (which calls the tensor-parallel axis 'tensor', not 'model') —
+        the bridge for anything translating an admitted spec.mesh into
+        worker flags or a parallel.MeshSpec."""
+        sizes = self.sizes()
+        sizes["tensor"] = sizes.pop("model")
+        return sizes
+
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "MeshSpec":
+        d = dict(d)
+        if "tensor" in d:
+            # Runtime spelling (parallel/mesh.py) accepted as an alias
+            # so specs can be written in either vocabulary.
+            if "model" in d:
+                raise SpecError(
+                    "mesh declares both 'model' and its alias 'tensor'")
+            d["model"] = d.pop("tensor")
         unknown = set(d) - set(cls.AXES)
         if unknown:
             raise SpecError(f"unknown mesh axes {sorted(unknown)}")

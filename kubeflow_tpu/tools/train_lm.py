@@ -23,6 +23,10 @@ def main(argv=None) -> int:
     ap.add_argument("--vocab-size", type=int, default=32_000)
     ap.add_argument("--seq-len", type=int, default=1024)
     ap.add_argument("--moe-experts", type=int, default=0)
+    ap.add_argument("--pipeline-microbatches", type=int, default=0,
+                    help="GPipe microbatches; takes effect when --mesh "
+                         "includes pipeline=N>1 (the layer stack then "
+                         "runs N_layers/N per stage)")
     ap.add_argument("--attention", default="dot",
                     choices=["dot", "flash", "ring"])
     ap.add_argument("--remat", action="store_true")
@@ -64,7 +68,23 @@ def main(argv=None) -> int:
     if args.mesh:
         for pair in args.mesh.split(","):
             k, _, v = pair.partition("=")
-            mesh_axes[k.strip()] = int(v)
+            k = k.strip()
+            if k == "model":
+                # The TPUJob CRD spells the tensor axis "model"
+                # (operator/crd.py MeshSpec); accept either spelling so
+                # an admitted spec.mesh can be mirrored into worker args
+                # verbatim.
+                k = "tensor"
+            mesh_axes[k] = int(v)
+    if mesh_axes.get("pipeline", 1) > 1 and not args.pipeline_microbatches:
+        # Without microbatches the model runs the plain sequential scan
+        # while the layer stack stays sharded over the pipeline axis —
+        # every device all-gathers the other stages' params each step,
+        # pure overhead that LOOKS like working PP.  Fail loudly.
+        ap.error("--mesh pipeline>1 requires --pipeline-microbatches>0 "
+                 "(otherwise the pipeline axis is pure overhead: the "
+                 "layer stack is sharded over it but the GPipe schedule "
+                 "never runs)")
     mesh = MeshSpec(**mesh_axes).build()
 
     cfg = TransformerConfig(
@@ -74,6 +94,7 @@ def main(argv=None) -> int:
         head_dim=args.head_dim, max_seq_len=args.seq_len,
         moe_experts=args.moe_experts, attention=args.attention,
         remat=args.remat, ce_dtype=args.ce_dtype,
+        pipeline_microbatches=args.pipeline_microbatches,
     )
     init_fn, loss_fn = lm_task(cfg, mesh=mesh)
     batch = args.batch_size_per_device * jax.device_count()

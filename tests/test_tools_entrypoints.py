@@ -64,6 +64,22 @@ class TestTrainLM:
         assert proc.returncode == 0, proc.stderr[-2000:]
         assert '"event": "train_step"' in proc.stderr
 
+    def test_pipeline_parallel_on_fake_slice(self):
+        """The container entrypoint trains the real LM through GPipe:
+        --mesh pipeline=2 + --pipeline-microbatches, end to end."""
+        proc = subprocess.run(
+            [sys.executable, "-m", "kubeflow_tpu.tools.train_lm",
+             "--d-model", "32", "--n-layers", "2", "--n-heads", "4",
+             "--n-kv-heads", "4", "--d-ff", "64", "--head-dim", "8",
+             "--vocab-size", "64", "--seq-len", "16",
+             "--batch-size-per-device", "1", "--steps", "2",
+             "--pipeline-microbatches", "4",
+             "--log-every", "1", "--mesh", "data=2,pipeline=2"],
+            capture_output=True, text=True, timeout=280, env=_env(),
+        )
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        assert '"event": "train_step"' in proc.stderr
+
 
 class TestProfiling:
     def test_trace_writes_xplane(self, tmp_path):

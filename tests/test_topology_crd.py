@@ -54,7 +54,7 @@ class TestMeshSpec:
 
     def test_exact_match(self):
         sizes = MeshSpec(data=4, model=2, sequence=2).resolve(16)
-        assert sizes == {"data": 4, "fsdp": 1, "model": 2,
+        assert sizes == {"data": 4, "fsdp": 1, "pipeline": 1, "model": 2,
                          "sequence": 2, "expert": 1}
 
     def test_mismatch_raises(self):
@@ -101,6 +101,33 @@ class TestTPUJobSpec:
         assert back.mesh.model == 4
         assert back.worker.args == ["--steps=5"]
         assert back.topology.chips == 16
+
+    def test_pipeline_axis_in_cr(self):
+        """PP is a first-class mesh axis in the job spec: declared,
+        validated against the slice at admission, round-tripped."""
+        job = TPUJobSpec(
+            name="pp", slice_type="v5e-16",
+            mesh=MeshSpec(data=-1, pipeline=2),
+            worker=WorkerSpec(image="me:1"),
+        )
+        assert job.mesh.resolve(16)["pipeline"] == 2
+        back = TPUJobSpec.from_custom_resource(job.to_custom_resource())
+        assert back.mesh.pipeline == 2
+        with pytest.raises(SpecError):
+            TPUJobSpec(name="bad", slice_type="v5e-8",
+                       mesh=MeshSpec(data=3, pipeline=2))
+
+    def test_tensor_alias_and_runtime_axes(self):
+        """The CRD spells tensor-parallelism 'model'; the runtime
+        (parallel/mesh.py) spells it 'tensor'.  Both vocabularies are
+        accepted on input and runtime_axes() emits the runtime one, so
+        an admitted spec.mesh can drive worker flags verbatim."""
+        spec = MeshSpec.from_dict({"data": -1, "tensor": 4})
+        assert spec.model == 4
+        axes = spec.runtime_axes()
+        assert axes["tensor"] == 4 and "model" not in axes
+        with pytest.raises(SpecError, match="alias"):
+            MeshSpec.from_dict({"model": 2, "tensor": 2})
 
     def test_camelcase_wire_schema(self):
         """The CR wire schema is uniformly camelCase; parse accepts it and
