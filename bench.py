@@ -643,6 +643,23 @@ def bench_lm_decode(args, devices, n_chips, on_tpu):
             "max_seq_len": 128, "dtype": "float32",
         }
         prompt_len, new_tokens, batch = 16, 16, args.batch or 4
+    if args.decode_prompt_len:
+        # Long-context serving sweep knob: a long prompt's prefill runs
+        # the flash kernel (O(t) memory) when the model is
+        # flash-configured — the dot path's [b, h, t, t] scores would be
+        # the limiter (models/generate.py).
+        prompt_len = args.decode_prompt_len
+        overrides["max_seq_len"] = max(
+            overrides["max_seq_len"], prompt_len + new_tokens)
+        overrides["attention"] = "flash"
+        if args.kv_cache == "int8":
+            # The generate() gate keeps flash OFF for quantized caches
+            # (serving goldens pin the dot path's cache rounding) — say
+            # so, or a long-context sweep gets attributed to the wrong
+            # prefill kernel.
+            print("lm-decode: NOTE --kv-cache int8 disables flash "
+                  "prefill; this run measures the dot-path prefill",
+                  file=sys.stderr)
     print(f"bench: lm decode, d_model={overrides['d_model']} "
           f"L{overrides['n_layers']}, prompt {prompt_len} + {new_tokens} "
           f"new, {devices[0].device_kind}", file=sys.stderr)
@@ -919,6 +936,9 @@ def main() -> None:
                          "(no 4-byte logits copy in HBM)")
     ap.add_argument("--quantize", default=None, choices=[None, "int8"],
                     help="lm-decode: weight-only quantization mode")
+    ap.add_argument("--decode-prompt-len", type=int, default=0,
+                    help="lm-decode: override prompt length (0 = model "
+                         "preset); long prompts flash-prefill")
     ap.add_argument("--kv-cache", default=None, choices=[None, "int8"],
                     help="lm-decode: quantized KV cache "
                          "(per-position scales)")

@@ -122,10 +122,33 @@ def _layer_step(cfg: TransformerConfig, layer_params, x, cache_kv,
     # Attend over the whole buffer; positions beyond cache_len + t are
     # masked by the causal rule (their k_pos > any live q_pos... they are
     # zeros at positions >= cache_len+t, masked via kv_offset arithmetic).
-    out = dot_product_attention(
-        q, ck, cv, causal=True, kv_offset=cache_len,
-        kv_valid_start=pad_amount,
-    )
+    #
+    # Prefill of a LONG prompt on a flash-configured model uses the
+    # Pallas flash kernel over the fresh q/k/v instead (the cache is
+    # empty at prefill, so causal attention over the prompt alone is the
+    # whole computation): the dot path materializes the [b, h, t, t]
+    # score matrix in HBM — O(t^2) memory that defeats the point of
+    # serving a long-context model whose TRAINING path is O(t).  Gated
+    # off for left-padded buckets (the kernel has no per-row key mask)
+    # and quantized caches (the dot path attends against the freshly
+    # quantized cache, and serving goldens pin that rounding).
+    t_prefill = x.shape[1]
+    # cache_len is a static python 0 at prefill and a TRACED scalar in
+    # the decode scan — the gate must only ever inspect the static case.
+    static_prefill = isinstance(cache_len, int) and cache_len == 0
+    if (cfg.attention == "flash" and t_prefill > 1 and static_prefill
+            and pad_amount is None and not isinstance(ck, QTensor)):
+        from kubeflow_tpu.ops.flash import flash_attention
+
+        out = flash_attention(
+            q, k, v, causal=True,
+            block_q=cfg.flash_block_q, block_k=cfg.flash_block_k,
+        )
+    else:
+        out = dot_product_attention(
+            q, ck, cv, causal=True, kv_offset=cache_len,
+            kv_valid_start=pad_amount,
+        )
     y = qeinsum("bshd,hde->bse", out, attn["wo"], dt)
     x = x + y
     y = norm(x, layer_params["mlp_norm"]["scale"])

@@ -75,6 +75,12 @@ def main(argv=None) -> int:
                 # an admitted spec.mesh can be mirrored into worker args
                 # verbatim.
                 k = "tensor"
+            if k in mesh_axes:
+                # Matches crd.MeshSpec.from_dict: declaring an axis
+                # twice (incl. via its alias) fails loudly instead of
+                # silently last-wins.
+                ap.error(f"--mesh declares axis {k!r} twice "
+                         "(note 'model' aliases 'tensor')")
             mesh_axes[k] = int(v)
     if mesh_axes.get("pipeline", 1) > 1 and not args.pipeline_microbatches:
         # Without microbatches the model runs the plain sequential scan

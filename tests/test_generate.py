@@ -256,3 +256,34 @@ def test_bucketed_batcher_rejects_multi_row_submit():
             mb.submit({"tokens": np.zeros((2, 5), np.int32)})
     finally:
         mb.close()
+
+
+def test_flash_prefill_matches_dot_decode():
+    """A flash-configured model's generate() (flash prefill, cached dot
+    decode) must produce exactly the dot-configured model's tokens.
+    This suite runs on the CPU fake slice (conftest pins the platform)
+    where flash falls back to the XLA path, so exact equality pins the
+    GATE logic and shapes; flash-kernel-vs-dot numerics are pinned
+    separately with tolerances in tests/test_ops.py."""
+    _, params, prompt = setup()
+    dc = DecodeConfig(max_new_tokens=5)
+    ref, _ = generate(CFG, params, prompt, dc)
+    cfg_flash = TransformerConfig(
+        **{**CFG.__dict__, "attention": "flash"})
+    out, _ = generate(cfg_flash, params, prompt, dc)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+    # The gate stays OFF for left-padded buckets and int8 caches (their
+    # semantics are pinned elsewhere); both must still decode correctly
+    # under a flash-configured model.
+    padded = jnp.concatenate(
+        [jnp.zeros((2, 3), jnp.int32), prompt], axis=1)
+    out_pad, _ = generate(cfg_flash, params, padded, dc,
+                          prompt_len=jnp.asarray([8, 8], jnp.int32))
+    np.testing.assert_array_equal(np.asarray(out_pad[:, 3:]),
+                                  np.asarray(ref))
+    out_q, _ = generate(
+        TransformerConfig(**{**CFG.__dict__, "attention": "flash"}),
+        params, prompt,
+        DecodeConfig(max_new_tokens=5, kv_cache_dtype="int8"))
+    assert out_q.shape == ref.shape
