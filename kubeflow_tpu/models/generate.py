@@ -128,24 +128,23 @@ def _layer_step(cfg: TransformerConfig, layer_params, x, cache_kv,
     # empty at prefill, so causal attention over the prompt alone is the
     # whole computation): the dot path materializes the [b, h, t, t]
     # score matrix in HBM — O(t^2) memory that defeats the point of
-    # serving a long-context model whose TRAINING path is O(t).  Gated
-    # off for left-padded buckets (the kernel has no per-row key mask
-    # yet, and BucketedLMBatcher attaches prompt_len to every batched
-    # request — so DEPLOYED bucketed serving prefills via the dot path,
-    # bounded by the largest configured bucket; flash prefill serves
-    # the unbatched and over-bucket paths) and for quantized caches
-    # (the dot path attends against the freshly quantized cache, and
-    # serving goldens pin that rounding).
+    # serving a long-context model whose TRAINING path is O(t).
+    # Left-padded bucketed batches ride the kernel's forward-only
+    # per-row key-start mask (kv_valid_start — pad keys get zero
+    # weight), so DEPLOYED bucketed serving flash-prefills too.  Gated
+    # off only for quantized caches (the dot path attends against the
+    # freshly quantized cache, and serving goldens pin that rounding).
     # cache_len is a static python 0 at prefill and a TRACED scalar in
     # the decode scan — the gate must only ever inspect the static case.
     static_prefill = isinstance(cache_len, int) and cache_len == 0
     if (cfg.attention == "flash" and t > 1 and static_prefill
-            and pad_amount is None and not isinstance(ck, QTensor)):
+            and not isinstance(ck, QTensor)):
         from kubeflow_tpu.ops.flash import flash_attention
 
         out = flash_attention(
             q, k, v, causal=True,
             block_q=cfg.flash_block_q, block_k=cfg.flash_block_k,
+            kv_valid_start=pad_amount,
         )
     else:
         out = dot_product_attention(

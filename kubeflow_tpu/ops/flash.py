@@ -68,9 +68,18 @@ def _fit_block(block: int, s: int) -> int:
 
 
 def _flash_fwd_kernel(
-    q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
-    *, scale: float, causal: bool, block_q: int, block_k: int,
+    q_ref, k_ref, v_ref, *refs,
+    scale: float, causal: bool, block_q: int, block_k: int,
+    masked: bool = False,
 ):
+    # With ``masked`` a fourth input carries the per-(batch*head) first
+    # valid key position (left-padded decode prefill: pad keys must get
+    # zero weight) — serving-side forward-only path.
+    if masked:
+        start_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = refs
+    else:
+        start_ref = None
+        o_ref, lse_ref, m_scr, l_scr, acc_scr = refs
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -85,6 +94,9 @@ def _flash_fwd_kernel(
     k_start = ki * block_k
     # Causal: block is live unless every (q, k) pair has k > q.
     live = (not causal) or (q_start + block_q - 1 >= k_start)
+    if masked:
+        # Blocks entirely before the first valid key are dead.
+        live = live & (k_start + block_k - 1 >= start_ref[0, 0])
 
     @pl.when(live)
     def _compute():
@@ -98,16 +110,28 @@ def _flash_fwd_kernel(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale                                   # [BQ, BK] f32
+        if causal or masked:
+            k_pos = k_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
         if causal:
             q_pos = q_start + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
-            k_pos = k_start + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        if masked:
+            s = jnp.where(k_pos >= start_ref[0, 0], s, NEG_INF)
 
         m_prev = m_scr[:, :1]                       # [BQ, 1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)                      # [BQ, BK] f32
+        if masked:
+            # A row whose every key so far is masked leaves m_new at the
+            # NEG_INF sentinel; exp(s - m_new) is then exp(0) = 1 for
+            # the masked entries (sentinel minus sentinel), silently
+            # attending to pads.  The causal path never hits this (the
+            # k=0 block always gives each live row a real max) but with
+            # a key-start mask the EARLY blocks are the masked ones —
+            # zero the contributions explicitly.
+            p = jnp.where(s > NEG_INF / 2, p, 0.0)
         alpha = jnp.exp(m_prev - m_new)             # [BQ, 1]
         l_new = alpha * l_scr[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
         acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
@@ -132,21 +156,37 @@ def _flash_fwd_kernel(
 def _flash_fwd_bhsd(
     q: jax.Array, k: jax.Array, v: jax.Array,
     *, causal: bool, block_q: int, block_k: int, interpret: bool,
+    kv_start: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, jax.Array]:
-    """q: [bh, sq, d], k/v: [bh, sk, d] -> (o [bh, sq, d], lse [bh, sq])."""
+    """q: [bh, sq, d], k/v: [bh, sk, d] -> (o [bh, sq, d], lse [bh, sq]).
+
+    kv_start ([bh, 1] int32, optional): first valid key position per
+    batch*head row — keys before it get zero weight (left-padded
+    prompts).  Forward-only: the backward kernels have no mask support.
+    """
     bh, sq, d = q.shape
     sk = k.shape[1]
     block_q = _fit_block(block_q, sq)
     block_k = _fit_block(block_k, sk)
     scale = d ** -0.5
     grid = (bh, sq // block_q, sk // block_k)
+    masked = kv_start is not None
     kernel = functools.partial(
         _flash_fwd_kernel, scale=scale, causal=causal,
-        block_q=block_q, block_k=block_k,
+        block_q=block_q, block_k=block_k, masked=masked,
     )
     # Propagate the varying-manual-axes type so the kernel is callable
     # inside shard_map (ring attention, make_sharded_flash).
     vma = jax.typeof(q).vma
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, qi, ki: (b, ki, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, qi, ki: (b, ki, 0)),
+    ]
+    inputs = [q, k, v]
+    if masked:
+        in_specs.append(pl.BlockSpec((1, 1), lambda b, qi, ki: (b, 0)))
+        inputs.append(kv_start.astype(jnp.int32))
     o, lse = pl.pallas_call(
         kernel,
         out_shape=[
@@ -157,11 +197,7 @@ def _flash_fwd_bhsd(
             jax.ShapeDtypeStruct((bh, sq, 1), jnp.float32, vma=vma),
         ],
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, qi, ki: (b, ki, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, qi, ki: (b, ki, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
             pl.BlockSpec((1, block_q, 1), lambda b, qi, ki: (b, qi, 0)),
@@ -173,7 +209,7 @@ def _flash_fwd_bhsd(
             pltpu.VMEM((block_q, d), jnp.float32),
         ],
         interpret=interpret,
-    )(q, k, v)
+    )(*inputs)
     return o, lse[:, :, 0]
 
 
@@ -520,6 +556,7 @@ def flash_attention(
     block_q: int = 512,
     block_k: int = 512,
     interpret: bool = False,
+    kv_valid_start: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Flash attention with the ops/attention.py [b, s, h, d] signature.
 
@@ -528,14 +565,30 @@ def flash_attention(
     repeating kv heads before the kernel (the cotangent sum over the head
     group is what jnp.repeat's autodiff gives back).  Segment masking is
     not yet in the kernel: segmented calls fall back to the XLA path.
+
+    kv_valid_start ([b] int32, optional): per-row first valid key —
+    keys before it get zero weight (left-padded bucketed decode
+    prefill, models/generate.py).  FORWARD-ONLY: this path bypasses the
+    custom-vjp kernels (inference has no cotangents; differentiating it
+    raises).
     """
     on_tpu = jax.default_backend() == "tpu"
     if segment_ids is not None or (not on_tpu and not interpret):
         return dot_product_attention(
-            q, k, v, causal=causal, segment_ids=segment_ids
+            q, k, v, causal=causal, segment_ids=segment_ids,
+            kv_valid_start=kv_valid_start,
         )
     b, sq, h, d = q.shape
     k, v = repeat_kv(k, v, h)
+    if kv_valid_start is not None:
+        start = jnp.repeat(
+            kv_valid_start.astype(jnp.int32), h)[:, None]  # [b*h, 1]
+        out, _ = _flash_fwd_bhsd(
+            _to_bhsd(q), _to_bhsd(k), _to_bhsd(v),
+            causal=causal, block_q=block_q, block_k=block_k,
+            interpret=interpret, kv_start=start,
+        )
+        return _from_bhsd(out, b, h)
     out = _flash(
         _to_bhsd(q), _to_bhsd(k), _to_bhsd(v),
         causal, block_q, block_k, interpret,

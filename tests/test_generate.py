@@ -273,9 +273,11 @@ def test_flash_prefill_matches_dot_decode():
     out, _ = generate(cfg_flash, params, prompt, dc)
     np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
 
-    # The gate stays OFF for left-padded buckets and int8 caches (their
-    # semantics are pinned elsewhere); both must still decode correctly
-    # under a flash-configured model.
+    # Left-padded rows ride flash prefill via the kernel's per-row
+    # key-start mask (CPU fallback applies the same mask in the dot
+    # path) and must decode identically to the unpadded reference;
+    # int8 caches keep the dot path (goldens pin that rounding) and
+    # must still decode at the right shape.
     padded = jnp.concatenate(
         [jnp.zeros((2, 3), jnp.int32), prompt], axis=1)
     out_pad, _ = generate(cfg_flash, params, padded, dc,
