@@ -338,16 +338,7 @@ class RecordDataset:
     def _python_batches(
         self, batch_size: int, drop_remainder: bool,
     ) -> Iterator[Dict[str, np.ndarray]]:
-        batch: List[Dict[str, np.ndarray]] = []
-        for payload in self:
-            batch.append(decode_example(payload, copy=False))
-            if len(batch) == batch_size:
-                yield {k: np.stack([ex[k] for ex in batch])
-                       for k in batch[0]}
-                batch = []
-        if batch and not drop_remainder:
-            yield {k: np.stack([ex[k] for ex in batch])
-                   for k in batch[0]}
+        yield from _stack_payloads(self, batch_size, drop_remainder)
 
     def _python_iter(self) -> Iterator[bytes]:
         rng = np.random.RandomState(self.seed)
@@ -447,33 +438,180 @@ def decode_example(payload: bytes,
     return out
 
 
+def skip_records(path: str | Path, n: int) -> int:
+    """Skip up to n records of a KFTR file WITHOUT reading payloads
+    (header walk + fseek).  Returns how many were skipped — the resume
+    fast-path building block: a decode-free skip costs microseconds per
+    record against the milliseconds of decode + stack it replaces.
+    Truncation raises IOError exactly like ``read_records`` (fseek
+    would silently sail past EOF, so the walk checks against the file
+    size)."""
+    skipped = 0
+    with open(path, "rb") as f:
+        size = os.fstat(f.fileno()).st_size
+        if f.read(5) != MAGIC:
+            raise IOError(f"{path}: bad magic (want KFTR v1)")
+        while skipped < n:
+            header = f.read(4)
+            if not header:
+                break
+            if len(header) != 4:
+                raise IOError(f"{path}: truncated length")
+            (length,) = struct.unpack("<I", header)
+            if f.tell() + length > size:
+                raise IOError(f"{path}: truncated payload")
+            f.seek(length, 1)
+            skipped += 1
+    return skipped
+
+
+def _stack_payloads(
+    payloads: "Iterable[bytes]", batch_size: int, drop_remainder: bool,
+) -> Iterator[Dict[str, np.ndarray]]:
+    """The one decode+stack loop every python batching path shares.
+    Zero-copy decode views are safe: np.stack copies them out."""
+    batch: List[Dict[str, np.ndarray]] = []
+    for payload in payloads:
+        batch.append(decode_example(payload, copy=False))
+        if len(batch) == batch_size:
+            yield {k: np.stack([ex[k] for ex in batch])
+                   for k in batch[0]}
+            batch = []
+    if batch and not drop_remainder:
+        yield {k: np.stack([ex[k] for ex in batch]) for k in batch[0]}
+
+
+def count_records(path: str | Path) -> int:
+    """Record count via header walk (no payload reads)."""
+    return skip_records(path, 1 << 62)
+
+
+class TensorBatches:
+    """Iterator over Trainer-shaped batches with a resume fast-path.
+
+    ``seek(n_steps)`` (the contract Trainer.fit probes for on resume)
+    skips n_steps batches before the first yield.  For an unshuffled
+    RecordDataset the skip is a decode-free header walk over the
+    shard files (payloads are fseek'd over, epochs wrap); shuffled or
+    plain-iterable datasets fall back to draining batches — correct,
+    just no faster than the replay Trainer.fit would otherwise do.
+    """
+
+    def __init__(self, dataset, batch_size: int,
+                 drop_remainder: bool = True):
+        self._dataset = dataset
+        self._batch_size = batch_size
+        self._drop = drop_remainder
+        self._skip_steps = 0
+
+    def seek(self, n_steps: int) -> None:
+        if n_steps < 0:
+            raise ValueError(f"seek wants n_steps >= 0, got {n_steps}")
+        self._skip_steps = int(n_steps)
+
+    def _fast_skippable(self) -> bool:
+        # The header-walk skip yields the remainder in FILE order, which
+        # only matches the stream it replaces when that stream is also
+        # file-ordered: the force_python reader.  The threaded native
+        # core interleaves files (its stream order is not
+        # file-deterministic), so a native dataset drains instead —
+        # its order on resume then matches what replay would produce.
+        return (isinstance(self._dataset, RecordDataset)
+                and self._dataset.shuffle_buffer <= 1
+                and self._dataset.force_python)
+
+    def _batches(self) -> Iterator[Dict[str, np.ndarray]]:
+        if isinstance(self._dataset, RecordDataset):
+            yield from self._dataset.stacked_batches(
+                self._batch_size, drop_remainder=self._drop)
+            return
+        yield from _stack_payloads(self._dataset, self._batch_size,
+                                   self._drop)
+
+    def _fast_skip(self, n_records: int) -> Iterator[Dict[str, np.ndarray]]:
+        """Header-walk past n_records, then decode/stack the remainder.
+
+        The mid-file resume point rules out the in-core stacked path
+        (the C reader starts at file offsets 0), so post-skip batches
+        use the python decode loop — resume pays decode per record
+        only AFTER the skip point instead of through it.
+        """
+        ds = self._dataset
+        counts = [count_records(p) for p in ds.paths]
+        per_epoch = sum(counts)
+        epochs_total = ds.repeat if ds.repeat > 0 else None
+        if per_epoch == 0:
+            return
+        epoch, offset = divmod(n_records, per_epoch)
+        if epochs_total is not None and epoch >= epochs_total:
+            return  # sought past the end: nothing left to yield
+
+        def remaining_payloads():
+            to_skip = offset  # records to fseek past, first epoch only
+            e = epoch
+            while epochs_total is None or e < epochs_total:
+                for path, cnt in zip(ds.paths, counts):
+                    if to_skip >= cnt:
+                        to_skip -= cnt
+                        continue
+                    with open(path, "rb") as f:
+                        f.read(5)  # magic, validated by count_records
+                        idx = 0
+                        while True:
+                            header = f.read(4)
+                            if not header:
+                                break
+                            if len(header) != 4:
+                                raise IOError(
+                                    f"{path}: truncated length")
+                            (length,) = struct.unpack("<I", header)
+                            if idx < to_skip:
+                                f.seek(length, 1)
+                            else:
+                                payload = f.read(length)
+                                if len(payload) != length:
+                                    raise IOError(
+                                        f"{path}: truncated payload")
+                                yield payload
+                            idx += 1
+                    to_skip = 0
+                to_skip = 0
+                e += 1
+
+        yield from _stack_payloads(remaining_payloads(),
+                                   self._batch_size, self._drop)
+
+    def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
+        # Lazy: Trainer.fit calls iter() BEFORE seek(); the skip amount
+        # is read when the first batch is pulled.
+        def run():
+            skip = self._skip_steps
+            if skip and self._fast_skippable():
+                yield from self._fast_skip(skip * self._batch_size)
+                return
+            it = self._batches()
+            for _ in range(skip):
+                next(it, None)
+            yield from it
+
+        return run()
+
+
 def tensor_batches(
     dataset: Iterable[bytes],
     batch_size: int,
     *,
     drop_remainder: bool = True,
-) -> Iterator[Dict[str, np.ndarray]]:
+) -> TensorBatches:
     """Decode + stack payloads into Trainer-shaped batches.
 
     A RecordDataset routes through its in-core stacked-batch path
     (decode + assembly in C++); any other payload iterable uses the
-    python decode/stack loop.
+    python decode/stack loop.  The returned iterator supports
+    ``seek(n_steps)`` — Trainer.fit's resume fast-path (decode-free
+    header-walk skip for unshuffled record datasets).
     """
-    if isinstance(dataset, RecordDataset):
-        yield from dataset.stacked_batches(
-            batch_size, drop_remainder=drop_remainder)
-        return
-    batch: List[Dict[str, np.ndarray]] = []
-    for payload in dataset:
-        # Zero-copy views are safe here: np.stack below copies them out.
-        batch.append(decode_example(payload, copy=False))
-        if len(batch) == batch_size:
-            yield {
-                k: np.stack([ex[k] for ex in batch]) for k in batch[0]
-            }
-            batch = []
-    if batch and not drop_remainder:
-        yield {k: np.stack([ex[k] for ex in batch]) for k in batch[0]}
+    return TensorBatches(dataset, batch_size, drop_remainder)
 
 
 def write_example_shards(

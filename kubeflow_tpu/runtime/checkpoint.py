@@ -56,7 +56,15 @@ class CheckpointManager:
         )
 
     def save(self, step: int, state: Any, *, force: bool = False) -> bool:
-        """Queue an async save; returns False if skipped by save policy."""
+        """Queue an async save; returns False if skipped by save policy.
+
+        Saving a step that already exists is a no-op, not an error:
+        fit's final forced save can land on the same step a periodic
+        save just wrote (num_steps-1 on a checkpoint_every boundary),
+        and orbax raises StepAlreadyExistsError for that.
+        """
+        if step in (self._mgr.all_steps() or ()):
+            return False
         saved = self._mgr.save(
             step, args=ocp.args.StandardSave(state), force=force
         )
