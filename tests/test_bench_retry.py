@@ -9,6 +9,7 @@ failure record when the backend never comes up.
 """
 
 import json
+import os
 
 import bench
 
@@ -118,3 +119,40 @@ def test_watchdog_passes_through_success_and_errors():
         log=lambda m: None, attempt_timeout_s=5.0)
     assert devices is None
     assert len(failure["detail"]["log"]) == 2
+
+
+def test_soft_deadline_skips_tail_but_prints_headline(monkeypatch, capsys):
+    """A driver-side hard timeout mid-suite records NOTHING (the one
+    JSON line prints at the end); the soft deadline must skip remaining
+    sub-benches and still deliver the headline record."""
+    import sys as _sys
+
+    monkeypatch.setenv("KFT_BENCH_DEADLINE_S", "0.000001")
+    # main() appends the fake-device flag to XLA_FLAGS in-place; pin the
+    # var so the append is rolled back after the test (subprocess-
+    # spawning tests inherit os.environ).
+    monkeypatch.setenv("XLA_FLAGS", os.environ.get("XLA_FLAGS", ""))
+    monkeypatch.setattr(_sys, "argv", ["bench.py", "--model", "both",
+                                       "--fake-devices", "8"])
+    headline = {"metric": "resnet50_images_per_sec_per_chip",
+                "value": 1.0, "unit": "x", "vs_baseline": 0.0,
+                "detail": {}}
+    monkeypatch.setattr(bench, "bench_resnet",
+                        lambda *a, **k: dict(headline, detail={}))
+
+    def boom(*a, **k):
+        raise AssertionError("sub-bench ran past the deadline")
+
+    for name in ("bench_lm", "bench_serving", "bench_lm_decode",
+                 "bench_data"):
+        monkeypatch.setattr(bench, name, boom)
+    monkeypatch.setattr(
+        bench, "acquire_devices",
+        lambda *a, **k: ([type("D", (), {"platform": "cpu"})()], None))
+    bench.main()
+    out = capsys.readouterr().out.strip().splitlines()
+    assert len(out) == 1, out
+    record = json.loads(out[0])
+    assert record["metric"] == "resnet50_images_per_sec_per_chip"
+    assert set(record["detail"]["skipped_sub_benches"]) == {
+        "lm", "serving", "lm_decode", "lm_decode_int8", "data"}
