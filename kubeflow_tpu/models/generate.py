@@ -241,7 +241,10 @@ def generate(
     """prompt [b, t] -> (tokens [b, t+max_new], logits_last [b, vocab]).
 
     One jitted program: prefill the prompt, then scan max_new_tokens
-    single-token steps against the cache.
+    single-token steps against the cache.  With ``eos_token >= 0`` the
+    step loop exits early once every row is done; tokens are identical
+    to the fixed-length run (pads are 0), and logits_last are from the
+    exit step rather than after max_new_tokens of pad-forwarding.
 
     prompt_len ([b] int32, optional): per-row real prompt lengths for
     LEFT-padded prompts — rows shorter than t carry (t - len) pad
@@ -300,8 +303,34 @@ def generate(
         return (cache, logits[:, -1], cache_len + 1, key, done), nxt
 
     done0 = jnp.zeros((b,), bool)
-    (_, final_logits, _, _, _), new_tokens = jax.lax.scan(
-        step, (cache, last, t, rng, done0), None,
-        length=decode.max_new_tokens)
+    if decode.eos_token >= 0:
+        # EOS configured: early-exit with lax.while_loop the moment
+        # every row is done — completions shorter than max_new_tokens
+        # stop paying per-token forwards.  Emitted TOKENS are identical
+        # to the fixed-length scan (done rows emit 0s, and the output
+        # buffer starts zeroed), so the goldens hold either way; the
+        # returned final logits are those of the step the loop exited
+        # at (the scan path kept forwarding pad zeros and returned
+        # logits after step max_new_tokens — values no caller should
+        # score from anyway once every row is done).
+        out0 = jnp.zeros((decode.max_new_tokens, b), jnp.int32)
+
+        def cond(state):
+            i, carry, _ = state
+            done = carry[4]
+            return (i < decode.max_new_tokens) & ~jnp.all(done)
+
+        def body(state):
+            i, carry, out = state
+            carry, nxt = step(carry, None)
+            return i + 1, carry, jax.lax.dynamic_update_index_in_dim(
+                out, nxt.astype(jnp.int32), i, axis=0)
+
+        _, (_, final_logits, _, _, _), new_tokens = jax.lax.while_loop(
+            cond, body, (0, (cache, last, t, rng, done0), out0))
+    else:
+        (_, final_logits, _, _, _), new_tokens = jax.lax.scan(
+            step, (cache, last, t, rng, done0), None,
+            length=decode.max_new_tokens)
     tokens = jnp.concatenate([prompt, new_tokens.T], axis=1)
     return tokens, final_logits

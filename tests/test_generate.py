@@ -289,3 +289,16 @@ def test_flash_prefill_matches_dot_decode():
         params, prompt,
         DecodeConfig(max_new_tokens=5, kv_cache_dtype="int8"))
     assert out_q.shape == ref.shape
+
+
+def test_eos_while_loop_matches_scan_when_eos_never_fires():
+    """eos_token >= 0 switches decode to the early-exit while_loop; when
+    no row ever emits EOS it must produce exactly the fixed-length scan's
+    tokens (the early exit changes wall time, never content)."""
+    _, params, prompt = setup()
+    ref, _ = generate(CFG, params, prompt, DecodeConfig(max_new_tokens=6))
+    used = set(np.asarray(ref[:, prompt.shape[1]:]).ravel().tolist())
+    eos = next(i for i in range(CFG.vocab_size) if i not in used)
+    out, _ = generate(CFG, params, prompt,
+                      DecodeConfig(max_new_tokens=6, eos_token=eos))
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
