@@ -28,3 +28,22 @@ def test_quickstart_end_to_end():
     # All four stages reported.
     for stage in ("[1]", "[2]", "[3]", "[4]"):
         assert stage in proc.stdout, proc.stdout
+
+
+def test_parallelism_tour_runs_every_family():
+    """examples/parallelism.py: the SAME flagship model trains through
+    dp/fsdp/tp/sp/ep/pp — the one-file proof of the mesh story the
+    reference spread across three job kinds."""
+    env = dict(os.environ, PYTHONPATH=str(REPO))
+    env.pop("JAX_PLATFORMS", None)        # the script pins cpu itself
+    env.pop("KFT_PARALLELISM_TPU", None)  # never grab a host's chip
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "examples" / "parallelism.py")],
+        capture_output=True, text=True, timeout=580, env=env,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "tour complete" in proc.stdout
+    for family in ("data-parallel", "fsdp", "tensor-parallel",
+                   "sequence-parallel", "expert-parallel",
+                   "pipeline-parallel"):
+        assert family in proc.stdout, proc.stdout

@@ -302,3 +302,20 @@ def test_eos_while_loop_matches_scan_when_eos_never_fires():
     out, _ = generate(CFG, params, prompt,
                       DecodeConfig(max_new_tokens=6, eos_token=eos))
     np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_eos_early_exit_payoff_case_matches_scan_semantics():
+    """The case the while_loop exists FOR: every row done well before
+    max_new_tokens.  Tokens must equal the fixed-length run truncated at
+    EOS (EOS emitted, zeros after), at the full output shape."""
+    _, params, prompt = setup()
+    row = prompt[:1]  # single row: its first greedy token becomes EOS
+    ref, _ = generate(CFG, params, row, DecodeConfig(max_new_tokens=6))
+    t = row.shape[1]
+    eos = int(ref[0, t])
+    out, _ = generate(CFG, params, row,
+                      DecodeConfig(max_new_tokens=6, eos_token=eos))
+    assert out.shape == ref.shape
+    expect = np.asarray(ref).copy()
+    expect[0, t + 1:] = 0  # everything after the EOS emission pads to 0
+    np.testing.assert_array_equal(np.asarray(out), expect)
