@@ -54,15 +54,19 @@ class MoEMLP(nn.Module):
     # Dispatch/combine implementation:
     #   "einsum" — GShard one-hot einsums: dispatch builds a [g, E, C]
     #     one-hot tensor and contracts over the g tokens, O(g*E*C*d)
-    #     MACs each way.  Robust, differentiable everywhere, but the
-    #     contraction is pure token MOVEMENT priced as MXU work — it was
-    #     ~1/3 of the measured MoE step at the bench config.
+    #     MACs each way.  The contraction is pure token MOVEMENT priced
+    #     as MXU work — but the MXU is exactly where the TPU is fast.
     #   "gather" — the same routing decisions materialized as indices:
     #     a [E, C] slot->token scatter, a row gather into the expert
     #     batch (O(E*C*d) bytes moved, no MACs), and a per-choice row
     #     gather back out (O(g*top_k*d)).  Identical numerics and drop
     #     semantics; the g-fold reduction dimension disappears.
-    impl: str = "gather"
+    # Swept on-chip at the bench config (v5e, 4 experts, top-2): einsum
+    # 34.9k tok/s (MFU 0.362) vs gather 30.9k (0.321), reproduced
+    # twice.  The asymptotic-MAC win loses to XLA's dynamic-gather
+    # lowering (vector-unit + HBM bound); the one-hot contractions ride
+    # the MXU.  Default follows the measurement.
+    impl: str = "einsum"
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:

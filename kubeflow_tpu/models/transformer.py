@@ -88,11 +88,12 @@ class TransformerConfig:
     # at the bench config (4 experts, ms/step): 128 -> 516, 256 -> 471,
     # 512 -> 495, 1024 -> 528 — see models/moe.py.
     moe_group_size: int = 256
-    # MoE dispatch/combine implementation: "gather" (slot-index scatter
-    # + row gathers, no O(g) contraction) or "einsum" (GShard one-hot
-    # contractions).  See models/moe.py MoEMLP.impl for the trade and
-    # BASELINE.md for the on-chip sweep.
-    moe_impl: str = "gather"
+    # MoE dispatch/combine implementation: "einsum" (GShard one-hot
+    # contractions — the measured on-chip winner, MXU-bound) or
+    # "gather" (slot-index scatter + row gathers, no O(g) contraction,
+    # but XLA's dynamic-gather lowering loses ~12% end to end).  See
+    # models/moe.py MoEMLP.impl for the sweep numbers.
+    moe_impl: str = "einsum"
     # Cross-entropy input precision.  "f32" materializes the full
     # [b, s, vocab] logits tensor in float32 before the loss (simple,
     # maximally precise).  "compute" keeps logits in the compute dtype

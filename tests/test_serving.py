@@ -728,6 +728,51 @@ class TestBatcherLifecycleRaces:
             real.close()
             srv.stop()
 
+    def test_finish_failure_spares_delivered_rows(self):
+        """A `finish` hook raising on row i must not poison rows
+        0..i-1 of the same batch: their waiters keep their results
+        (they may not have woken yet when the error handler runs)."""
+        from kubeflow_tpu.serving.model_server import MicroBatcher
+
+        def finish(row, meta):
+            if meta:
+                raise RuntimeError("finish boom")
+            return row
+
+        mb = MicroBatcher(
+            lambda inputs: {"x": np.asarray(inputs["x"])},
+            max_batch_size=2, batch_timeout_s=0.5,
+            allowed_batch_sizes=[1, 2], in_flight=1, name="finfail",
+            group_key=lambda inputs: "all",
+            collate=lambda rows: (
+                {"x": np.concatenate(
+                    [np.asarray(r["x"]) for r in rows], axis=0)},
+                # Meta truthy (=> finish raises) for every row but the
+                # first, so one batch mixes delivered and poisoned rows.
+                [i > 0 for i in range(len(rows))]),
+            finish=finish,
+        )
+        try:
+            import concurrent.futures as cf
+
+            with cf.ThreadPoolExecutor(2) as ex:
+                futs = [ex.submit(
+                    mb.submit, {"x": np.full((1, 2), i, np.int32)})
+                    for i in range(2)]
+                results = []
+                for f in futs:
+                    try:
+                        results.append(("ok", f.result(timeout=10)))
+                    except RuntimeError as exc:
+                        results.append(("err", str(exc)))
+            kinds = sorted(k for k, _ in results)
+            # Exactly one row delivered, one poisoned — never both
+            # poisoned (the old handler overwrote delivered rows) and
+            # never a hang.
+            assert kinds == ["err", "ok"], results
+        finally:
+            mb.close()
+
     def test_over_bucket_prompt_falls_back_to_direct(self):
         from kubeflow_tpu.serving.model_server import BucketedLMBatcher
 
