@@ -960,9 +960,10 @@ def main() -> None:
     ap.add_argument("--moe-impl", default="einsum",
                     choices=["einsum", "gather"],
                     help="MoE dispatch/combine implementation "
-                         "(models/moe.py; einsum measured 34.9k vs "
-                         "gather 30.9k tok/s on-chip)")
-    ap.add_argument("--moe-group-size", type=int, default=256,
+                         "(models/moe.py; einsum measured 38.8k tok/s "
+                         "at group 128 vs gather 31.0k at its best "
+                         "group 256)")
+    ap.add_argument("--moe-group-size", type=int, default=128,
                     help="GShard routing group (tokens) for --moe-experts")
     ap.add_argument("--remat-policy", default="nobatch",
                     choices=["nobatch", "dots"],

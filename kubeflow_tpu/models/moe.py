@@ -45,11 +45,14 @@ class MoEMLP(nn.Module):
     top_k: int = 2
     capacity_factor: float = 1.25
     # Routing group size (tokens): dispatch cost per token is
-    # proportional to it, capacity granularity inversely.  The effective
-    # size is a divisor of the token count <= this (gcd fallback), so any
-    # batch shape works.  256 measured best on v5e (TransformerConfig
-    # .moe_group_size documents the sweep).
-    group_size: int = 256
+    # proportional to it, capacity granularity (and drop variance)
+    # inversely.  The effective size is a divisor of the token count <=
+    # this (gcd fallback), so any batch shape works.  Swept on v5e: 256
+    # was best under the round-3 G-major einsums; with E-major rank-3
+    # expert matmuls 128 wins (MFU 0.404 vs 0.399, dispatch one-hot
+    # cost halved) and 64 plateaus (0.402) while shrinking per-group
+    # statistics, so 128 is the default.
+    group_size: int = 128
     dtype: object = jnp.bfloat16
     # Dispatch/combine implementation:
     #   "einsum" — GShard one-hot einsums: dispatch builds a [g, E, C]
@@ -61,11 +64,14 @@ class MoEMLP(nn.Module):
     #     batch (O(E*C*d) bytes moved, no MACs), and a per-choice row
     #     gather back out (O(g*top_k*d)).  Identical numerics and drop
     #     semantics; the g-fold reduction dimension disappears.
-    # Swept on-chip at the bench config (v5e, 4 experts, top-2): einsum
-    # 34.9k tok/s (MFU 0.362) vs gather 30.9k (0.321), reproduced
-    # twice.  The asymptotic-MAC win loses to XLA's dynamic-gather
-    # lowering (vector-unit + HBM bound); the one-hot contractions ride
-    # the MXU.  Default follows the measurement.
+    # Swept on-chip at the bench config (v5e, 4 experts, top-2,
+    # artifacts/r4_onchip_sweeps.log): einsum 38.8k tok/s (MFU 0.404,
+    # E-major rank-3 form, group 128) vs gather 31.0k (0.322, at its
+    # own best group 256 — gather drops to 28.1k at 128, so set
+    # group_size=256 when selecting it).  The asymptotic-MAC win loses
+    # to XLA's dynamic-gather lowering (vector-unit + HBM bound); the
+    # one-hot contractions ride the MXU.  Default follows the
+    # measurement.
     impl: str = "einsum"
 
     @nn.compact
@@ -191,17 +197,47 @@ class MoEMLP(nn.Module):
                 dispatch = dispatch + contrib.astype(jnp.bfloat16)
                 combine = combine \
                     + contrib * gate_vals[..., choice, None, None]
+            # Expert axis LEADING on the dispatch output: the expert
+            # einsums batch over E, and producing [G, E, C, d] makes
+            # XLA materialize a G<->E transpose between dispatch and
+            # the first expert matmul (profiled at ~18 ms/step, ~4% of
+            # the MoE step, pure data movement).  E-major feeds them
+            # in place.
             expert_in = jnp.einsum(
-                "gnec,gnd->gecd", dispatch, tokens.astype(jnp.bfloat16))
+                "gnec,gnd->egcd", dispatch, tokens.astype(jnp.bfloat16))
 
-        # Expert compute: [G, E, C, d] batched SwiGLU — one big MXU batch.
-        expert_in = nn.with_logical_constraint(
-            expert_in, (None, "expert", None, None))
-        gate = jnp.einsum("gecd,edf->gecf", expert_in, wi[:, 0].astype(dt))
-        up = jnp.einsum("gecd,edf->gecf", expert_in, wi[:, 1].astype(dt))
-        h = nn.silu(gate) * up
-        h = nn.with_logical_constraint(h, (None, "expert", None, "mlp"))
-        expert_out = jnp.einsum("gecf,efd->gecd", h, wo.astype(dt))
+        def expert_mlp(x, spec, constraint):
+            """Batched SwiGLU over the expert slot tensor; `spec` is the
+            input/activation einsum subscripts (the down-projection
+            transposes them), `constraint` the matching logical axes
+            with "mlp" substituted on the f dim."""
+            x = nn.with_logical_constraint(x, constraint)
+            lhs, out = spec.split("->")
+            lhs = lhs.split(",")[0]
+            gate = jnp.einsum(spec, x, wi[:, 0].astype(dt))
+            up = jnp.einsum(spec, x, wi[:, 1].astype(dt))
+            h = nn.silu(gate) * up
+            h = nn.with_logical_constraint(
+                h, tuple("mlp" if c == "d" else a
+                         for c, a in zip(lhs, constraint)))
+            return jnp.einsum(f"{out},efd->{lhs}", h, wo.astype(dt))
+
+        if self.impl == "gather":
+            # The slot map is [G, E, C]; vmap over G builds [G, E, C,
+            # d], and the combine row-gathers index it per group.
+            expert_out = expert_mlp(
+                expert_in, "gecd,edf->gecf",
+                (None, "expert", None, None))
+        else:
+            # [E, G*C, d] — one big MXU batch, expert axis outermost
+            # end to end (dispatch through combine).  The G and C dims
+            # are collapsed for the matmuls: rank-3 inputs lower to one
+            # clean batched dot per expert, where the rank-4 form kept
+            # G as a second batch dim.
+            expert_out = expert_mlp(
+                expert_in.reshape(cfg_e, n_groups * capacity, d),
+                "end,edf->enf", ("expert", None, None),
+            ).reshape(cfg_e, n_groups, capacity, d)
 
         if self.impl == "gather":
             # Each token reads its top_k slots back out: a per-choice
@@ -217,7 +253,7 @@ class MoEMLP(nn.Module):
                 out = out + rows * w
         else:
             out = jnp.einsum(
-                "gnec,gecd->gnd", combine.astype(dt), expert_out)
+                "gnec,egcd->gnd", combine.astype(dt), expert_out)
 
         # Switch load-balance loss: E * sum_e (fraction of tokens routed
         # to e) * (mean router prob of e); minimised by uniform routing.

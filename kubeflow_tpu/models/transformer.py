@@ -84,10 +84,12 @@ class TransformerConfig:
     moe_capacity_factor: float = 1.25
     moe_aux_coef: float = 0.01
     # GShard routing group (tokens); dispatch-einsum cost per token is
-    # proportional to it, capacity granularity inversely.  On-chip sweep
-    # at the bench config (4 experts, ms/step): 128 -> 516, 256 -> 471,
-    # 512 -> 495, 1024 -> 528 — see models/moe.py.
-    moe_group_size: int = 256
+    # proportional to it, capacity granularity inversely.  On-chip
+    # sweeps at the bench config (4 experts, ms/step): round-3 G-major
+    # einsums 128 -> 516, 256 -> 471, 512 -> 495, 1024 -> 528; after
+    # the round-4 E-major rank-3 rework 64 -> 423, 128 -> 421,
+    # 256 -> 427 — see models/moe.py for why the optimum moved.
+    moe_group_size: int = 128
     # MoE dispatch/combine implementation: "einsum" (GShard one-hot
     # contractions — the measured on-chip winner, MXU-bound) or
     # "gather" (slot-index scatter + row gathers, no O(g) contraction,

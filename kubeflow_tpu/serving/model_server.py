@@ -505,7 +505,7 @@ class BucketedLMBatcher:
     occupies (not the bucket spacing) — a losing trade only when the
     length distribution is wide and batched decode is compute-bound,
     and a winning one whenever round trips or batch count dominate,
-    as in interactive decode (measured 5.8x at the bench config).
+    as in interactive decode (measured ~6x at the bench config).
 
     Buckets still bound the program count: one jitted generate program
     per (bucket, allowed batch size) that actually occurs, compiled on
