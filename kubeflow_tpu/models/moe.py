@@ -36,6 +36,14 @@ from flax import linen as nn
 kernel_init = nn.initializers.lecun_normal()
 
 
+def default_group_size(impl: str) -> int:
+    """Measured per-impl routing-group optimum (v5e bench config):
+    einsum 128 (dispatch one-hot cost is linear in the group), gather
+    256 (smaller groups degrade its scatter/gather, 28.1k vs 31.0k
+    tok/s).  Single source of truth for the group_size=0 sentinel."""
+    return 256 if impl == "gather" else 128
+
+
 class MoEMLP(nn.Module):
     """Drop-in replacement for the dense SwiGLU MLP block."""
 
@@ -47,12 +55,12 @@ class MoEMLP(nn.Module):
     # Routing group size (tokens): dispatch cost per token is
     # proportional to it, capacity granularity (and drop variance)
     # inversely.  The effective size is a divisor of the token count <=
-    # this (gcd fallback), so any batch shape works.  Swept on v5e: 256
-    # was best under the round-3 G-major einsums; with E-major rank-3
-    # expert matmuls 128 wins (MFU 0.404 vs 0.399, dispatch one-hot
-    # cost halved) and 64 plateaus (0.402) while shrinking per-group
-    # statistics, so 128 is the default.
-    group_size: int = 128
+    # this (gcd fallback), so any batch shape works.  0 = each impl's
+    # measured optimum (default_group_size above).  Sweep on v5e with
+    # the E-major rank-3 einsums: 128 wins (MFU 0.404 vs 0.399 at 256,
+    # dispatch one-hot cost halved) and 64 plateaus (0.402) while
+    # shrinking per-group statistics.
+    group_size: int = 0
     dtype: object = jnp.bfloat16
     # Dispatch/combine implementation:
     #   "einsum" — GShard one-hot einsums: dispatch builds a [g, E, C]
@@ -67,11 +75,10 @@ class MoEMLP(nn.Module):
     # Swept on-chip at the bench config (v5e, 4 experts, top-2,
     # artifacts/r4_onchip_sweeps.log): einsum 38.8k tok/s (MFU 0.404,
     # E-major rank-3 form, group 128) vs gather 31.0k (0.322, at its
-    # own best group 256 — gather drops to 28.1k at 128, so set
-    # group_size=256 when selecting it).  The asymptotic-MAC win loses
-    # to XLA's dynamic-gather lowering (vector-unit + HBM bound); the
-    # one-hot contractions ride the MXU.  Default follows the
-    # measurement.
+    # own best group 256 — each impl runs its optimum via the
+    # group_size=0 sentinel).  The asymptotic-MAC win loses to XLA's
+    # dynamic-gather lowering (vector-unit + HBM bound); the one-hot
+    # contractions ride the MXU.  Default follows the measurement.
     impl: str = "einsum"
 
     @nn.compact
@@ -79,15 +86,16 @@ class MoEMLP(nn.Module):
         cfg_e, d, f = self.num_experts, self.d_model, self.d_ff
         b, s, _ = x.shape
         n_tokens = b * s
+        group_size = self.group_size or default_group_size(self.impl)
         # Largest divisor of n_tokens <= group_size (bounded scan at
         # trace time; a gcd shortcut degenerates badly for token counts
         # sharing few factors with a power-of-two group size — e.g.
         # gcd(2046, 256) = 2 would give per-2-token groups whose
         # capacity clamps to top_k, inflating expert compute to E slots
         # per token and never dropping anything).
-        g = next(cand for cand in range(min(self.group_size, n_tokens), 0, -1)
+        g = next(cand for cand in range(min(group_size, n_tokens), 0, -1)
                  if n_tokens % cand == 0)
-        if g < min(self.group_size, n_tokens) // 4:
+        if g < min(group_size, n_tokens) // 4:
             # The divisor scan itself can degenerate (prime-ish token
             # counts collapse g to 1-2): capacity then clamps to top_k
             # and expert compute/memory inflates by up to
@@ -97,7 +105,7 @@ class MoEMLP(nn.Module):
 
             warnings.warn(
                 f"MoE routing group degenerated: n_tokens={n_tokens} has "
-                f"no divisor near group_size={self.group_size} (fitted "
+                f"no divisor near group_size={group_size} (fitted "
                 f"g={g}); per-group capacity clamps to top_k and expert "
                 f"compute inflates by up to num_experts/top_k x.  Choose "
                 f"batch*seq with a divisor close to group_size.",
@@ -206,20 +214,17 @@ class MoEMLP(nn.Module):
             expert_in = jnp.einsum(
                 "gnec,gnd->egcd", dispatch, tokens.astype(jnp.bfloat16))
 
-        def expert_mlp(x, spec, constraint):
+        def expert_mlp(x, spec, x_axes, h_axes):
             """Batched SwiGLU over the expert slot tensor; `spec` is the
-            input/activation einsum subscripts (the down-projection
-            transposes them), `constraint` the matching logical axes
-            with "mlp" substituted on the f dim."""
-            x = nn.with_logical_constraint(x, constraint)
+            up-projection einsum (its transpose is the down-projection),
+            `x_axes`/`h_axes` the logical shardings of the input and
+            the f-dim activations."""
+            x = nn.with_logical_constraint(x, x_axes)
             lhs, out = spec.split("->")
             lhs = lhs.split(",")[0]
             gate = jnp.einsum(spec, x, wi[:, 0].astype(dt))
             up = jnp.einsum(spec, x, wi[:, 1].astype(dt))
-            h = nn.silu(gate) * up
-            h = nn.with_logical_constraint(
-                h, tuple("mlp" if c == "d" else a
-                         for c, a in zip(lhs, constraint)))
+            h = nn.with_logical_constraint(nn.silu(gate) * up, h_axes)
             return jnp.einsum(f"{out},efd->{lhs}", h, wo.astype(dt))
 
         if self.impl == "gather":
@@ -227,7 +232,8 @@ class MoEMLP(nn.Module):
             # d], and the combine row-gathers index it per group.
             expert_out = expert_mlp(
                 expert_in, "gecd,edf->gecf",
-                (None, "expert", None, None))
+                (None, "expert", None, None),
+                (None, "expert", None, "mlp"))
         else:
             # [E, G*C, d] — one big MXU batch, expert axis outermost
             # end to end (dispatch through combine).  The G and C dims
@@ -237,6 +243,7 @@ class MoEMLP(nn.Module):
             expert_out = expert_mlp(
                 expert_in.reshape(cfg_e, n_groups * capacity, d),
                 "end,edf->enf", ("expert", None, None),
+                ("expert", None, "mlp"),
             ).reshape(cfg_e, n_groups, capacity, d)
 
         if self.impl == "gather":

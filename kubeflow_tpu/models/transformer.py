@@ -84,12 +84,16 @@ class TransformerConfig:
     moe_capacity_factor: float = 1.25
     moe_aux_coef: float = 0.01
     # GShard routing group (tokens); dispatch-einsum cost per token is
-    # proportional to it, capacity granularity inversely.  On-chip
-    # sweeps at the bench config (4 experts, ms/step): round-3 G-major
-    # einsums 128 -> 516, 256 -> 471, 512 -> 495, 1024 -> 528; after
-    # the round-4 E-major rank-3 rework 64 -> 423, 128 -> 421,
-    # 256 -> 427 — see models/moe.py for why the optimum moved.
-    moe_group_size: int = 128
+    # proportional to it, capacity granularity inversely.  0 = the
+    # measured per-impl optimum (einsum 128, gather 256 — each impl's
+    # best from the on-chip sweeps; pinning one shared default would
+    # silently pair the other impl with its worst config).  Sweep
+    # history at the bench config (4 experts, ms/step): round-3
+    # G-major einsums 128 -> 516, 256 -> 471, 512 -> 495,
+    # 1024 -> 528; after the round-4 E-major rank-3 rework 64 -> 423,
+    # 128 -> 421, 256 -> 427 — see models/moe.py for why the optimum
+    # moved.
+    moe_group_size: int = 0
     # MoE dispatch/combine implementation: "einsum" (GShard one-hot
     # contractions — the measured on-chip winner, MXU-bound) or
     # "gather" (slot-index scatter + row gathers, no O(g) contraction,
@@ -136,6 +140,16 @@ class TransformerConfig:
                     "pipeline_microbatches cannot nest ring attention; "
                     "use attention='dot' or 'flash' inside pipeline "
                     "stages")
+
+    def resolved_moe_group_size(self) -> int:
+        """The routing group actually used: the configured value, or
+        each impl's measured on-chip optimum when left at 0 (the
+        single source of truth is models/moe.py default_group_size)."""
+        if self.moe_group_size:
+            return self.moe_group_size
+        from kubeflow_tpu.models.moe import default_group_size
+
+        return default_group_size(self.moe_impl)
 
     def flops_per_token(self) -> float:
         """Forward useful FLOPs per token (2*params matmul convention +
@@ -303,7 +317,8 @@ class Block(nn.Module):
                 d_model=cfg.d_model, d_ff=cfg.d_ff,
                 num_experts=cfg.moe_experts, top_k=cfg.moe_top_k,
                 capacity_factor=cfg.moe_capacity_factor,
-                group_size=cfg.moe_group_size, dtype=cfg.dtype,
+                group_size=cfg.resolved_moe_group_size(),
+                dtype=cfg.dtype,
                 impl=cfg.moe_impl,
                 name="moe",
             )(y)
