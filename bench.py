@@ -987,7 +987,7 @@ def main() -> None:
                          "0 = per-impl measured optimum (einsum 128, "
                          "gather 256)")
     ap.add_argument("--remat-policy", default="nobatch",
-                    choices=["nobatch", "dots"],
+                    choices=["nobatch", "dots", "minimal"],
                     help="lm remat checkpoint policy (on-chip sweep knob)")
     ap.add_argument("--no-save-attn", action="store_true",
                     help="drop flash (out, lse) residuals at the remat "

@@ -339,6 +339,12 @@ def _remat_policy(cfg: TransformerConfig):
         "dots": jax.checkpoint_policies.dots_saveable,
         "nobatch":
             jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+        # Save nothing but the (composed-below) flash residuals —
+        # every matmul recomputes in the bwd.  The long-context
+        # policy: at seq 32k the nobatch-saved MLP activations alone
+        # are 2 x 2.06 GB and the program OOMs a 16 GB v5e; minimal
+        # fits (measured in BASELINE.md's long-context ladder).
+        "minimal": jax.checkpoint_policies.nothing_saveable,
     }
     if cfg.remat_policy not in policies:
         raise ValueError(

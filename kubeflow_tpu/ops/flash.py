@@ -77,8 +77,12 @@ def _flash_fwd_kernel(
     # zero weight) — serving-side forward-only path.
     if masked:
         start_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = refs
+        # The whole [bh, 1] start table rides in SMEM (a (1, 1)-blocked
+        # VMEM input fails the TPU lowering's 8x128-tile rule); each
+        # instance reads its own row.
+        row_start = start_ref[pl.program_id(0), 0]
     else:
-        start_ref = None
+        row_start = None
         o_ref, lse_ref, m_scr, l_scr, acc_scr = refs
     qi = pl.program_id(1)
     ki = pl.program_id(2)
@@ -96,7 +100,7 @@ def _flash_fwd_kernel(
     live = (not causal) or (q_start + block_q - 1 >= k_start)
     if masked:
         # Blocks entirely before the first valid key are dead.
-        live = live & (k_start + block_k - 1 >= start_ref[0, 0])
+        live = live & (k_start + block_k - 1 >= row_start)
 
     @pl.when(live)
     def _compute():
@@ -118,7 +122,7 @@ def _flash_fwd_kernel(
                 jnp.int32, (block_q, block_k), 0)
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
         if masked:
-            s = jnp.where(k_pos >= start_ref[0, 0], s, NEG_INF)
+            s = jnp.where(k_pos >= row_start, s, NEG_INF)
 
         m_prev = m_scr[:, :1]                       # [BQ, 1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
@@ -185,7 +189,9 @@ def _flash_fwd_bhsd(
     ]
     inputs = [q, k, v]
     if masked:
-        in_specs.append(pl.BlockSpec((1, 1), lambda b, qi, ki: (b, 0)))
+        # Whole table, SMEM: per-row scalars drive block liveness, and
+        # a (1, 1) VMEM block violates the TPU 8x128 tiling rule.
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
         inputs.append(kv_start.astype(jnp.int32))
     o, lse = pl.pallas_call(
         kernel,

@@ -68,6 +68,32 @@ class TestForward:
             atol=1e-5,
         )
 
+    def test_remat_minimal_policy_grads_match(self):
+        """The long-context `minimal` policy (save nothing, recompute
+        every matmul in the bwd) must change memory only — grads match
+        the default policy's."""
+        from kubeflow_tpu.models.transformer import lm_task
+
+        toks = jnp.asarray(
+            np.arange(2 * 8, dtype=np.int32).reshape(2, 8)
+            % CFG.vocab_size)
+        rng = jax.random.key(1)
+        grads = {}
+        for policy in ("nobatch", "minimal"):
+            cfg = TransformerConfig(
+                **{**CFG.__dict__, "remat": True, "remat_policy": policy})
+            init_fn, loss_fn = lm_task(cfg)
+            params, mutable = init_fn(jax.random.key(0))
+            g = jax.grad(
+                lambda p: loss_fn(p, mutable, {"tokens": toks}, rng)[0]
+            )(params)
+            grads[policy] = [
+                np.asarray(x) for x in jax.tree.leaves(nn.unbox(g))]
+        assert grads["nobatch"] and (
+            len(grads["nobatch"]) == len(grads["minimal"]))
+        for a, b in zip(grads["nobatch"], grads["minimal"]):
+            np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-6)
+
 
 class TestShardedTraining:
     def test_tp_sharded_params_and_loss_decreases(self, devices):
