@@ -334,6 +334,7 @@ def bench_lm(args, devices, n_chips, on_tpu):
             moe_group_size=args.moe_group_size,
             moe_impl=args.moe_impl,
             ce_dtype=args.ce_dtype,
+            ce_chunk=args.ce_chunk,
         )
         batch = args.batch or sizes["batch"] * n_chips
     else:  # tiny hermetic config for --fake-devices runs
@@ -348,6 +349,7 @@ def bench_lm(args, devices, n_chips, on_tpu):
             moe_group_size=args.moe_group_size,
             moe_impl=args.moe_impl,
             ce_dtype=args.ce_dtype,
+            ce_chunk=args.ce_chunk,
         )
         batch = args.batch or 4 * n_chips
     print(
@@ -963,6 +965,10 @@ def main() -> None:
                          "expert axis shards it")
     ap.add_argument("--lm-size", default="188m", choices=["188m", "470m"],
                     help="lm bench model size preset (on-TPU only)")
+    ap.add_argument("--ce-chunk", type=int, default=0,
+                    help="lm: sequence-chunked CE (positions per chunk; "
+                         "0 = unchunked) — no [b, s, vocab] logits in "
+                         "HBM, the seq-128k memory lever")
     ap.add_argument("--ce-dtype", default="f32",
                     choices=["f32", "compute"],
                     help="lm cross-entropy input precision: 'compute' "

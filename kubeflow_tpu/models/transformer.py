@@ -109,6 +109,15 @@ class TransformerConfig:
     # half the bytes.  Loss differs only in bf16 rounding of individual
     # logits (reductions still accumulate f32).
     ce_dtype: str = "f32"
+    # Sequence-chunked cross-entropy: >0 unembeds and evaluates the
+    # loss `ce_chunk` positions at a time under a rematerialized
+    # lax.scan, so no [b, s, vocab] logits tensor ever exists in HBM
+    # (peak extra memory is O(b * chunk * vocab)).  The long-context
+    # loss lever above ce_dtype: at seq 128k even bf16 logits are
+    # 8.4 GB.  The effective chunk is the largest divisor of s <= this
+    # (any s works); numerics follow ce_dtype within each chunk.
+    # 0 = unchunked.
+    ce_chunk: int = 0
     # Pipeline parallelism: >0 streams this many microbatches through the
     # layer stack under the GPipe schedule (parallel/pipeline.py) whenever
     # the model's mesh has a `pipeline` axis > 1.  The nn.scan param stack
@@ -361,7 +370,12 @@ def _remat_policy(cfg: TransformerConfig):
 
 
 class Transformer(nn.Module):
-    """LM: token ids [b, s] -> logits [b, s, vocab]."""
+    """LM: token ids [b, s] -> logits [b, s, vocab].
+
+    With ``return_hidden=True`` the unembed projection is skipped and
+    the call returns ``(hidden [b, s, d], unembed [v, d] or [d, v])``
+    instead — the chunked-CE contract (lm_task, cfg.ce_chunk > 0).
+    """
 
     cfg: TransformerConfig
     mesh: Optional[jax.sharding.Mesh] = None
@@ -374,7 +388,8 @@ class Transformer(nn.Module):
         positions: Optional[jax.Array] = None,
         segment_ids: Optional[jax.Array] = None,
         deterministic: bool = True,
-    ) -> jax.Array:
+        return_hidden: bool = False,
+    ) -> "jax.Array | Tuple[jax.Array, jax.Array]":
         cfg = self.cfg
         embed = self.param(
             "embed",
@@ -420,15 +435,22 @@ class Transformer(nn.Module):
 
         x = RMSNorm(dtype=cfg.dtype, name="final_norm")(x)
         if cfg.tied_embeddings:
-            logits = jnp.einsum("bse,ve->bsv", x, embed.astype(cfg.dtype))
+            unembed = embed
         else:
-            w_out = self.param(
+            unembed = self.param(
                 "w_out",
                 nn.with_logical_partitioning(kernel_init, ("embed", "vocab")),
                 (cfg.d_model, cfg.vocab_size),
                 jnp.float32,
             )
-            logits = jnp.einsum("bse,ev->bsv", x, w_out.astype(cfg.dtype))
+        if return_hidden:
+            # Sequence-chunked CE (lm_task, cfg.ce_chunk > 0): the
+            # caller unembeds chunk by chunk so the [b, s, vocab]
+            # logits never materialize — at seq 128k they are 8.4 GB
+            # even in bf16, past what remat can claw back.
+            return x, unembed.astype(cfg.dtype)
+        spec = "bse,ve->bsv" if cfg.tied_embeddings else "bse,ev->bsv"
+        logits = jnp.einsum(spec, x, unembed.astype(cfg.dtype))
         if cfg.ce_dtype == "f32":
             return logits.astype(jnp.float32)
         return logits  # compute dtype; lm_task fuses the f32 reductions
@@ -523,47 +545,99 @@ def lm_task(cfg: TransformerConfig, mesh=None):
         variables = model.init(rng, toks)
         return variables["params"], {}
 
+    def ce_per_position(lg, tgt):
+        """Per-position CE [*, n] from logits [*, n, v], honoring
+        cfg.ce_dtype (shared by the unchunked and chunked paths)."""
+        if cfg.ce_dtype == "f32":
+            return optax.softmax_cross_entropy_with_integer_labels(
+                lg.astype(jnp.float32), tgt)
+        # Fused CE on compute-dtype logits: each reduction upcasts
+        # per element inside its own fusion, so the only [*, n, v]
+        # tensors in HBM are the compute-dtype logits — no 4-byte
+        # copy, and the backward's softmax cotangent stays narrow.
+        m = jax.lax.stop_gradient(jnp.max(lg, axis=-1, keepdims=True))
+        # Subtract in f32 (exact; the casts fuse into the reduce — no
+        # [*, n, v] f32 tensor hits HBM): the only precision
+        # difference vs the f32 path is the narrow storage of the
+        # logits themselves.
+        lse = jnp.log(jnp.sum(
+            jnp.exp(lg.astype(jnp.float32) - m.astype(jnp.float32)),
+            axis=-1,
+        )) + m[..., 0].astype(jnp.float32)
+        target_logit = jnp.take_along_axis(
+            lg, tgt[..., None], axis=-1
+        )[..., 0].astype(jnp.float32)
+        return lse - target_logit
+
+    def chunked_ce(hidden, unembed, tokens):
+        """Mean next-token CE without materializing [b, s, vocab]:
+        unembed + loss run `chunk` positions at a time under a
+        rematerialized scan (backward recomputes each chunk's logits).
+        The final position has no target; a zero weight masks it so
+        chunks can tile all s positions regardless of divisibility of
+        s - 1 (at seq 128k, s - 1 is prime)."""
+        b, s = tokens.shape
+        chunk = next(c for c in range(min(cfg.ce_chunk, s), 0, -1)
+                     if s % c == 0)
+        if chunk < min(cfg.ce_chunk, s) // 4:
+            # The divisor scan degenerates for prime-ish s (chunk
+            # collapses toward 1 and the loss becomes an s-iteration
+            # scan of single-position unembeds — looks like a hang).
+            # Trace-time warning so the config is fixed, not silently
+            # paid every step (same contract as the MoE group fit).
+            import warnings
+
+            warnings.warn(
+                f"ce_chunk degenerated: seq_len={s} has no divisor "
+                f"near ce_chunk={cfg.ce_chunk} (fitted {chunk}); the "
+                f"chunked CE scan runs {s // chunk} iterations.  "
+                f"Choose a sequence length with a divisor close to "
+                f"ce_chunk.",
+                stacklevel=2,
+            )
+        n = s // chunk
+        targets = jnp.concatenate(
+            [tokens[:, 1:], jnp.zeros((b, 1), tokens.dtype)], axis=1)
+        weights = jnp.concatenate(
+            [jnp.ones((b, s - 1), jnp.float32),
+             jnp.zeros((b, 1), jnp.float32)], axis=1)
+        spec = "bce,ve->bcv" if cfg.tied_embeddings else "bce,ev->bcv"
+
+        def body(total, inp):
+            hc, tc, wc = inp
+            lg = jnp.einsum(spec, hc, unembed)
+            return total + jnp.sum(ce_per_position(lg, tc) * wc), None
+
+        total, _ = jax.lax.scan(
+            jax.checkpoint(body),
+            jnp.zeros((), jnp.float32),
+            (hidden.reshape(b, n, chunk, -1).transpose(1, 0, 2, 3),
+             targets.reshape(b, n, chunk).transpose(1, 0, 2),
+             weights.reshape(b, n, chunk).transpose(1, 0, 2)),
+        )
+        return total / (b * (s - 1))
+
     def loss_fn(params, mutable, batch, rng):
         del mutable
         tokens = batch["tokens"]
+        apply_kwargs = dict(
+            deterministic=False, rngs={"dropout": rng},
+            return_hidden=cfg.ce_chunk > 0,
+        )
         if cfg.moe_experts > 0:
-            logits, sown = model.apply(
+            out, sown = model.apply(
                 {"params": params}, tokens,
-                deterministic=False,
-                rngs={"dropout": rng},
-                mutable=["losses"],
+                mutable=["losses"], **apply_kwargs,
             )
         else:
-            logits = model.apply(
-                {"params": params}, tokens,
-                deterministic=False,
-                rngs={"dropout": rng},
-            )
-        targets = tokens[:, 1:]
-        if cfg.ce_dtype == "f32":
-            loss = optax.softmax_cross_entropy_with_integer_labels(
-                logits[:, :-1], targets
-            ).mean()
+            out = model.apply({"params": params}, tokens, **apply_kwargs)
+        if cfg.ce_chunk > 0:
+            hidden, unembed = out
+            loss = chunked_ce(hidden, unembed, tokens)
         else:
-            # Fused CE on compute-dtype logits: each reduction upcasts
-            # per element inside its own fusion, so the only [b, s, v]
-            # tensors in HBM are the compute-dtype logits — no 4-byte
-            # copy, and the backward's softmax cotangent stays narrow.
-            lg = logits[:, :-1]
-            m = jax.lax.stop_gradient(
-                jnp.max(lg, axis=-1, keepdims=True))
-            # Subtract in f32 (exact; the casts fuse into the reduce —
-            # no [b, s, v] f32 tensor hits HBM): the only precision
-            # difference vs the f32 path is the bf16 storage of the
-            # logits themselves.
-            lse = jnp.log(jnp.sum(
-                jnp.exp(lg.astype(jnp.float32)
-                        - m.astype(jnp.float32)), axis=-1,
-            )) + m[..., 0].astype(jnp.float32)
-            target_logit = jnp.take_along_axis(
-                lg, targets[..., None], axis=-1
-            )[..., 0].astype(jnp.float32)
-            loss = (lse - target_logit).mean()
+            logits = out
+            loss = ce_per_position(
+                logits[:, :-1], tokens[:, 1:]).mean()
         metrics = {"perplexity": jnp.exp(loss)}
         if cfg.moe_experts > 0:
             aux = sum(jnp.sum(v) for v in

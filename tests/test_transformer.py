@@ -279,3 +279,40 @@ class TestFusedCE:
 
         with pytest.raises(ValueError, match="ce_dtype"):
             TransformerConfig(ce_dtype="fp32")
+
+    def test_chunked_ce_matches_unchunked(self):
+        """ce_chunk > 0 (no [b, s, vocab] logits in HBM, the seq-128k
+        memory lever) must match the unchunked loss AND grads in both
+        ce_dtype modes — including a chunk that does not divide s
+        (divisor fallback: s=16, ce_chunk=6 -> effective 4).  On an
+        f32 model the paths differ only by reassociation; a bf16
+        model adds chunk-boundary rounding, covered by the loss-level
+        bf16 check in test_compute_dtype_ce_close_on_bf16_model."""
+        rng = np.random.RandomState(7)
+        toks = jnp.asarray(rng.randint(0, CFG.vocab_size, (2, 16)),
+                           jnp.int32)
+        for mode in ("f32", "compute"):
+            results = {}
+            for chunk in (0, 6):
+                cfg = TransformerConfig(
+                    **{**CFG.__dict__, "dtype": jnp.float32,
+                       "ce_dtype": mode, "ce_chunk": chunk})
+                init_fn, loss_fn = lm_task(cfg)
+                params, _ = init_fn(jax.random.key(0))
+
+                def scalar_loss(p, loss_fn=loss_fn):
+                    loss, _ = loss_fn(p, {}, {"tokens": toks},
+                                      jax.random.key(1))
+                    return loss
+
+                loss, grads = jax.value_and_grad(scalar_loss)(params)
+                results[chunk] = (
+                    float(loss),
+                    [np.asarray(g, np.float32)
+                     for g in jax.tree_util.tree_leaves(nn.unbox(grads))])
+            np.testing.assert_allclose(
+                results[0][0], results[6][0], rtol=1e-6)
+            assert results[0][1] and (
+                len(results[0][1]) == len(results[6][1]))
+            for a, b in zip(results[0][1], results[6][1]):
+                np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
