@@ -727,22 +727,40 @@ def bench_lm_decode(args, devices, n_chips, on_tpu):
         # a new program.
         from kubeflow_tpu.serving.model_server import MicroBatcher
 
-        mb = MicroBatcher(
-            server.get("lm").predict, max_batch_size=batch,
-            batch_timeout_s=0.02, allowed_batch_sizes=[1, batch],
-            in_flight=2, name="lm",
-        )
         n_clients, per_client = batch, 2 if on_tpu else 1
-        batcher_req_s, mb_stats, mb_failures = closed_loop_clients(
-            mb,
+
+        def median_trials(make_batcher, make_inputs, label):
+            """Median req/s over repeated closed-loop windows, with the
+            MEDIAN trial's batcher stats (a single short window through
+            the tunnel spreads ~±20%; pairing the median throughput
+            with another trial's mean batch size would misdescribe the
+            reported measurement).  Failures accumulate across trials.
+            """
+            trials, failures = [], 0
+            for _ in range(3 if on_tpu else 1):
+                batcher = make_batcher()
+                req_s, stats, fails = closed_loop_clients(
+                    batcher, make_inputs, n_clients, per_client)
+                batcher.close()
+                failures += fails
+                trials.append((req_s, stats))
+            trials.sort(key=lambda t: t[0])
+            req_s, stats = trials[len(trials) // 2]
+            if failures:
+                print(f"{label}: {failures} failed requests",
+                      file=sys.stderr)
+            return req_s, stats
+
+        batcher_req_s, mb_stats = median_trials(
+            lambda: MicroBatcher(
+                server.get("lm").predict, max_batch_size=batch,
+                batch_timeout_s=0.02, allowed_batch_sizes=[1, batch],
+                in_flight=2, name="lm",
+            ),
             lambda: {"tokens": rng.randint(
                 1, cfg.vocab_size, size=(1, prompt_len)
             ).astype(np.int32)},
-            n_clients, per_client)
-        mb.close()
-        if mb_failures:
-            print(f"lm batcher: {mb_failures} failed requests",
-                  file=sys.stderr)
+            "lm batcher")
 
         # MIXED-length clients through the BucketedLMBatcher (VERDICT r3
         # item 7): prompts of three different lengths share ONE queue
@@ -798,13 +816,10 @@ def bench_lm_decode(args, devices, n_chips, on_tpu):
                 })
                 jax.block_until_ready(out["tokens"])
 
-        bmb = make_bucketed()
-        mixed_req_s, bmb_stats, bmb_failures = closed_loop_clients(
-            bmb, mixed_inputs, n_clients, per_client)
-        bmb.close()
-        if bmb_failures:
-            print(f"lm bucketed batcher: {bmb_failures} failed requests",
-                  file=sys.stderr)
+        # Same median-of-trials treatment: single windows measured
+        # anywhere from 15 to 33 req/s across runs before this.
+        mixed_req_s, bmb_stats = median_trials(
+            make_bucketed, mixed_inputs, "lm bucketed batcher")
     tok_s_b1 = new_tokens / lat1_s
     tok_s = batch * new_tokens / latb_s
     # Belt over the asarray suspenders: decode steps are SEQUENTIAL
