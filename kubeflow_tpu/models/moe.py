@@ -44,6 +44,29 @@ def default_group_size(impl: str) -> int:
     return 256 if impl == "gather" else 128
 
 
+def fit_divisor(n: int, limit: int, label: str, consequence: str) -> int:
+    """Largest divisor of ``n`` <= ``limit`` — the trace-time tiling
+    fit shared by the MoE routing-group and the chunked-CE scan (a gcd
+    shortcut degenerates badly for counts sharing few factors with a
+    power-of-two limit: gcd(2046, 256) = 2).
+
+    The scan itself can still degenerate for prime-ish ``n`` (the fit
+    collapses toward 1); below limit//4 a trace-time warning names the
+    ``label`` and its ``consequence`` so the config is fixed rather
+    than silently paid every step."""
+    want = min(limit, n)
+    got = next(c for c in range(want, 0, -1) if n % c == 0)
+    if got < want // 4:
+        import warnings
+
+        warnings.warn(
+            f"{label} degenerated: {n} has no divisor near {limit} "
+            f"(fitted {got}).  {consequence}",
+            stacklevel=3,
+        )
+    return got
+
+
 class MoEMLP(nn.Module):
     """Drop-in replacement for the dense SwiGLU MLP block."""
 
@@ -87,30 +110,11 @@ class MoEMLP(nn.Module):
         b, s, _ = x.shape
         n_tokens = b * s
         group_size = self.group_size or default_group_size(self.impl)
-        # Largest divisor of n_tokens <= group_size (bounded scan at
-        # trace time; a gcd shortcut degenerates badly for token counts
-        # sharing few factors with a power-of-two group size — e.g.
-        # gcd(2046, 256) = 2 would give per-2-token groups whose
-        # capacity clamps to top_k, inflating expert compute to E slots
-        # per token and never dropping anything).
-        g = next(cand for cand in range(min(group_size, n_tokens), 0, -1)
-                 if n_tokens % cand == 0)
-        if g < min(group_size, n_tokens) // 4:
-            # The divisor scan itself can degenerate (prime-ish token
-            # counts collapse g to 1-2): capacity then clamps to top_k
-            # and expert compute/memory inflates by up to
-            # num_experts/top_k x.  Trace-time warning so the config is
-            # fixed, not silently paid every step.
-            import warnings
-
-            warnings.warn(
-                f"MoE routing group degenerated: n_tokens={n_tokens} has "
-                f"no divisor near group_size={group_size} (fitted "
-                f"g={g}); per-group capacity clamps to top_k and expert "
-                f"compute inflates by up to num_experts/top_k x.  Choose "
-                f"batch*seq with a divisor close to group_size.",
-                stacklevel=2,
-            )
+        g = fit_divisor(
+            n_tokens, group_size, "MoE routing group",
+            "Per-group capacity clamps to top_k and expert "
+            "compute/memory inflates by up to num_experts/top_k x.  "
+            "Choose batch*seq with a divisor close to group_size.")
         n_groups = n_tokens // g
         capacity = max(
             self.top_k,

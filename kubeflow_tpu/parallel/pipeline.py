@@ -33,16 +33,25 @@ def pipelined_scan(
     x: jax.Array,
     *,
     axis_name: str = PIPELINE,
+    with_aux: bool = False,
 ) -> jax.Array:
     """Run x through L layers, pipeline-parallel.  Call inside shard_map.
 
-    fn: one layer body, fn(params_for_layer, activation) -> activation.
+    fn: one layer body, fn(params_for_layer, activation) -> activation —
+      or, with ``with_aux=True``, -> (activation, aux_scalar).
     stacked_params: pytree with leading dim = layers-per-stage (the global
       [L, ...] stack sharded over `axis_name`, so each stage holds L/S).
     x: microbatched activations [M, mb, ...] (replicated across the
       pipeline axis; the caller shards batch over data axes as usual).
 
     Returns [M, mb, ...] outputs, replicated across the pipeline axis.
+    With ``with_aux=True`` returns ``(outputs, aux)``: the f32 sum of
+    every layer's aux over all (layer, microbatch) pairs, psummed across
+    stages — the MoE load-balance loss thread (VERDICT r4 item 3).  Only
+    VALID schedule steps contribute: each stage runs M + S - 1 loop
+    iterations but owns microbatch t - stage at step t, and the bubble
+    steps compute on stale/zero activations whose aux must not leak into
+    the loss (gradients included — the mask zeroes their cotangents).
     """
     n_stages = jax.lax.psum(1, axis_name)
     stage = jax.lax.axis_index(axis_name)
@@ -51,13 +60,16 @@ def pipelined_scan(
     # stage s -> s+1; the wrap link (S-1 -> 0) carries no live data.
     perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
 
-    def run_stage(act: jax.Array) -> jax.Array:
+    def run_stage(act: jax.Array):
         # Sequential local layers: lax.scan over this stage's param stack.
         def body(carry, layer_params):
+            if with_aux:
+                out, aux = fn(layer_params, carry)
+                return out, aux.astype(jnp.float32)
             return fn(layer_params, carry), None
 
-        out, _ = jax.lax.scan(body, act, stacked_params)
-        return out
+        out, auxs = jax.lax.scan(body, act, stacked_params)
+        return out, (jnp.sum(auxs) if with_aux else None)
 
     # The input stack enters the schedule as an explicitly VARYING f32
     # array (for narrow floats).  Two reasons, both about the transpose:
@@ -76,21 +88,58 @@ def pipelined_scan(
     x_stack = x.astype(jnp.float32) if ride_f32 else x
 
     # Loop carries become varying over the pipeline axis (stage-dependent
-    # values flow through them) even when x enters replicated.
-    vma = tuple({*jax.typeof(x).vma, axis_name})
-    vary = lambda a: jax.lax.pcast(a, vma, to="varying")
+    # values flow through them) even when x enters replicated.  Each
+    # array pcasts only the axes it is MISSING: under the composed
+    # pp x ring shard_map the input is already varying over `sequence`,
+    # and pcast rejects re-adding an axis already in the varying set.
+    vma = {*jax.typeof(x).vma, axis_name}
+
+    def vary(a):
+        missing = tuple(vma - set(jax.typeof(a).vma))
+        return jax.lax.pcast(a, missing, to="varying") if missing else a
+
+    def vary_param(p):
+        # Params replicated over a non-pipeline manual axis (sequence,
+        # under the composed pp x ring shard_map) would otherwise get
+        # their cotangent psum inserted implicitly at each USE site —
+        # in the compute dtype, and a sub-f32 all-reduce inside a
+        # partial-manual region aborts XLA's partitioner (the Shardy
+        # constraint in the reducer trips AllReducePromotion's clone:
+        # "Invalid binary instruction opcode copy").  One explicit
+        # pcast here moves that psum to this boundary, riding f32 for
+        # narrow-float leaves.
+        missing = tuple(vma - set(jax.typeof(p).vma))
+        if not missing:
+            return p
+        narrow = (jnp.issubdtype(p.dtype, jnp.floating)
+                  and jnp.finfo(p.dtype).bits < 32)
+        if narrow:
+            return jax.lax.pcast(
+                p.astype(jnp.float32), missing, to="varying"
+            ).astype(p.dtype)
+        return jax.lax.pcast(p, missing, to="varying")
+
+    stacked_params = jax.tree_util.tree_map(vary_param, stacked_params)
     x_var = vary(x_stack)
     zero_mb = vary(jnp.zeros_like(x[0]))
     ys0 = vary(jnp.zeros(x.shape, in_dtype))
 
+    aux0 = vary(jnp.zeros((), jnp.float32))
+
     def step(t, carry):
-        recv, ys = carry
+        recv, ys, aux_acc = carry
         # Stage 0 injects microbatch t (clamped; masked out when t >= M).
         mb_idx = jnp.clip(t, 0, n_micro - 1)
         injected = jax.lax.dynamic_index_in_dim(
             x_var, mb_idx, keepdims=False).astype(in_dtype)
         inp = jnp.where(stage == 0, injected, recv)
-        out = run_stage(inp)
+        out, aux = run_stage(inp)
+        if with_aux:
+            # This stage owns microbatch t - stage at step t; outside
+            # [0, M) it is a bubble step whose aux is garbage.
+            mb = t - stage
+            aux_acc = aux_acc + jnp.where(
+                (mb >= 0) & (mb < n_micro), aux, 0.0)
         # The last stage owns microbatch t-(S-1) at step t.
         out_idx = jnp.clip(t - (n_stages - 1), 0, n_micro - 1)
         is_valid = (t >= n_stages - 1) & (stage == n_stages - 1)
@@ -98,9 +147,10 @@ def pipelined_scan(
             ys, jnp.where(is_valid, out, ys[out_idx]), out_idx, axis=0
         )
         nxt = jax.lax.ppermute(out, axis_name, perm)
-        return nxt, updated
+        return nxt, updated, aux_acc
 
-    _, ys = jax.lax.fori_loop(0, total_steps, step, (zero_mb, ys0))
+    _, ys, aux_acc = jax.lax.fori_loop(
+        0, total_steps, step, (zero_mb, ys0, aux0))
     # Only the last stage holds real outputs; broadcast them to every
     # stage so downstream (loss) code is stage-agnostic.  The psum rides
     # f32 for sub-f32 floats: XLA's partitioner aborts ("Invalid binary
@@ -111,9 +161,15 @@ def pipelined_scan(
     ys = jnp.where(stage == n_stages - 1, ys, jnp.zeros_like(ys))
     if jnp.issubdtype(ys.dtype, jnp.floating) and \
             jnp.finfo(ys.dtype).bits < 32:
-        return jax.lax.psum(
+        ys = jax.lax.psum(
             ys.astype(jnp.float32), axis_name).astype(ys.dtype)
-    return jax.lax.psum(ys, axis_name)
+    else:
+        ys = jax.lax.psum(ys, axis_name)
+    if with_aux:
+        # Each stage accumulated its OWN layers' aux; the total is the
+        # sum across stages (f32, so no sub-f32 all-reduce detour).
+        return ys, jax.lax.psum(aux_acc, axis_name)
+    return ys
 
 
 def microbatch(x: jax.Array, n_micro: int) -> jax.Array:

@@ -263,7 +263,7 @@ class MicroBatcher:
         finish: Optional[Callable[
             [Dict[str, Any], Any], Dict[str, Any]]] = None,
     ):
-        # Batch-assembly hooks (all-or-none in practice): `group_key`
+        # Batch-assembly hooks (all-or-none, enforced): `group_key`
         # replaces the shape signature — entries with equal keys may
         # share a device batch even when their shapes differ — and
         # `collate` then builds the stacked arrays from the raw inputs
@@ -271,7 +271,17 @@ class MicroBatcher:
         # row's natural shape).  Without hooks, grouping is by exact
         # shape signature and collation is axis-0 concatenation — rows
         # of different shapes can never legally concatenate, which is
-        # why cross-shape batching must bring its own collate.
+        # why cross-shape batching must bring its own collate; a collate
+        # without finish would silently drop the per-row metas, so a
+        # partial hook set is a construction error, not a latent one.
+        hooks = {"group_key": group_key, "collate": collate,
+                 "finish": finish}
+        given = [k for k, v in hooks.items() if v is not None]
+        if given and len(given) != len(hooks):
+            missing = sorted(set(hooks) - set(given))
+            raise ValueError(
+                f"MicroBatcher batch-assembly hooks are all-or-none: "
+                f"got {sorted(given)} without {missing}")
         self._predict = predict
         self._group_key = group_key
         self._collate = collate
@@ -295,6 +305,17 @@ class MicroBatcher:
         self._stopped = False
         self._batch_sizes: Dict[int, int] = {}
         self._requests = 0
+        # Per-stage dispatch-cycle accounting (seconds, cumulative) —
+        # the first thing VERDICT r4 asked for when capacity came in 5x
+        # under the device rate: queue_wait is oldest-entry age at
+        # dispatch, the rest split one _process call.  overlap tracks
+        # how many runners are actually inside _process concurrently
+        # (pipeline depth achieved, not configured).
+        self._cycle = {k: 0.0 for k in (
+            "queue_wait", "collate", "pad", "predict", "to_host",
+            "deliver")}
+        self._in_process = 0
+        self._max_in_process = 0
         from kubeflow_tpu.runtime.prom import REGISTRY
 
         # Registered at construction so the series exists on /metrics
@@ -319,14 +340,28 @@ class MicroBatcher:
             r.start()
 
     def submit(self, inputs: Dict[str, Any]) -> Dict[str, Any]:
-        """One logical request of batch-dim 1 (or [1, ...] rows)."""
-        # Signature computed once, outside the lock: np.asarray on
-        # list-typed payloads (the REST JSON path) is O(payload).
+        """One logical request of batch-dim 1 ([1, ...] rows).
+
+        Enforced here (loudly, to the offending caller only): each
+        entry gets exactly ONE result row back at delivery, so a
+        multi-row submission would silently lose every row but the
+        first.  Hooked batchers (group_key/collate) validate in their
+        own submit (e.g. BucketedLMBatcher)."""
         entry = {"inputs": inputs,
                  "t": time.monotonic(),
                  "event": threading.Event(), "out": None, "err": None}
-        sig = (self._group_key(inputs) if self._group_key is not None
-               else self._shape_sig(inputs))
+        # Signature computed once, outside the lock: np.asarray on
+        # list-typed payloads (the REST JSON path) is O(payload).
+        if self._group_key is not None:
+            sig = self._group_key(inputs)
+        else:
+            sig = self._shape_sig(inputs)
+            for (key, shape, _) in sig:
+                if not shape or shape[0] != 1:
+                    raise ValueError(
+                        f"MicroBatcher.submit takes one row per call: "
+                        f"input {key!r} has shape {shape}; submit rows "
+                        f"separately")
         with self._lock:
             if self._stopped:
                 # After close() the runner threads are gone; an entry
@@ -341,10 +376,14 @@ class MicroBatcher:
         return entry["out"]
 
     def stats(self) -> Dict[str, Any]:
-        """Effective-batch-size distribution over dispatched batches."""
+        """Effective-batch-size distribution over dispatched batches,
+        plus the mean per-batch cost of each dispatch-cycle stage and
+        the achieved pipeline depth (max concurrent _process calls)."""
         with self._lock:
             hist = dict(sorted(self._batch_sizes.items()))
             requests = self._requests
+            cycle = dict(self._cycle)
+            max_overlap = self._max_in_process
         batches = sum(hist.values())
         return {
             "requests": requests,
@@ -352,6 +391,10 @@ class MicroBatcher:
             "batch_size_hist": hist,
             "mean_batch_size": round(requests / batches, 2) if batches
             else 0.0,
+            "cycle_profile_ms": {
+                k: round(v / batches * 1e3, 3) for k, v in cycle.items()
+            } if batches else {},
+            "max_pipeline_depth": max_overlap,
         }
 
     def close(self) -> None:
@@ -429,7 +472,16 @@ class MicroBatcher:
                 self._requests += len(batch)
                 self._size_hist.observe(
                     float(len(batch)), batcher=self._metric_name)
-            self._process(batch)
+                self._cycle["queue_wait"] += (
+                    time.monotonic() - batch[0]["t"])
+                self._in_process += 1
+                self._max_in_process = max(self._max_in_process,
+                                           self._in_process)
+            try:
+                self._process(batch)
+            finally:
+                with self._lock:
+                    self._in_process -= 1
 
     def _pad_size(self, n: int) -> int:
         for size in self.allowed:
@@ -439,35 +491,61 @@ class MicroBatcher:
 
     def _process(self, batch: List[dict]) -> None:
         try:
+            cyc = self._cycle  # float += on dict values is one
+            # BINARY_OP under the interpreter lock; the races are
+            # benign (stats are advisory, read after close in bench)
+            t0 = time.perf_counter()
             metas: Optional[List[Any]] = None
+            n = len(batch)
+            size = self._pad_size(n)
             if self._collate is not None:
                 stacked, metas = self._collate(
                     [e["inputs"] for e in batch])
+                t1 = time.perf_counter()
+                cyc["collate"] += t1 - t0
+                if size > n:
+                    stacked = {
+                        k: np.concatenate(
+                            [v] + [v[:1]] * (size - n), axis=0
+                        ) for k, v in stacked.items()
+                    }
+                t2 = time.perf_counter()
+                cyc["pad"] += t2 - t1
             else:
-                keys = batch[0]["inputs"].keys()
-                stacked = {
-                    k: np.concatenate(
-                        [np.asarray(e["inputs"][k]) for e in batch],
-                        axis=0)
-                    for k in keys
-                }
-            n = len(batch)
-            size = self._pad_size(n)
-            if size > n:
-                stacked = {
-                    k: np.concatenate(
-                        [v] + [v[:1]] * (size - n), axis=0
-                    ) for k, v in stacked.items()
-                }
+                # One preallocated buffer per key, filled row-by-row and
+                # tail-padded in place: the earlier concatenate-of-N
+                # (plus a second concatenate for padding) built the
+                # batch from dozens of small Python-level array ops —
+                # measured 38 ms collate + 62 ms pad per batch-64 cycle
+                # under a 192-client GIL storm, pure assembly overhead
+                # on the serving hot path.
+                stacked = {}
+                for k in batch[0]["inputs"].keys():
+                    first = np.asarray(batch[0]["inputs"][k])
+                    out = np.empty((size,) + first.shape[1:],
+                                   first.dtype)
+                    out[0] = first[0]
+                    for i, e in enumerate(batch[1:], 1):
+                        out[i] = np.asarray(e["inputs"][k])[0]
+                    if size > n:
+                        out[n:] = out[0]
+                    stacked[k] = out
+                t2 = time.perf_counter()
+                cyc["collate"] += t2 - t0
             outputs = self._predict(stacked)
+            t3 = time.perf_counter()
+            cyc["predict"] += t3 - t2
             # One device->host transfer per output key, then row views.
             host = {k: np.asarray(v) for k, v in outputs.items()}
+            t4 = time.perf_counter()
+            cyc["to_host"] += t4 - t3
             for i, e in enumerate(batch):
                 row = {k: v[i:i + 1] for k, v in host.items()}
                 if metas is not None and self._finish is not None:
                     row = self._finish(row, metas[i])
                 e["out"] = row
                 e["event"].set()
+            cyc["deliver"] += time.perf_counter() - t4
         except Exception as exc:
             # Propagate to all waiters still pending.  Rows already
             # delivered (event set) keep their results — a `finish`
@@ -548,10 +626,19 @@ class BucketedLMBatcher:
         }
         return stacked, [bucket - n for n in lengths]
 
-    @staticmethod
-    def _strip(row: Dict[str, Any], pad: int) -> Dict[str, Any]:
+    # Output keys aligned to the FULL padded position axis (pad keys at
+    # the left, like the input tokens), stripped per-row on the way
+    # out.  Any NEW per-position output a loader grows MUST either be
+    # added here (if it spans the padded prompt+completion axis) or be
+    # returned pad-free by the loader (e.g. per-NEW-token logprobs of
+    # shape [b, new] carry no pad and must NOT be listed) — an
+    # unlisted padded key returns silently left-padded.
+    _POSITIONAL_KEYS = ("tokens",)
+
+    @classmethod
+    def _strip(cls, row: Dict[str, Any], pad: int) -> Dict[str, Any]:
         return {
-            k: (v[:, pad:] if k == "tokens" and pad else v)
+            k: (v[:, pad:] if k in cls._POSITIONAL_KEYS and pad else v)
             for k, v in row.items()
         }
 

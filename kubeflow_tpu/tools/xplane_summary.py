@@ -60,6 +60,39 @@ def _categorize(name: str) -> str:
     return "other"
 
 
+def _leaf_line_ms(plane, line) -> float:
+    """Leaf synchronous op time (ms) on one trace line: async DMA and
+    container (module/loop/step) events excluded — the single
+    accounting shared by the printed table and device_busy_ms."""
+    ps = 0.0
+    for ev in line.events:
+        name = plane.event_metadata[ev.metadata_id].name
+        if not _ASYNC.search(name) and not _is_container(name):
+            ps += ev.duration_ps
+    return ps / 1e9
+
+
+def _is_device_plane(plane) -> bool:
+    return "TPU" in plane.name or "device" in plane.name.lower()
+
+
+def device_busy_ms(path: str) -> float:
+    """Leaf-op device busy time (ms) from a ``*.xplane.pb`` trace — the
+    programmatic face of ``summarize_xplane`` for benchmarks that need
+    the device-side truth as a number (bench.py's serving device-ceiling
+    probe).  Uses the same busiest-line / async-excluded accounting as
+    the printed table; returns the busiest device plane's total."""
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2  # lazy
+
+    xs = xplane_pb2.XSpace()
+    with open(path, "rb") as f:
+        xs.ParseFromString(f.read())
+    return max(
+        (max((_leaf_line_ms(p, line) for line in p.lines), default=0.0)
+         for p in xs.planes if _is_device_plane(p)),
+        default=0.0)
+
+
 def summarize_xplane(path: str, top_n: int = 25, steps: int = 1) -> None:
     from tensorflow.tsl.profiler.protobuf import xplane_pb2  # lazy: dev tool
 
@@ -72,21 +105,16 @@ def summarize_xplane(path: str, top_n: int = 25, steps: int = 1) -> None:
               file=sys.stderr)
     div = max(1, steps)
     for p in xs.planes:
-        if "TPU" not in p.name and "device" not in p.name.lower():
+        if not _is_device_plane(p):
             continue
         # Pick the busiest line as the op stream, measured by LEAF
-        # synchronous time only: a DMA line has huge overlapped totals
-        # and a module/step line is one container event spanning the
-        # whole trace — counting either would crown the wrong line and
-        # leave the leaf tables empty.
+        # synchronous time only (_leaf_line_ms): a DMA line has huge
+        # overlapped totals and a module/step line is one container
+        # event spanning the whole trace — counting either would crown
+        # the wrong line and leave the leaf tables empty.
         best_line, best_ms = None, -1.0
         for line in p.lines:
-            ms = 0.0
-            for ev in line.events:
-                name = p.event_metadata[ev.metadata_id].name
-                if not _ASYNC.search(name) and not _is_container(name):
-                    ms += ev.duration_ps
-            ms /= 1e9
+            ms = _leaf_line_ms(p, line)
             if ms > best_ms:
                 best_line, best_ms = line, ms
         if best_line is None or not best_line.events:

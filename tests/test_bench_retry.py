@@ -155,4 +155,105 @@ def test_soft_deadline_skips_tail_but_prints_headline(monkeypatch, capsys):
     record = json.loads(out[0])
     assert record["metric"] == "resnet50_images_per_sec_per_chip"
     assert set(record["detail"]["skipped_sub_benches"]) == {
-        "lm", "serving", "lm_decode", "lm_decode_int8", "data"}
+        "lm", "lm_moe", "serving", "lm_decode", "lm_decode_int8", "data"}
+
+
+def _both_result():
+    """A round-4-shaped --model=both record (driver tail, BENCH_r04)."""
+    return {
+        "metric": "resnet50_images_per_sec_per_chip", "value": 411.2,
+        "unit": "images/sec/chip", "vs_baseline": 0.8,
+        "detail": {
+            "images_per_sec": 411.2, "step_time_ms": 218.0, "mfu": 0.34,
+            "device": "TPU v5 lite",
+            "roofline": {"frac_of_roofline": 0.91},
+            "lm": {"value": 38000, "mfu": 0.55, "seq_len": 2048,
+                   "step_time_ms": 430, "attention": "flash"},
+            "lm_moe": {"value": 41000, "mfu": 0.432, "seq_len": 2048,
+                       "moe_experts": 4, "optimizer": "adafactor"},
+            "serving": {
+                "sustained_ms_per_request": 1.41,
+                "batcher_capacity_requests_per_sec": 142.6,
+                "batcher_small_image": {"requests_per_sec": 482.4},
+                # ballast standing in for the fields that overflowed
+                # the driver tail in round 4
+                "batcher_batch_size_hist": {str(i): i for i in range(64)},
+            },
+            "lm_decode": {"batched_tokens_per_sec": 3479.5,
+                          "filler": "x" * 1200},
+            "lm_decode_int8": {"batched_tokens_per_sec": 4058.0},
+            "data": {"pipeline_native_examples_per_sec": 63962.0,
+                     "native_vs_python_ratio": 1.77},
+        },
+    }
+
+
+def test_headline_summary_fits_driver_tail():
+    """Round 4's driver artifact recorded ``parsed: null`` because the
+    single stdout line exceeded the 2000-char tail.  The summary must
+    carry every north-star metric and fit with room to spare."""
+    summary = bench.headline_summary(_both_result())
+    line = json.dumps(summary)
+    assert len(line) < 1500
+    d = summary["detail"]
+    assert summary["value"] == 411.2
+    assert d["resnet_mfu"] == 0.34
+    assert d["resnet_roofline_frac"] == 0.91
+    assert d["lm_mfu"] == 0.55
+    assert d["moe_mfu"] == 0.432
+    assert d["decode_tokens_per_sec"] == 3479.5
+    assert d["decode_tokens_per_sec_int8"] == 4058.0
+    assert d["serving_batcher_capacity_req_s"] == 142.6
+    assert d["serving_small_image_req_s"] == 482.4
+    assert d["data_native_vs_python"] == 1.77
+    assert d["full_results"] == "artifacts/bench_full.json"
+
+
+def test_emit_big_record_compacts_stdout_keeps_full_blob(
+        tmp_path, monkeypatch, capsys):
+    monkeypatch.chdir(tmp_path)
+    result = _both_result()
+    bench.emit(result)
+    captured = capsys.readouterr()
+    lines = captured.out.strip().splitlines()
+    assert len(lines) == 1
+    assert len(lines[0]) < 2000
+    assert json.loads(lines[0])["detail"]["moe_mfu"] == 0.432
+    full = json.loads((tmp_path / "artifacts/bench_full.json").read_text())
+    assert full == result
+    assert "FULL RESULT:" in captured.err
+
+
+def test_emit_big_single_model_record_keeps_scalar_detail(
+        tmp_path, monkeypatch, capsys):
+    """A large --model=serving record is NOT both-shaped; emit must keep
+    its scalar metrics on stdout and drop only the oversized values."""
+    monkeypatch.chdir(tmp_path)
+    record = {
+        "metric": "serving_predict_sustained_ms", "value": 1.4,
+        "unit": "ms/request", "detail": {
+            "batcher_capacity_requests_per_sec": 173.5,
+            "wire_ceiling_req_s": 204.2,
+            "device_ms_per_batch16": 0.26,
+            "batcher_batch_size_hist": {str(i): i for i in range(400)},
+        },
+    }
+    bench.emit(record)
+    lines = capsys.readouterr().out.strip().splitlines()
+    assert len(lines) == 1 and len(lines[0]) < 2000
+    d = json.loads(lines[0])["detail"]
+    assert d["batcher_capacity_requests_per_sec"] == 173.5
+    assert d["wire_ceiling_req_s"] == 204.2
+    assert d["device_ms_per_batch16"] == 0.26
+    assert d["truncated_keys"] == ["batcher_batch_size_hist"]
+    assert d["full_results"] == "artifacts/bench_full.json"
+
+
+def test_emit_small_record_passes_through(tmp_path, monkeypatch, capsys):
+    monkeypatch.chdir(tmp_path)
+    record = {"metric": "m", "value": 1.0, "unit": "x", "vs_baseline": 0.0,
+              "detail": {}}
+    bench.emit(record)
+    out = capsys.readouterr().out.strip().splitlines()
+    assert len(out) == 1
+    assert json.loads(out[0]) == record

@@ -198,12 +198,118 @@ class TestPipelineParallel:
                                    atol=2e-2, rtol=1e-2)
 
     def test_invalid_combinations_rejected(self):
+        # Dropout is the ONE residual wall (rngs are not threaded through
+        # the GPipe functional body; every shipped config trains at 0).
+        # MoE and ring COMPOSE as of r5 — constructing them must work.
         with pytest.raises(ValueError, match="dropout"):
             TransformerConfig(pipeline_microbatches=2, dropout_rate=0.1)
-        with pytest.raises(ValueError, match="moe"):
-            TransformerConfig(pipeline_microbatches=2, moe_experts=4)
-        with pytest.raises(ValueError, match="ring"):
-            TransformerConfig(pipeline_microbatches=2, attention="ring")
+        TransformerConfig(pipeline_microbatches=2, moe_experts=4)
+        TransformerConfig(pipeline_microbatches=2, attention="ring")
+
+    def test_pipelined_moe_matches_microbatched_sequential(self, devices):
+        """pp x moe (VERDICT r4 item 3): the sown load-balance aux rides
+        the GPipe schedule.  GPipe's semantics ARE per-microbatch: the
+        reference is the mean over microbatches of the sequential
+        model's loss on that microbatch (equal microbatches make the CE
+        part equal full-batch CE, and moe_group_size = tokens-per-
+        microbatch aligns the routing groups, so the only differences
+        are reduction order)."""
+        B, S, M = 8, 16, 4
+        base = dict(
+            vocab_size=64, d_model=32, n_layers=2, n_heads=4,
+            n_kv_heads=2, d_ff=64, head_dim=8, max_seq_len=32,
+            dtype=jnp.float32, moe_experts=4,
+            moe_group_size=(B // M) * S)
+        seq_cfg = TransformerConfig(**base)
+        pp_cfg = TransformerConfig(**base, pipeline_microbatches=M)
+        mesh = MeshSpec(data=2, pipeline=2, expert=2).build(devices)
+        init_seq, loss_seq = lm_task(seq_cfg)
+        _, loss_pp = lm_task(pp_cfg, mesh=mesh)
+        rng = jax.random.key(0)
+        params = init_seq(rng)[0]
+        toks = jnp.asarray(
+            np.random.RandomState(3).randint(0, 64, (B, S)), jnp.int32)
+
+        def ref_loss(p):
+            mbs = toks.reshape(M, B // M, S)
+            return sum(loss_seq(p, {}, {"tokens": mbs[m]}, rng)[0]
+                       for m in range(M)) / M
+
+        def pp_loss(p):
+            return loss_pp(p, {}, {"tokens": toks}, rng)[0]
+
+        with mesh, nn.logical_axis_rules(list(DEFAULT_RULES)):
+            l_pp, g_pp = jax.block_until_ready(
+                jax.jit(jax.value_and_grad(pp_loss))(params))
+        l_ref, g_ref = jax.value_and_grad(ref_loss)(params)
+        np.testing.assert_allclose(float(l_pp), float(l_ref), rtol=1e-5)
+        for a, b in zip(jax.tree_util.tree_leaves(g_pp),
+                        jax.tree_util.tree_leaves(g_ref)):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=1e-4, rtol=1e-3)
+
+    def test_pipelined_ring_matches_microbatched_sequential(self, devices):
+        """pp x ring (VERDICT r4 item 3): ring attention runs per-shard
+        inside the composed {pipeline, sequence}-manual shard_map; ring
+        is exact softmax attention, so the pipelined-ring loss and grads
+        must match the sequential dot-attention reference."""
+        B, S, M = 4, 32, 2
+        base = dict(
+            vocab_size=64, d_model=32, n_layers=2, n_heads=4,
+            n_kv_heads=2, d_ff=64, head_dim=8, max_seq_len=32,
+            dtype=jnp.float32)
+        seq_cfg = TransformerConfig(**base, attention="dot")
+        pp_cfg = TransformerConfig(
+            **base, attention="ring", pipeline_microbatches=M)
+        mesh = MeshSpec(pipeline=2, sequence=2).build(devices[:4])
+        init_seq, loss_seq = lm_task(seq_cfg)
+        _, loss_pp = lm_task(pp_cfg, mesh=mesh)
+        rng = jax.random.key(0)
+        params = init_seq(rng)[0]
+        toks = jnp.asarray(
+            np.random.RandomState(4).randint(0, 64, (B, S)), jnp.int32)
+
+        def ref_loss(p):
+            mbs = toks.reshape(M, B // M, S)
+            return sum(loss_seq(p, {}, {"tokens": mbs[m]}, rng)[0]
+                       for m in range(M)) / M
+
+        def pp_loss(p):
+            return loss_pp(p, {}, {"tokens": toks}, rng)[0]
+
+        with mesh, nn.logical_axis_rules(list(DEFAULT_RULES)):
+            l_pp, g_pp = jax.block_until_ready(
+                jax.jit(jax.value_and_grad(pp_loss))(params))
+        l_ref, g_ref = jax.value_and_grad(ref_loss)(params)
+        np.testing.assert_allclose(float(l_pp), float(l_ref), rtol=1e-5)
+        for a, b in zip(jax.tree_util.tree_leaves(g_pp),
+                        jax.tree_util.tree_leaves(g_ref)):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=2e-4, rtol=1e-3)
+
+    def test_pp_ring_moe_all_compose(self, devices):
+        """The full stack at once — pipeline x sequence x expert on one
+        mesh, ring attention + MoE + GPipe in one program — trains a
+        step to a finite loss with the aux metric threaded through."""
+        cfg = TransformerConfig(
+            vocab_size=64, d_model=32, n_layers=2, n_heads=4,
+            n_kv_heads=2, d_ff=64, head_dim=8, max_seq_len=32,
+            dtype=jnp.bfloat16, attention="ring",
+            pipeline_microbatches=2, moe_experts=2)
+        mesh = MeshSpec(pipeline=2, sequence=2, expert=2).build(devices)
+        init_fn, loss_fn = lm_task(cfg, mesh=mesh)
+        tr = Trainer(
+            init_fn=init_fn, loss_fn=loss_fn, tx=optax.adam(1e-3),
+            mesh=mesh,
+            metrics=MetricsLogger(stream=open("/dev/null", "w")),
+        )
+        state = tr.create_state()
+        step = tr.compile_step()
+        toks = np.arange(4 * 32, dtype=np.int32).reshape(4, 32) % 64
+        state, metrics = step(state, tr.shard_batch({"tokens": toks}))
+        loss = float(jax.block_until_ready(metrics["loss"]))
+        assert np.isfinite(loss), loss
+        assert float(metrics["moe_aux"]) > 0.0
 
     def test_indivisible_batch_rejected(self, devices):
         mesh = MeshSpec(data=1, pipeline=2).build(devices[:2])
