@@ -925,6 +925,46 @@ def bench_lm_decode(args, devices, n_chips, on_tpu):
         # anywhere from 15 to 33 req/s across runs before this.
         mixed_req_s, bmb_stats = median_trials(
             make_bucketed, mixed_inputs, "lm bucketed batcher")
+
+        # Promotion-cost probe (device-side): the SAME prompts decoded
+        # at their natural bucket vs left-padded to an 8x bucket — the
+        # per-step KV span a promoted row pays, and the measured
+        # justification for BucketedLMBatcher's max_promotion_factor
+        # bound (a round-trip-dominated closed loop can't feel this
+        # cost; the device does, every decode step).
+        promotion = {}
+        wide_bucket = 8 * prompt_len
+        if on_tpu and overrides["max_seq_len"] >= wide_bucket + new_tokens:
+            nat_prompts = rng.randint(
+                1, cfg.vocab_size, size=(batch, prompt_len)
+            ).astype(np.int32)
+            padded = np.concatenate(
+                [np.zeros((batch, wide_bucket - prompt_len), np.int32),
+                 nat_prompts], axis=1)
+            plens = np.full((batch,), prompt_len, np.int32)
+
+            def timed_decode(tokens):
+                inp = {"tokens": tokens, "prompt_len": plens}
+                np.asarray(predict_fn(inp)["tokens"])  # compile/warm
+                ts = []
+                for _ in range(3):
+                    t0 = time.perf_counter()
+                    np.asarray(predict_fn(inp)["tokens"])
+                    ts.append(time.perf_counter() - t0)
+                return sorted(ts)[1]
+
+            t_nat = timed_decode(nat_prompts)
+            t_pad = timed_decode(padded)
+            promotion = {
+                "natural_bucket": prompt_len,
+                "promoted_bucket": wide_bucket,
+                "natural_ms": round(t_nat * 1e3, 1),
+                "promoted_ms": round(t_pad * 1e3, 1),
+                "promotion_step_cost_ratio": round(t_pad / t_nat, 2),
+            }
+            print(f"promotion cost: bucket {prompt_len} {t_nat*1e3:.0f} "
+                  f"ms vs promoted {wide_bucket} {t_pad*1e3:.0f} ms "
+                  f"({t_pad/t_nat:.2f}x)", file=sys.stderr)
     tok_s_b1 = new_tokens / lat1_s
     tok_s = batch * new_tokens / latb_s
     # Belt over the asarray suspenders: decode steps are SEQUENTIAL
@@ -965,6 +1005,7 @@ def bench_lm_decode(args, devices, n_chips, on_tpu):
             "batcher_mixed_mean_batch_size":
                 bmb_stats["mean_batch_size"],
             "batcher_mixed_lengths": lengths,
+            **({"promotion_cost": promotion} if promotion else {}),
             **({"quantize": args.quantize} if args.quantize else {}),
             **({"kv_cache": args.kv_cache} if args.kv_cache else {}),
             **({"timing_suspect": True} if timing_suspect else {}),
@@ -1266,7 +1307,8 @@ def main() -> None:
     emit(result)
 
 
-def headline_summary(result: dict) -> dict:
+def headline_summary(result: dict,
+                     full_results: str = "artifacts/bench_full.json") -> dict:
     """Compact one-line summary of a --model=both record.
 
     The driver keeps only the last ~2000 chars of stdout and parses the
@@ -1314,7 +1356,7 @@ def headline_summary(result: dict) -> dict:
                 pick("data", "pipeline_native_examples_per_sec"),
             "data_native_vs_python": pick("data", "native_vs_python_ratio"),
             "skipped_sub_benches": d.get("skipped_sub_benches", []),
-            "full_results": "artifacts/bench_full.json",
+            "full_results": full_results,
         },
     }
     summary["detail"] = {k: v for k, v in summary["detail"].items()
@@ -1322,14 +1364,15 @@ def headline_summary(result: dict) -> dict:
     return summary
 
 
-def shrink_detail(result: dict, limit: int = 1800) -> dict:
+def shrink_detail(result: dict, limit: int = 1800,
+                  full_results: str = "artifacts/bench_full.json") -> dict:
     """Fit a SINGLE-model record into the driver tail: keep as many
     detail keys as fit (smallest first — scalars survive, the big
     histograms/profiles go to the full-results file), and name what was
     dropped.  --model=both records use headline_summary instead (its
     curated cross-sub-bench names beat a greedy keep)."""
     head = {k: v for k, v in result.items() if k != "detail"}
-    kept = {"full_results": "artifacts/bench_full.json"}
+    kept = {"full_results": full_results}
     dropped = []
     budget = limit - len(json.dumps({**head, "detail": kept})) \
         - len('"truncated_keys": ') - 40
@@ -1352,20 +1395,24 @@ def emit(result: dict) -> None:
     import os
 
     blob = json.dumps(result)
+    full_results = "artifacts/bench_full.json"
     try:
         os.makedirs("artifacts", exist_ok=True)
-        with open("artifacts/bench_full.json", "w") as f:
+        with open(full_results, "w") as f:
             f.write(blob + "\n")
     except OSError as e:  # read-only cwd must not kill the capture
         print(f"bench_full.json not written: {e}", file=sys.stderr)
+        # Don't advertise an artifact that doesn't exist — the only
+        # full copy is then the stderr line below.
+        full_results = "stderr (FULL RESULT line)"
     print(f"FULL RESULT: {blob}", file=sys.stderr)
     if len(blob) <= 1800:
         print(blob)
     elif any(k in result.get("detail", {}) for k in
              ("lm", "lm_moe", "serving", "lm_decode", "data")):
-        print(json.dumps(headline_summary(result)))
+        print(json.dumps(headline_summary(result, full_results)))
     else:
-        print(json.dumps(shrink_detail(result)))
+        print(json.dumps(shrink_detail(result, full_results=full_results)))
 
 
 if __name__ == "__main__":

@@ -520,6 +520,7 @@ class MicroBatcher:
                 # under a 192-client GIL storm, pure assembly overhead
                 # on the serving hot path.
                 stacked = {}
+                pad_s = 0.0
                 for k in batch[0]["inputs"].keys():
                     first = np.asarray(batch[0]["inputs"][k])
                     out = np.empty((size,) + first.shape[1:],
@@ -528,10 +529,13 @@ class MicroBatcher:
                     for i, e in enumerate(batch[1:], 1):
                         out[i] = np.asarray(e["inputs"][k])[0]
                     if size > n:
+                        tp = time.perf_counter()
                         out[n:] = out[0]
+                        pad_s += time.perf_counter() - tp
                     stacked[k] = out
                 t2 = time.perf_counter()
-                cyc["collate"] += t2 - t0
+                cyc["collate"] += t2 - t0 - pad_s
+                cyc["pad"] += pad_s
             outputs = self._predict(stacked)
             t3 = time.perf_counter()
             cyc["predict"] += t3 - t2
@@ -589,6 +593,18 @@ class BucketedLMBatcher:
     per (bucket, allowed batch size) that actually occurs, compiled on
     first use.  A uniform-length workload pads to its own bucket and
     behaves exactly as before.
+
+    Promotion is BOUNDED (VERDICT r4 item 7): unbounded promotion is a
+    cliff on a wide length spread — a 128-token prompt co-batched with
+    a 4096-token one pays the 4096 bucket's KV span on every decode
+    step (measured on-chip: see bench.py's promotion-cost probe).
+    ``max_promotion_factor`` partitions the buckets into bands whose
+    largest/smallest ratio stays <= the factor; only requests in the
+    same band share a queue, so a request's worst-case padded bucket is
+    bounded at factor x its own.  The trade is explicit: more bands =
+    tighter per-request KV bound but fewer co-batching partners (a
+    uniform workload is unaffected; a maximally-wide one degrades
+    toward per-band batching).  ``None`` restores the single queue.
     """
 
     def __init__(
@@ -597,13 +613,27 @@ class BucketedLMBatcher:
         *,
         buckets: Optional[List[int]] = None,
         pad_token: int = 0,
+        max_promotion_factor: Optional[float] = 4.0,
         **batcher_kwargs,
     ):
         self.buckets = sorted(buckets or [32, 64, 128, 256, 512, 1024])
         self.pad_token = pad_token
+        # Band id per bucket: a new band starts when the bucket exceeds
+        # factor x the band's smallest member.
+        self._band: Dict[int, int] = {}
+        if max_promotion_factor is None:
+            self._band = {b: 0 for b in self.buckets}
+        else:
+            band, band_min = -1, None
+            for b in self.buckets:
+                if band_min is None or b > band_min * max_promotion_factor:
+                    band, band_min = band + 1, b
+                self._band[b] = band
         self._inner = MicroBatcher(
             predict,
-            group_key=lambda inputs: "lm",
+            group_key=lambda inputs: (
+                "lm", self._band[self.bucket_for(
+                    np.asarray(inputs["tokens"]).shape[-1])]),
             collate=self._collate,
             finish=self._strip,
             **batcher_kwargs)

@@ -103,6 +103,38 @@ def cmd_apply(args: argparse.Namespace) -> int:
     return proc.returncode
 
 
+def cmd_delete(args: argparse.Namespace) -> int:
+    """Render and delete via kubectl — the ``ks delete`` heir
+    (user_guide.md:409,439,489: the reference lifecycle ended with
+    ``ks delete default``).  Tears down the deployed resources; the app
+    state file is untouched (delete is a cluster operation, not an app
+    edit — re-``apply`` restores the same deployment).  With a
+    component name, only that component's manifests are deleted."""
+    app = _load_app(args.app_file)
+    if args.component:
+        have = [c["name"] for c in app.components]
+        if args.component not in have:
+            raise ValueError(
+                f"no component named {args.component!r}; have {have}")
+        sub_app = App(namespace=app.namespace)
+        for c in app.components:
+            if c["name"] == args.component:
+                sub_app.add(c["prototype"], c["name"], **c["params"])
+        app = sub_app
+    manifest = to_yaml(app.render())
+    if args.dry_run:
+        sys.stdout.write(manifest)
+        return 0
+    # --ignore-not-found: deleting an app that is partially deployed
+    # (or torn down twice) is a no-op, not an error — matches kubectl's
+    # own idempotent-teardown convention.
+    proc = subprocess.run(
+        ["kubectl", "delete", "--ignore-not-found", "-f", "-"],
+        input=manifest.encode(),
+    )
+    return proc.returncode
+
+
 def cmd_prototype(args: argparse.Namespace) -> int:
     if args.action == "list":
         for name in default_registry.names():
@@ -167,6 +199,14 @@ def build_parser() -> argparse.ArgumentParser:
     p = sub.add_parser("apply", help="render and kubectl-apply")
     p.add_argument("--dry-run", action="store_true")
     p.set_defaults(func=cmd_apply)
+
+    p = sub.add_parser(
+        "delete",
+        help="render and kubectl-delete (teardown, the ks delete heir)")
+    p.add_argument("component", nargs="?", default=None,
+                   help="only this component (default: the whole app)")
+    p.add_argument("--dry-run", action="store_true")
+    p.set_defaults(func=cmd_delete)
 
     p = sub.add_parser("prototype", help="inspect prototypes")
     psub = p.add_subparsers(dest="action", required=True)

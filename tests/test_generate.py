@@ -238,6 +238,70 @@ def test_bucketed_batcher_mixed_lengths_share_batches():
         mb.close()
 
 
+def test_bucketed_batcher_promotion_is_bounded():
+    """max_promotion_factor (VERDICT r4 item 7): a short prompt must
+    never be co-batched into a bucket more than factor x its own — the
+    per-decode-step KV span is set by the batch bucket, so unbounded
+    promotion makes a 128-token request pay a 4096-token attention span
+    per step on a wide length spread."""
+    from kubeflow_tpu.serving.model_server import BucketedLMBatcher
+
+    widths = []
+
+    def predict(inputs):
+        widths.append(np.asarray(inputs["tokens"]).shape[1])
+        return {"tokens": np.asarray(inputs["tokens"])}
+
+    mb = BucketedLMBatcher(
+        predict, buckets=[32, 128, 512, 4096], max_batch_size=2,
+        batch_timeout_s=0.05, allowed_batch_sizes=[1, 2], name="lmb4")
+    try:
+        import concurrent.futures as cf
+
+        with cf.ThreadPoolExecutor(2) as ex:
+            short = ex.submit(
+                mb.submit, {"tokens": np.ones((1, 100), np.int32)})
+            long = ex.submit(
+                mb.submit, {"tokens": np.ones((1, 3000), np.int32)})
+            short, long = short.result(), long.result()
+        # Separate bands (128 vs 4096 with factor 4) -> separate
+        # dispatches: the short prompt padded to ITS band's bucket.
+        assert sorted(widths) == [128, 4096], widths
+        assert short["tokens"].shape == (1, 100)
+        assert long["tokens"].shape == (1, 3000)
+        assert mb.stats()["batches"] == 2
+    finally:
+        mb.close()
+
+
+def test_bucketed_batcher_unbounded_promotion_shares_one_queue():
+    """max_promotion_factor=None restores the single shared queue: the
+    same spread promotes the short prompt to the long one's bucket."""
+    from kubeflow_tpu.serving.model_server import BucketedLMBatcher
+
+    widths = []
+
+    def predict(inputs):
+        widths.append(np.asarray(inputs["tokens"]).shape[1])
+        return {"tokens": np.asarray(inputs["tokens"])}
+
+    mb = BucketedLMBatcher(
+        predict, buckets=[32, 128, 512, 4096],
+        max_promotion_factor=None, max_batch_size=2,
+        batch_timeout_s=0.2, allowed_batch_sizes=[1, 2], name="lmb5")
+    try:
+        import concurrent.futures as cf
+
+        with cf.ThreadPoolExecutor(2) as ex:
+            outs = list(ex.map(
+                lambda n: mb.submit({"tokens": np.ones((1, n), np.int32)}),
+                [100, 3000]))
+        assert widths == [4096], widths  # one co-batched dispatch
+        assert outs[0]["tokens"].shape == (1, 100)
+    finally:
+        mb.close()
+
+
 def test_bucketed_batcher_oversize_prompt_rejected():
     from kubeflow_tpu.serving.model_server import BucketedLMBatcher
 
