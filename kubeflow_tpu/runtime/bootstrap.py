@@ -116,6 +116,16 @@ def initialize(
     (kubeflow/openmpi/prototypes/openmpi.jsonnet:21).
     """
     env = env or worker_env()
+    # A JAX_PLATFORMS env var is the operator's explicit platform
+    # choice; honor it even on images whose sitecustomize pre-registers
+    # a hardware plugin and pins jax.config.jax_platforms at interpreter
+    # start (which silently overrides the env var — a CPU fake-slice
+    # run of any tool entrypoint would land on the real chip instead).
+    platforms = os.environ.get("JAX_PLATFORMS")
+    if platforms:
+        import jax
+
+        jax.config.update("jax_platforms", platforms)
     if not env.is_distributed:
         log.info("single-process job; skipping jax.distributed")
         return env

@@ -23,7 +23,8 @@ from kubeflow_tpu.serving.model_server import ModelServer
 
 
 def batcher_factory(*, micro_batch_size: int, batch_timeout_s: float,
-                    lm_buckets: str = ""):
+                    lm_buckets: str = "",
+                    lm_max_promotion_factor: float = 4.0):
     """ModelServer.enable_batching factory: picks the batcher per model.
 
     lm_generate models with buckets get the left-padding
@@ -51,8 +52,12 @@ def batcher_factory(*, micro_batch_size: int, batch_timeout_s: float,
         )
         loader = str(model.meta.get("loader", ""))
         if buckets and loader.endswith("lm_generate"):
-            return BucketedLMBatcher(model.predict, buckets=buckets,
-                                     **kwargs)
+            return BucketedLMBatcher(
+                model.predict, buckets=buckets,
+                max_promotion_factor=(lm_max_promotion_factor
+                                      if lm_max_promotion_factor > 0
+                                      else None),
+                **kwargs)
         return MicroBatcher(model.predict, **kwargs)
 
     return build
@@ -82,6 +87,13 @@ def main(argv=None) -> int:
                          "--micro_batch_size on an lm_generate model, "
                          "mixed-length prompts left-pad to these so "
                          "they share batched decode programs")
+    ap.add_argument("--lm_max_promotion_factor", type=float, default=4.0,
+                    help="bound on dispatch-time bucket promotion: only "
+                         "prompts whose buckets are within this factor "
+                         "share a batch (a short prompt then never pays "
+                         "more than factor x its own bucket's KV span "
+                         "per decode step); <=0 = unbounded, one "
+                         "shared queue")
     args = ap.parse_args(argv)
 
     logging.basicConfig(level=logging.INFO, stream=sys.stderr)
@@ -94,6 +106,7 @@ def main(argv=None) -> int:
                 micro_batch_size=args.micro_batch_size,
                 batch_timeout_s=args.batch_timeout_ms / 1e3,
                 lm_buckets=args.lm_buckets,
+                lm_max_promotion_factor=args.lm_max_promotion_factor,
             ),
         )
         logging.info("request batching on: size<=%d, window %.1f ms%s",

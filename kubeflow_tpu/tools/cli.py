@@ -88,19 +88,36 @@ def cmd_show(args: argparse.Namespace) -> int:
     return 0
 
 
-def cmd_apply(args: argparse.Namespace) -> int:
-    """Render and apply via kubectl — same final hop as the reference's
-    bootstrapper (`ks show default | kubectl apply -f -`,
-    bootstrap/cmd/bootstrap/app/server.go:514-533)."""
+def _component_subset(app: App, name: str) -> App:
+    have = [c["name"] for c in app.components]
+    if name not in have:
+        raise ValueError(f"no component named {name!r}; have {have}")
+    sub_app = App(namespace=app.namespace)
+    for c in app.components:
+        if c["name"] == name:
+            sub_app.add(c["prototype"], c["name"], **c["params"])
+    return sub_app
+
+
+def _render_and_pipe(args: argparse.Namespace, kubectl: List[str]) -> int:
+    """Shared apply/delete flow: load, render (optionally one
+    component), print on --dry-run, else pipe to kubectl."""
     app = _load_app(args.app_file)
+    if getattr(args, "component", None):
+        app = _component_subset(app, args.component)
     manifest = to_yaml(app.render())
     if args.dry_run:
         sys.stdout.write(manifest)
         return 0
-    proc = subprocess.run(
-        ["kubectl", "apply", "-f", "-"], input=manifest.encode(),
-    )
+    proc = subprocess.run(kubectl, input=manifest.encode())
     return proc.returncode
+
+
+def cmd_apply(args: argparse.Namespace) -> int:
+    """Render and apply via kubectl — same final hop as the reference's
+    bootstrapper (`ks show default | kubectl apply -f -`,
+    bootstrap/cmd/bootstrap/app/server.go:514-533)."""
+    return _render_and_pipe(args, ["kubectl", "apply", "-f", "-"])
 
 
 def cmd_delete(args: argparse.Namespace) -> int:
@@ -109,30 +126,12 @@ def cmd_delete(args: argparse.Namespace) -> int:
     ``ks delete default``).  Tears down the deployed resources; the app
     state file is untouched (delete is a cluster operation, not an app
     edit — re-``apply`` restores the same deployment).  With a
-    component name, only that component's manifests are deleted."""
-    app = _load_app(args.app_file)
-    if args.component:
-        have = [c["name"] for c in app.components]
-        if args.component not in have:
-            raise ValueError(
-                f"no component named {args.component!r}; have {have}")
-        sub_app = App(namespace=app.namespace)
-        for c in app.components:
-            if c["name"] == args.component:
-                sub_app.add(c["prototype"], c["name"], **c["params"])
-        app = sub_app
-    manifest = to_yaml(app.render())
-    if args.dry_run:
-        sys.stdout.write(manifest)
-        return 0
-    # --ignore-not-found: deleting an app that is partially deployed
-    # (or torn down twice) is a no-op, not an error — matches kubectl's
-    # own idempotent-teardown convention.
-    proc = subprocess.run(
-        ["kubectl", "delete", "--ignore-not-found", "-f", "-"],
-        input=manifest.encode(),
-    )
-    return proc.returncode
+    component name, only that component's manifests are deleted.
+    --ignore-not-found: deleting an app that is partially deployed (or
+    torn down twice) is a no-op, not an error — kubectl's own
+    idempotent-teardown convention."""
+    return _render_and_pipe(
+        args, ["kubectl", "delete", "--ignore-not-found", "-f", "-"])
 
 
 def cmd_prototype(args: argparse.Namespace) -> int:

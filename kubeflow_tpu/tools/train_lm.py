@@ -36,7 +36,19 @@ def main(argv=None) -> int:
                          "TransformerConfig.ce_dtype)")
     ap.add_argument("--batch-size-per-device", type=int, default=8)
     ap.add_argument("--steps", type=int, default=100)
+    ap.add_argument("--steps-per-call", type=int, default=1,
+                    help="fused train steps per device dispatch "
+                         "(Trainer.fit host-loop fusion)")
     ap.add_argument("--learning-rate", type=float, default=3e-4)
+    ap.add_argument("--warmup-steps", type=int, default=0,
+                    help=">0 = linear warmup to --learning-rate then "
+                         "cosine decay over --steps")
+    ap.add_argument("--optimizer", default="adamw",
+                    choices=["adamw", "adafactor"])
+    ap.add_argument("--moe-capacity-factor", type=float, default=1.25)
+    ap.add_argument("--metrics-out", default="",
+                    help="write the final metrics history as JSON "
+                         "(loss-curve artifact)")
     ap.add_argument("--mesh", default="",
                     help="axis sizes, e.g. 'tensor=4,sequence=2' "
                          "(data absorbs the rest)")
@@ -98,7 +110,9 @@ def main(argv=None) -> int:
         n_layers=args.n_layers, n_heads=args.n_heads,
         n_kv_heads=args.n_kv_heads, d_ff=args.d_ff,
         head_dim=args.head_dim, max_seq_len=args.seq_len,
-        moe_experts=args.moe_experts, attention=args.attention,
+        moe_experts=args.moe_experts,
+        moe_capacity_factor=args.moe_capacity_factor,
+        attention=args.attention,
         remat=args.remat, ce_dtype=args.ce_dtype,
         pipeline_microbatches=args.pipeline_microbatches,
     )
@@ -106,9 +120,18 @@ def main(argv=None) -> int:
     batch = args.batch_size_per_device * jax.device_count()
     peak = (parse_slice_type(env.slice_type).bf16_tflops_per_chip * 1e12
             if env.slice_type else 0.0)
+    if args.warmup_steps > 0:
+        lr = optax.warmup_cosine_decay_schedule(
+            init_value=0.0, peak_value=args.learning_rate,
+            warmup_steps=args.warmup_steps, decay_steps=args.steps,
+            end_value=args.learning_rate * 0.1)
+    else:
+        lr = args.learning_rate
+    tx = (optax.adafactor(lr) if args.optimizer == "adafactor"
+          else optax.adamw(lr))
     trainer = Trainer(
         init_fn=init_fn, loss_fn=loss_fn,
-        tx=optax.adamw(args.learning_rate), mesh=mesh,
+        tx=tx, mesh=mesh,
         checkpoints=(CheckpointManager(args.checkpoint_dir)
                      if args.checkpoint_dir else None),
         checkpoint_every=args.checkpoint_every,
@@ -137,8 +160,20 @@ def main(argv=None) -> int:
         data = synthetic()
 
     trainer.fit(data, num_steps=args.steps, examples_per_step=batch,
-                log_every=args.log_every)
+                log_every=args.log_every,
+                steps_per_call=args.steps_per_call)
     logging.info("training done: %s", trainer._last_metrics)
+    if args.metrics_out:
+        import json as _json
+
+        with open(args.metrics_out, "w") as f:
+            _json.dump({
+                "config": {k: v for k, v in vars(args).items()
+                           if isinstance(v, (int, float, str, bool))},
+                "history": trainer.metrics.history,
+            }, f, indent=1, default=float)
+            f.write("\n")
+        logging.info("metrics history -> %s", args.metrics_out)
     return 0
 
 
