@@ -330,6 +330,7 @@ def bench_lm(args, devices, n_chips, on_tpu):
             save_attn_residuals=not args.no_save_attn,
             flash_block_q=args.flash_block_q,
             flash_block_k=args.flash_block_k,
+            flash_block_diag=args.flash_block_diag,
             moe_experts=args.moe_experts,
             moe_group_size=args.moe_group_size,
             moe_impl=args.moe_impl,
@@ -1124,6 +1125,9 @@ def main() -> None:
     ap.add_argument("--flash-block-k", type=int, default=1024,
                     help="flash attention k block (on-chip sweep knob; "
                          "1024 measured best on v5e @ seq 2048)")
+    ap.add_argument("--flash-block-diag", type=int, default=0,
+                    help="two-pass causal forward: diagonal-band fine "
+                         "tile (0 = classic single pass)")
     ap.add_argument("--no-remat", action="store_true",
                     help="disable per-block remat in the lm bench")
     ap.add_argument("--moe-experts", type=int, default=0,

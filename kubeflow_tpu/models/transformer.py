@@ -76,6 +76,11 @@ class TransformerConfig:
     # _fit_block clamps both to the actual sequence length.
     flash_block_q: int = 512
     flash_block_k: int = 1024
+    # >0 = two-pass causal forward (ops/flash.py): full blocks at
+    # (block_q, block_k) mask-free + the diagonal band at this fine
+    # tiling, merged in log space — shrinks the masked-MAC waste of
+    # diagonal-straddling blocks.  0 = classic single pass.
+    flash_block_diag: int = 0
     # Mixture-of-Experts: 0 = dense MLP; >0 replaces every block's MLP
     # with a MoE layer of that many experts (expert-parallel over the
     # `expert` mesh axis; models/moe.py).
@@ -239,10 +244,12 @@ class Attention(nn.Module):
                 return make_sharded_flash(
                     self.mesh, causal=True,
                     block_q=cfg.flash_block_q, block_k=cfg.flash_block_k,
+                    block_diag=cfg.flash_block_diag,
                 )(q, k, v)
             return flash_attention(
                 q, k, v, causal=True,
                 block_q=cfg.flash_block_q, block_k=cfg.flash_block_k,
+                block_diag=cfg.flash_block_diag,
             )
         return dot_product_attention(q, k, v, causal=True,
                                      segment_ids=segment_ids)

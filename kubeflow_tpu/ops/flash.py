@@ -220,6 +220,282 @@ def _flash_fwd_bhsd(
 
 
 # ---------------------------------------------------------------------------
+# Two-pass ("splash"-style) causal forward
+#
+# The single-pass causal kernel pays full BQ x BK MACs on every block
+# that straddles the diagonal — at (512, 1024) on seq 2048 that is ~33%
+# of all MACs on masked pairs (XProf accounting, BASELINE.md headroom
+# #1).  Split the work by mask structure instead:
+#   pass A — only blocks FULLY below the diagonal, at the big
+#     (block_q, block_k) tiling, zero masking code;
+#   pass B — the diagonal band (everything pass A skipped), retiled at
+#     a fine (block_diag, block_diag) granularity so the masked waste
+#     shrinks from BQ*BK/2 per diagonal block to BDf^2/2 per fine tile.
+# Each pass emits normalized (o, lse); one fused elementwise merge in
+# log space (the ring-attention hop merge, parallel/ring.py _merge)
+# combines them exactly.  At (512, 1024, 256) on seq 2048 the MAC count
+# drops ~24%; the sweep lives in BASELINE.md.
+# ---------------------------------------------------------------------------
+
+
+def _flash_fwd_full_kernel(
+    q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+    *, scale: float, block_q: int, block_k: int,
+):
+    """Pass A: k blocks strictly below the diagonal — no mask, ever.
+    A q block whose every k block is dead still writes (o=0,
+    lse=NEG_INF): the merge treats it as an empty partial."""
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # Full blocks for this q block: k in [0, q_start // block_k).
+    live = ki < (qi * block_q) // block_k
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        m_prev = m_scr[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = alpha * l_scr[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        m = m_scr[:, :1]
+        l = l_scr[:, :1]
+        safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_scr[:] / safe).astype(o_ref.dtype)
+        lse_ref[0] = jnp.where(l == 0.0, NEG_INF, m + jnp.log(safe))
+
+
+def _flash_fwd_diag_kernel(
+    q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+    *, scale: float, block_q: int, block_k: int, block_diag: int,
+):
+    """Pass B: the diagonal band pass A skipped, at fine tiles.
+
+    For the fine q tile starting at qfs (inside coarse block qi), the
+    band is k in [((qi*BQ) // BK) * BK, qfs + BDf); fine tiles beyond
+    the causal frontier are dead.  The causal mask is applied on every
+    live tile (the `where` is cheap; the MAC waste is what the fine
+    tiling already shrank)."""
+    qf = pl.program_id(1)
+    kf = pl.program_id(2)
+    nkf = pl.num_programs(2)
+
+    @pl.when(kf == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    qfs = qf * block_diag
+    boundary = ((qfs // block_q) * block_q) // block_k * block_k
+    k_start = boundary + kf * block_diag
+    live = k_start <= qfs + block_diag - 1
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        q_pos = qfs + jax.lax.broadcasted_iota(
+            jnp.int32, (block_diag, block_diag), 0)
+        k_pos = k_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_diag, block_diag), 1)
+        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m_prev = m_scr[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        # The first band tile of row 0 is the row's own diagonal tile,
+        # so every live row sees a real max here (k_pos == q_pos is
+        # always in range) — no sentinel-minus-sentinel hazard.
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = alpha * l_scr[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(kf == nkf - 1)
+    def _finish():
+        m = m_scr[:, :1]
+        l = l_scr[:, :1]
+        safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_scr[:] / safe).astype(o_ref.dtype)
+        lse_ref[0] = jnp.where(l == 0.0, NEG_INF, m + jnp.log(safe))
+
+
+def merge_partials(o_a, lse_a, o_b, lse_b):
+    """Exact log-space merge of two normalized attention partials.
+
+    o_*: [..., d]; lse_*: o.shape[:-1] (an empty partial carries
+    lse = NEG_INF, o = 0).  The ONE copy of the sentinel-guarded
+    online-softmax merge — the two-pass forward uses it directly and
+    ring attention's hop merge (parallel/ring.py _merge) wraps it with
+    its own lse layout; a numerics change here serves both."""
+    m = jnp.maximum(lse_a, lse_b)
+    safe_m = jnp.where(m > NEG_INF / 2, m, 0.0)
+    wa = jnp.where(lse_a > NEG_INF / 2, jnp.exp(lse_a - safe_m), 0.0)
+    wb = jnp.where(lse_b > NEG_INF / 2, jnp.exp(lse_b - safe_m), 0.0)
+    l = wa + wb
+    safe_l = jnp.maximum(l, 1e-37)
+    o = (o_a.astype(jnp.float32) * (wa / safe_l)[..., None]
+         + o_b.astype(jnp.float32) * (wb / safe_l)[..., None])
+    lse = jnp.where(l > 0.0, safe_m + jnp.log(safe_l), NEG_INF)
+    return o.astype(o_a.dtype), lse
+
+
+def _flash_fwd_two_pass(
+    q: jax.Array, k: jax.Array, v: jax.Array,
+    *, block_q: int, block_k: int, block_diag: int, interpret: bool,
+) -> Tuple[jax.Array, jax.Array]:
+    """Causal self-attention forward via full-block + diagonal-band
+    passes.  Requires sq == sk (training self-attention)."""
+    import math
+
+    bh, sq, d = q.shape
+    block_q = _fit_block(block_q, sq)
+    block_k = _fit_block(block_k, sq)
+    # The band arithmetic (boundary // block_diag, nband) needs the
+    # fine tile to divide both coarse blocks: largest divisor of their
+    # gcd <= the request (which then divides sq too, via block_q).
+    g = math.gcd(block_q, block_k)
+    block_diag = next(c for c in range(min(block_diag, g), 0, -1)
+                      if g % c == 0)
+    scale = d ** -0.5
+    vma = jax.typeof(q).vma
+    nq, nk = sq // block_q, sq // block_k
+    # Widest band, in fine tiles: the k span [boundary, qfs + BDf) is
+    # at most (block_q - block_diag) + block_k wide plus the fine tile
+    # itself (boundary snaps down by up to BK - 1 relative to the
+    # coarse q start).
+    nband = min((block_q + block_k) // block_diag, sq // block_diag)
+    out_shape = [
+        jax.ShapeDtypeStruct((bh, sq, d), q.dtype, vma=vma),
+        jax.ShapeDtypeStruct((bh, sq, 1), jnp.float32, vma=vma),
+    ]
+
+    n_full_max = ((nq - 1) * block_q) // block_k
+    if n_full_max > 0:
+        o_a, lse_a = pl.pallas_call(
+            functools.partial(
+                _flash_fwd_full_kernel, scale=scale,
+                block_q=block_q, block_k=block_k,
+            ),
+            out_shape=out_shape,
+            grid=(bh, nq, n_full_max),
+            in_specs=[
+                pl.BlockSpec((1, block_q, d),
+                             lambda b, qi, ki: (b, qi, 0)),
+                # Dead iterations (ki >= this q block's full count)
+                # clamp their fetch to the last live block — the DMA
+                # then re-reads a hot block instead of streaming a
+                # k/v block the kernel will ignore.
+                pl.BlockSpec(
+                    (1, block_k, d),
+                    lambda b, qi, ki: (
+                        b,
+                        jnp.minimum(
+                            ki,
+                            jnp.maximum(
+                                (qi * block_q) // block_k - 1, 0)),
+                        0)),
+                pl.BlockSpec(
+                    (1, block_k, d),
+                    lambda b, qi, ki: (
+                        b,
+                        jnp.minimum(
+                            ki,
+                            jnp.maximum(
+                                (qi * block_q) // block_k - 1, 0)),
+                        0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, block_q, d),
+                             lambda b, qi, ki: (b, qi, 0)),
+                pl.BlockSpec((1, block_q, 1),
+                             lambda b, qi, ki: (b, qi, 0)),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((block_q, 128), jnp.float32),
+                pltpu.VMEM((block_q, 128), jnp.float32),
+                pltpu.VMEM((block_q, d), jnp.float32),
+            ],
+            interpret=interpret,
+        )(q, k, v)
+    else:
+        o_a = lse_a = None
+
+    def _band_k_index(b, qf, kf):
+        qfs = qf * block_diag
+        boundary = ((qfs // block_q) * block_q) // block_k * block_k
+        idx = boundary // block_diag + kf
+        # Dead band tiles (beyond the causal frontier) re-fetch the
+        # frontier tile; also keeps the index in range.
+        return (b, jnp.minimum(idx, qfs // block_diag), 0)
+
+    o_b, lse_b = pl.pallas_call(
+        functools.partial(
+            _flash_fwd_diag_kernel, scale=scale, block_q=block_q,
+            block_k=block_k, block_diag=block_diag,
+        ),
+        out_shape=out_shape,
+        grid=(bh, sq // block_diag, nband),
+        in_specs=[
+            pl.BlockSpec((1, block_diag, d),
+                         lambda b, qf, kf: (b, qf, 0)),
+            pl.BlockSpec((1, block_diag, d), _band_k_index),
+            pl.BlockSpec((1, block_diag, d), _band_k_index),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_diag, d),
+                         lambda b, qf, kf: (b, qf, 0)),
+            pl.BlockSpec((1, block_diag, 1),
+                         lambda b, qf, kf: (b, qf, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_diag, 128), jnp.float32),
+            pltpu.VMEM((block_diag, 128), jnp.float32),
+            pltpu.VMEM((block_diag, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+
+    if o_a is None:
+        return o_b, lse_b[:, :, 0]
+    o, lse = merge_partials(
+        o_a, lse_a[:, :, 0], o_b, lse_b[:, :, 0])
+    return o, lse
+
+
+# ---------------------------------------------------------------------------
 # Backward kernels
 #
 # dq pass: grid (bh, q_blocks, k_blocks), k innermost, accumulates dq.
@@ -414,22 +690,36 @@ def _flash_bwd_bhsd(
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(
-    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6)
-)
-def _flash(q, k, v, causal, block_q, block_k, interpret):
-    o, _ = _flash_fwd_bhsd(
+def _fwd_dispatch(q, k, v, causal, block_q, block_k, interpret,
+                  block_diag):
+    """Single-pass vs two-pass forward.  Two-pass needs: a request
+    (block_diag > 0), a causal self-attention shape (sq == sk), and a
+    sequence long enough for full blocks to exist at all."""
+    if (block_diag and causal and q.shape[1] == k.shape[1]
+            and q.shape[1] > block_k):
+        return _flash_fwd_two_pass(
+            q, k, v, block_q=block_q, block_k=block_k,
+            block_diag=block_diag, interpret=interpret,
+        )
+    return _flash_fwd_bhsd(
         q, k, v, causal=causal, block_q=block_q, block_k=block_k,
         interpret=interpret,
     )
+
+
+@functools.partial(
+    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7)
+)
+def _flash(q, k, v, causal, block_q, block_k, interpret, block_diag):
+    o, _ = _fwd_dispatch(
+        q, k, v, causal, block_q, block_k, interpret, block_diag)
     return o
 
 
-def _flash_vjp_fwd(q, k, v, causal, block_q, block_k, interpret):
-    o, lse = _flash_fwd_bhsd(
-        q, k, v, causal=causal, block_q=block_q, block_k=block_k,
-        interpret=interpret,
-    )
+def _flash_vjp_fwd(q, k, v, causal, block_q, block_k, interpret,
+                   block_diag):
+    o, lse = _fwd_dispatch(
+        q, k, v, causal, block_q, block_k, interpret, block_diag)
     # Under jax.checkpoint this fwd rule IS the primal pass, and (o, lse)
     # are the residuals the backward kernels need.  dots_saveable-style
     # policies never match a Pallas custom call, so without these tags a
@@ -442,7 +732,11 @@ def _flash_vjp_fwd(q, k, v, causal, block_q, block_k, interpret):
     return o, (q, k, v, o, lse)
 
 
-def _flash_vjp_bwd(causal, block_q, block_k, interpret, res, g):
+def _flash_vjp_bwd(causal, block_q, block_k, interpret, block_diag,
+                   res, g):
+    # The merged lse IS the true full-softmax lse, so the backward
+    # kernels are identical for both forward schedules.
+    del block_diag
     q, k, v, o, lse = res
     delta = jnp.sum(
         g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1
@@ -530,6 +824,7 @@ def make_sharded_flash(
     causal: bool = True,
     block_q: int = 512,
     block_k: int = 512,
+    block_diag: int = 0,
 ):
     """shard_map wrapper: flash per shard, batch over (data, fsdp), heads
     over tensor, sequence resident (use ring attention for sequence
@@ -546,7 +841,8 @@ def make_sharded_flash(
     )
     def fn(q, k, v):
         return flash_attention(
-            q, k, v, causal=causal, block_q=block_q, block_k=block_k
+            q, k, v, causal=causal, block_q=block_q, block_k=block_k,
+            block_diag=block_diag,
         )
 
     return fn
@@ -561,6 +857,7 @@ def flash_attention(
     segment_ids: Optional[jax.Array] = None,
     block_q: int = 512,
     block_k: int = 512,
+    block_diag: int = 0,
     interpret: bool = False,
     kv_valid_start: Optional[jax.Array] = None,
 ) -> jax.Array:
@@ -571,6 +868,12 @@ def flash_attention(
     repeating kv heads before the kernel (the cotangent sum over the head
     group is what jnp.repeat's autodiff gives back).  Segment masking is
     not yet in the kernel: segmented calls fall back to the XLA path.
+
+    block_diag > 0 selects the two-pass causal forward: full blocks at
+    (block_q, block_k) with no masking, the diagonal band at
+    (block_diag, block_diag) fine tiles, merged in log space — cuts the
+    masked-MAC waste of diagonal-straddling blocks (backward unchanged;
+    the merged lse is exact).  0 = classic single pass.
 
     kv_valid_start ([b] int32, optional): per-row first valid key —
     keys before it get zero weight (left-padded bucketed decode
@@ -597,6 +900,6 @@ def flash_attention(
         return _from_bhsd(out, b, h)
     out = _flash(
         _to_bhsd(q), _to_bhsd(k), _to_bhsd(v),
-        causal, block_q, block_k, interpret,
+        causal, block_q, block_k, interpret, block_diag,
     )
     return _from_bhsd(out, b, h)

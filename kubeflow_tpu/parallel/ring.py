@@ -138,20 +138,16 @@ def _block_bwd(q, k, v, g, lse, delta, causal, use_flash, block_q, block_k,
 
 
 def _merge(o_acc, lse_acc, o_p, lse_p):
-    """Log-space online-softmax merge of two normalized (o, lse) partials."""
-    m = jnp.maximum(lse_acc, lse_p)
-    safe_m = jnp.where(m > NEG_INF / 2, m, 0.0)
-    a_acc = jnp.where(lse_acc > NEG_INF / 2, jnp.exp(lse_acc - safe_m), 0.0)
-    a_p = jnp.where(lse_p > NEG_INF / 2, jnp.exp(lse_p - safe_m), 0.0)
-    l = a_acc + a_p                                    # [b, h, s]
-    safe_l = jnp.maximum(l, 1e-37)
+    """Log-space online-softmax merge of two normalized partials:
+    o [b, s, h, d] with lse [b, h, s].  The sentinel/floor numerics
+    live in ONE place — ops/flash.py merge_partials (shared with the
+    two-pass forward); this wrapper only adapts the ring's lse layout
+    (head-major) to the o-aligned layout the core expects."""
+    from kubeflow_tpu.ops.flash import merge_partials
 
-    def w(a):
-        return (a / safe_l).swapaxes(1, 2)[..., None]  # [b, s, h, 1]
-
-    o_new = o_acc * w(a_acc) + o_p * w(a_p)
-    lse_new = jnp.where(l > 0.0, safe_m + jnp.log(safe_l), NEG_INF)
-    return o_new, lse_new
+    o_new, lse_aligned = merge_partials(
+        o_acc, lse_acc.swapaxes(1, 2), o_p, lse_p.swapaxes(1, 2))
+    return o_new, lse_aligned.swapaxes(1, 2)
 
 
 def _fold_heads(dk, hkv):

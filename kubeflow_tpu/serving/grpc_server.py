@@ -172,10 +172,28 @@ def make_grpc_server(
     server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
     server.add_generic_rpc_handlers(
         (grpc.method_handlers_generic_handler(SERVICE, handlers),))
+    # TF-Serving compat face on the SAME port: reference-era clients
+    # address /tensorflow.serving.PredictionService/Predict with TF
+    # TensorProto payloads and run unchanged (serving/tf_compat.py).
+    from kubeflow_tpu.serving import tf_compat
+    from kubeflow_tpu.serving.protos import tf_compat_pb2
+
+    tf_servicer = tf_compat.TFPredictServicer(model_server)
+    tf_handlers = {
+        "Predict": grpc.unary_unary_rpc_method_handler(
+            _wrap(tf_servicer, "Predict"),
+            request_deserializer=tf_compat_pb2.PredictRequest.FromString,
+            response_serializer=(
+                tf_compat_pb2.PredictResponse.SerializeToString),
+        ),
+    }
+    server.add_generic_rpc_handlers(
+        (grpc.method_handlers_generic_handler(
+            tf_compat.TF_SERVICE, tf_handlers),))
     bound = server.add_insecure_port(f"{host}:{port}")
     server.bound_port = bound
     server.start()
-    log.info("gRPC PredictionService on :%d", bound)
+    log.info("gRPC PredictionService on :%d (+ tf-serving compat)", bound)
     return server
 
 

@@ -1,0 +1,152 @@
+"""TF-Serving Predict wire compatibility (VERDICT r4 item 9).
+
+The clone protos must parse bytes the REAL tensorflow produces and
+produce bytes the real tensorflow parses — both directions are
+cross-validated against the installed tensorflow's tensor_pb2 /
+make_tensor_proto / make_ndarray, and the end-to-end test drives the
+live gRPC server through /tensorflow.serving.PredictionService/Predict
+with a reference-shaped request (raw JPEG bytes in a DT_STRING tensor,
+the inception-client/label.py contract).
+"""
+
+import io
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+from tensorflow.core.framework import tensor_pb2 as _real_tensor_pb2  # noqa: E402
+from kubeflow_tpu.serving import tf_compat  # noqa: E402
+from kubeflow_tpu.serving.protos import tf_compat_pb2 as pb  # noqa: E402
+
+
+class TestTensorProtoWireCompat:
+    @pytest.mark.parametrize("arr", [
+        np.arange(12, dtype=np.float32).reshape(3, 4),
+        np.arange(6, dtype=np.int64).reshape(2, 3),
+        (np.arange(24) % 255).astype(np.uint8).reshape(2, 3, 4),
+        np.asarray([[True, False]]),
+    ])
+    def test_parses_real_tf_tensorproto(self, arr):
+        real = tf.make_tensor_proto(arr)
+        clone = pb.TensorProto.FromString(real.SerializeToString())
+        out = tf_compat.tensorproto_to_numpy(clone)
+        assert out.dtype == arr.dtype
+        np.testing.assert_array_equal(out, arr)
+
+    def test_parses_small_tensor_val_fields(self):
+        # make_tensor_proto uses float_val (not tensor_content) for
+        # tiny tensors — the other client encoding.
+        real = tf.make_tensor_proto(3.5, shape=[2, 2])
+        clone = pb.TensorProto.FromString(real.SerializeToString())
+        out = tf_compat.tensorproto_to_numpy(clone)
+        np.testing.assert_array_equal(out, np.full((2, 2), 3.5, np.float32))
+
+    def test_parses_string_tensor(self):
+        blobs = [b"raw-jpeg-1", b"raw-jpeg-2"]
+        real = tf.make_tensor_proto(blobs, shape=[2])
+        clone = pb.TensorProto.FromString(real.SerializeToString())
+        assert tf_compat.tensorproto_to_numpy(clone) == blobs
+
+    def test_real_tf_parses_our_response_tensors(self):
+        arr = np.linspace(0, 1, 10, dtype=np.float32).reshape(2, 5)
+        ours = tf_compat.numpy_to_tensorproto(arr)
+        real = _real_tensor_pb2.TensorProto.FromString(
+            ours.SerializeToString())
+        np.testing.assert_array_equal(tf.make_ndarray(real), arr)
+
+    def test_request_wrapper_round_trips_model_spec(self):
+        req = pb.PredictRequest()
+        req.model_spec.name = "inception"
+        req.model_spec.signature_name = "predict_images"
+        req.model_spec.version.value = 7
+        back = pb.PredictRequest.FromString(req.SerializeToString())
+        assert back.model_spec.name == "inception"
+        assert back.model_spec.version.value == 7
+
+
+class TestImageDecode:
+    def _jpeg(self, rng, size=32):
+        from PIL import Image
+
+        img = Image.fromarray(
+            rng.randint(0, 255, (size, size, 3), dtype=np.uint8))
+        buf = io.BytesIO()
+        img.save(buf, format="JPEG")
+        return buf.getvalue()
+
+    def test_decode_image_bytes(self):
+        rng = np.random.RandomState(0)
+        batch = tf_compat.decode_image_bytes(
+            [self._jpeg(rng), self._jpeg(rng)])
+        assert batch.shape == (2, 32, 32, 3)
+        assert batch.dtype == np.uint8
+
+    def test_images_key_aliased_and_decoded(self):
+        rng = np.random.RandomState(1)
+        req = pb.PredictRequest()
+        real = tf.make_tensor_proto([self._jpeg(rng)], shape=[1])
+        req.inputs["images"].ParseFromString(real.SerializeToString())
+        inputs = tf_compat.request_inputs_to_numpy(req)
+        assert set(inputs) == {"image"}
+        assert inputs["image"].shape == (1, 32, 32, 3)
+
+
+class TestEndToEndReferenceShapedPredict:
+    def test_reference_client_request_runs_unchanged(self, tmp_path):
+        """A byte-identical reference-era request (DT_STRING raw JPEG,
+        inputs['images'], signature predict_images) served end to end
+        through the live gRPC port."""
+        import grpc
+        import jax
+
+        from kubeflow_tpu.models.resnet import ResNetConfig
+        from kubeflow_tpu.serving.export import export
+        from kubeflow_tpu.serving.grpc_server import make_grpc_server
+        from kubeflow_tpu.serving.model_server import ModelServer
+
+        rng = np.random.RandomState(2)
+        # Same construction the classifier loader will use at load time
+        # (family + num_classes + num_filters), or shapes mismatch.
+        model = ResNetConfig._FACTORIES["resnet18"](
+            num_classes=10, num_filters=8)
+        variables = model.init(
+            jax.random.key(0), np.zeros((1, 32, 32, 3), np.float32),
+            train=False)
+        export(str(tmp_path / "m"), 1, variables,
+               loader="kubeflow_tpu.serving.loaders:classifier",
+               config={"family": "resnet18", "num_classes": 10,
+                       "num_filters": 8})
+        server = ModelServer()
+        server.add_model("inception", str(tmp_path / "m"))
+        grpc_srv = make_grpc_server(server, port=0, host="127.0.0.1")
+        try:
+            req = pb.PredictRequest()
+            req.model_spec.name = "inception"
+            req.model_spec.signature_name = "predict_images"
+            jpeg = TestImageDecode()._jpeg(rng)
+            req.inputs["images"].ParseFromString(
+                tf.make_tensor_proto([jpeg], shape=[1])
+                .SerializeToString())
+
+            channel = grpc.insecure_channel(
+                f"127.0.0.1:{grpc_srv.bound_port}")
+            call = channel.unary_unary(
+                "/tensorflow.serving.PredictionService/Predict",
+                request_serializer=pb.PredictRequest.SerializeToString,
+                response_deserializer=pb.PredictResponse.FromString,
+            )
+            resp = call(req, timeout=120)
+            scores = tf_compat.tensorproto_to_numpy(
+                resp.outputs["scores"])
+            assert scores.shape == (1, 10)
+            np.testing.assert_allclose(scores.sum(), 1.0, atol=1e-3)
+            assert resp.model_spec.version.value == 1
+            # The real tensorflow can parse our response tensor too.
+            real = _real_tensor_pb2.TensorProto.FromString(
+                resp.outputs["scores"].SerializeToString())
+            np.testing.assert_array_equal(tf.make_ndarray(real), scores)
+            channel.close()
+        finally:
+            grpc_srv.stop(grace=None)
