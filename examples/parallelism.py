@@ -60,6 +60,14 @@ def main() -> int:
         ("pipeline-parallel (GPipe)",
          MeshSpec(data=4, pipeline=2),
          {"pipeline_microbatches": 4, "attention": "dot"}),
+        # The composed finale: ring attention + MoE + GPipe in ONE
+        # program over a pipeline x sequence x expert mesh — the
+        # combinations a >1-slice MoE long-context job wants (the r4
+        # composition walls, lifted in r5).
+        ("pp x sp x ep composed (ring + MoE through GPipe)",
+         MeshSpec(pipeline=2, sequence=2, expert=2),
+         {"pipeline_microbatches": 2, "attention": "ring",
+          "moe_experts": 2}),
     ]
     rng = np.random.RandomState(0)
     devnull = open(os.devnull, "w")
