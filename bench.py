@@ -1038,6 +1038,244 @@ def bench_lm_decode(args, devices, n_chips, on_tpu):
     }
 
 
+def bench_lm_engine(args, devices, n_chips, on_tpu):
+    """Continuous-batching DecodeEngine vs the static BucketedLMBatcher
+    on ONE mixed open-loop workload.
+
+    The workload is the serving reality the static path is worst at:
+    requests arrive on their own schedule (open loop, seeded arrival
+    offsets), with mixed prompt lengths AND mixed per-request completion
+    budgets.  The static batcher runs whole generate() programs — every
+    request pays the export config's full max_new_tokens (the program
+    bakes it in) and a request arriving mid-generation waits for the
+    program to finish.  The engine admits into free slots between
+    steps, retires rows the moment their budget is met, and treats the
+    budget as data.  Throughput counts DELIVERED tokens (what clients
+    asked for) over the same request set for both paths; the batcher's
+    decoded-token rate is also recorded so the waste is explicit.
+
+    Timing is the stall-resistant interleaved-window scheme from
+    bench_lm_decode: engine/batcher windows alternate so one tunnel
+    freeze cannot silently poison both sides, the faster window is the
+    capability estimator, and per-window values ship in the record.
+    """
+    import tempfile
+    import threading
+
+    import jax
+    import jax.numpy as jnp  # noqa: F401  (platform configured by caller)
+    import numpy as np
+
+    from kubeflow_tpu.models.transformer import Transformer
+    from kubeflow_tpu.serving.engine import DecodeEngine
+    from kubeflow_tpu.serving.export import export
+    from kubeflow_tpu.serving.loaders import _model_config
+    from kubeflow_tpu.serving.model_server import (
+        BucketedLMBatcher,
+        ModelServer,
+    )
+
+    if on_tpu:
+        overrides = {
+            "vocab_size": 32_000, "d_model": 1024, "n_layers": 12,
+            "n_heads": 8, "n_kv_heads": 8, "d_ff": 2816, "head_dim": 128,
+            "max_seq_len": 2048, "dtype": "bfloat16",
+        }
+        max_new = 128
+        prompt_lens = [32, 48, 64, 96, 128, 192, 256, 40]
+        req_news = [16, 32, 64, 128]
+        prefill_len, slots, spc, admit = 256, 16, 4, 4
+        buckets = [64, 128, 256]
+        n_requests, spread_s, windows = 64, 0.5, 2
+    else:  # tiny hermetic config — runs under JAX_PLATFORMS=cpu
+        overrides = {
+            "vocab_size": 256, "d_model": 64, "n_layers": 2, "n_heads": 4,
+            "n_kv_heads": 4, "d_ff": 128, "head_dim": 16,
+            "max_seq_len": 128, "dtype": "float32",
+        }
+        max_new = 48
+        prompt_lens = [4, 7, 11, 16, 23, 32, 27, 9]
+        req_news = [4, 8, 16, 32]
+        prefill_len, slots, spc, admit = 32, 8, 8, 4
+        buckets = [8, 16, 32]
+        n_requests, spread_s, windows = 64, 0.02, 3
+    print(f"bench: lm engine vs static batcher, "
+          f"d_model={overrides['d_model']} L{overrides['n_layers']}, "
+          f"{n_requests} reqs, prompts {min(prompt_lens)}-"
+          f"{max(prompt_lens)}, budgets {min(req_news)}-{max(req_news)} "
+          f"of {max_new}, {devices[0].device_kind}", file=sys.stderr)
+
+    cfg = _model_config(overrides)
+    model = Transformer(cfg)
+    rng = np.random.RandomState(0)
+    variables = model.init(jax.random.key(0),
+                           np.zeros((1, prompt_lens[0]), np.int32))
+    with tempfile.TemporaryDirectory() as tmp:
+        export(f"{tmp}/lm", 1, variables,
+               loader="kubeflow_tpu.serving.loaders:lm_generate",
+               config={"model": overrides, "max_new_tokens": max_new,
+                       "temperature": 0.0})
+        server = ModelServer()
+        server.add_model("lm", f"{tmp}/lm")
+        lm = server.get("lm")
+        spec = lm.predict.engine_spec
+
+        # One seeded request set + arrival schedule shared by BOTH
+        # paths: (prompt, requested tokens, arrival offset).
+        reqs = [
+            (rng.randint(1, cfg.vocab_size,
+                         size=(1, prompt_lens[i % len(prompt_lens)])
+                         ).astype(np.int32),
+             req_news[i % len(req_news)],
+             rng.uniform(0.0, spread_s))
+            for i in range(n_requests)
+        ]
+        delivered = sum(n for _, n, _ in reqs)
+
+        window_failures = {}
+
+        def run_window(submit, label):
+            failures = []
+
+            def client(prompt, new, delay):
+                time.sleep(delay)
+                try:
+                    submit(prompt, new)
+                except Exception as exc:  # noqa: BLE001 — recorded
+                    failures.append((exc, new))
+
+            threads = [threading.Thread(target=client, args=r)
+                       for r in reqs]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            wall = time.perf_counter() - t0
+            if failures:
+                print(f"{label}: {len(failures)} failed requests "
+                      f"({failures[0][0]})", file=sys.stderr)
+            window_failures.setdefault(label, []).append(len(failures))
+            # Failed submissions delivered nothing — their tokens must
+            # not inflate the window's throughput.  ok_requests /
+            # ok_delivered let the batcher's decoded-rate derivation
+            # count only the requests that actually ran.
+            ok = delivered - sum(n for _, n in failures)
+            return {"rate": ok / wall, "ok_delivered": ok,
+                    "ok_requests": n_requests - len(failures)}
+
+        # --- engine: persistent across windows (the persistent cache
+        # IS the design); warm both programs with two tiny requests.
+        engine = DecodeEngine(
+            spec["cfg"], spec["params"], spec["decode"], slots=slots,
+            prefill_len=prefill_len, steps_per_call=spc,
+            admit_width=admit, name="bench")
+        for _ in range(2):
+            engine.submit({"tokens": reqs[0][0],
+                           "max_new_tokens": max(2, spc)})
+
+        def engine_submit(prompt, new):
+            engine.submit({"tokens": prompt, "max_new_tokens": new})
+
+        # --- static batcher: compile EVERY (bucket, allowed size)
+        # generate program the windows can hit (the bench_lm_decode
+        # lesson: promotion makes the bucket a batch-composition
+        # property, so client-driven warmup cannot be trusted).
+        allowed = [s for s in (1, 2, 4, 8, 16) if s <= slots]
+        predict_fn = lm.predict
+        for bucket in buckets:
+            for size in allowed:
+                warm = rng.randint(1, cfg.vocab_size,
+                                   size=(size, bucket)).astype(np.int32)
+                out = predict_fn({
+                    "tokens": warm,
+                    "prompt_len": np.full((size,), bucket, np.int32)})
+                jax.block_until_ready(out["tokens"])
+
+        def make_batcher():
+            return BucketedLMBatcher(
+                predict_fn, buckets=buckets, max_batch_size=slots,
+                batch_timeout_s=0.02, allowed_batch_sizes=allowed,
+                in_flight=2, name="bench-static")
+
+        # --- interleaved windows (fresh batcher per window for clean
+        # stats; the engine keeps its persistent cache).
+        engine_windows, batcher_windows = [], []
+        batcher_stats = None
+        for _ in range(windows):
+            engine_windows.append(run_window(engine_submit, "engine"))
+            batcher = make_batcher()
+            batcher_windows.append(run_window(
+                lambda p, n: batcher.submit({"tokens": p}), "batcher"))
+            batcher_stats = batcher.stats()
+            batcher.close()
+        engine_stats = engine.stats()
+        compiled = engine.compiled_programs()
+        engine.close()
+
+    eng_rates = [w["rate"] for w in engine_windows]
+    bat_rates = [w["rate"] for w in batcher_windows]
+    eng_tok_s, bat_tok_s = max(eng_rates), max(bat_rates)
+    bat_best = max(batcher_windows, key=lambda w: w["rate"])
+    window_spread = (max(eng_rates) > 2 * min(eng_rates)
+                     or max(bat_rates) > 2 * min(bat_rates))
+    ratio = eng_tok_s / bat_tok_s if bat_tok_s else 0.0
+    print(f"lm engine: {eng_tok_s:.1f} tok/s delivered vs static "
+          f"batcher {bat_tok_s:.1f} ({ratio:.2f}x), occupancy "
+          f"{engine_stats['mean_occupancy']}/{slots}, per-token p50 "
+          f"{engine_stats['token_latency_p50_ms']} ms p95 "
+          f"{engine_stats['token_latency_p95_ms']} ms", file=sys.stderr)
+    return {
+        "metric": "lm_engine_tokens_per_sec",
+        "value": round(eng_tok_s, 1),
+        "unit": "delivered tokens/sec (continuous batching, "
+                "mixed open-loop)",
+        "vs_baseline": round(ratio, 3),
+        "detail": {
+            "engine_tokens_per_sec": round(eng_tok_s, 1),
+            "batcher_tokens_per_sec": round(bat_tok_s, 1),
+            "engine_vs_batcher": round(ratio, 3),
+            # The batcher's device-side rate: it decodes the full
+            # config budget for every request no matter what was asked
+            # (derived from its best window's SUCCESSFUL requests only).
+            "batcher_decoded_tokens_per_sec": round(
+                bat_tok_s * bat_best["ok_requests"] * max_new
+                / bat_best["ok_delivered"], 1)
+            if bat_best["ok_delivered"] else 0.0,
+            "token_latency_p50_ms":
+                engine_stats["token_latency_p50_ms"],
+            "token_latency_p95_ms":
+                engine_stats["token_latency_p95_ms"],
+            "mean_slot_occupancy": engine_stats["mean_occupancy"],
+            "slots": slots,
+            "steps_per_call": spc,
+            "admit_width": admit,
+            "prefill_len": prefill_len,
+            "engine_window_tokens_per_sec":
+                [round(w, 1) for w in eng_rates],
+            "batcher_window_tokens_per_sec":
+                [round(w, 1) for w in bat_rates],
+            **({"window_spread_suspect": True} if window_spread
+               else {}),
+            **({"window_failed_requests": window_failures}
+               if any(n for fs in window_failures.values()
+                      for n in fs) else {}),
+            "batcher_mean_batch_size":
+                (batcher_stats or {}).get("mean_batch_size"),
+            "requests": n_requests,
+            "prompt_lens": sorted(set(prompt_lens)),
+            "requested_new_tokens": sorted(set(req_news)),
+            "config_max_new_tokens": max_new,
+            "delivered_tokens_per_window": delivered,
+            "arrival_spread_s": spread_s,
+            "compiled_programs": compiled,
+            "d_model": overrides["d_model"],
+            "n_layers": overrides["n_layers"],
+            "device": devices[0].device_kind,
+        },
+    }
+
+
 def bench_data(args, devices, n_chips, on_tpu):
     """KFTR input pipeline throughput: the default path vs the python
     decode/stack loop, at two record sizes.
@@ -1129,7 +1367,7 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--model",
                     choices=["resnet", "lm", "serving", "lm-decode",
-                             "data", "both"],
+                             "lm-engine", "data", "both"],
                     default="both",
                     help="'both' = ResNet headline (the reference's own "
                          "benchmark) with the LM suite nested in detail")
@@ -1232,6 +1470,8 @@ def main() -> None:
         result = bench_serving(args, devices, n_chips, on_tpu)
     elif args.model == "lm-decode":
         result = bench_lm_decode(args, devices, n_chips, on_tpu)
+    elif args.model == "lm-engine":
+        result = bench_lm_engine(args, devices, n_chips, on_tpu)
     elif args.model == "data":
         result = bench_data(args, devices, n_chips, on_tpu)
     else:
@@ -1308,6 +1548,13 @@ def main() -> None:
         except Exception as e:
             print(f"lm-decode sub-benchmark failed: {e}", file=sys.stderr)
         try:
+            if not over_budget("lm_engine"):
+                lme = bench_lm_engine(args, devices, n_chips, on_tpu)
+                result["detail"]["lm_engine"] = lme["detail"]
+        except Exception as e:
+            print(f"lm-engine sub-benchmark failed: {e}",
+                  file=sys.stderr)
+        try:
             # The quantized serving story, captured in the same record:
             # int8 weights + int8 KV cache (where each pays is analyzed
             # in BASELINE.md).  Skipped when the base run was already
@@ -1373,6 +1620,9 @@ def headline_summary(result: dict,
                 pick("lm_decode", "batched_tokens_per_sec"),
             "decode_tokens_per_sec_int8":
                 pick("lm_decode_int8", "batched_tokens_per_sec"),
+            "engine_tokens_per_sec":
+                pick("lm_engine", "engine_tokens_per_sec"),
+            "engine_vs_batcher": pick("lm_engine", "engine_vs_batcher"),
             "serving_sustained_ms_per_request":
                 pick("serving", "sustained_ms_per_request"),
             "serving_batcher_capacity_req_s":
@@ -1437,7 +1687,8 @@ def emit(result: dict) -> None:
     if len(blob) <= 1800:
         print(blob)
     elif any(k in result.get("detail", {}) for k in
-             ("lm", "lm_moe", "serving", "lm_decode", "data")):
+             ("lm", "lm_moe", "serving", "lm_decode", "lm_engine",
+              "data")):
         print(json.dumps(headline_summary(result, full_results)))
     else:
         print(json.dumps(shrink_detail(result, full_results=full_results)))

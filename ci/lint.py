@@ -65,8 +65,14 @@ BANNED = [
 
 def py_files(root: pathlib.Path) -> Iterator[pathlib.Path]:
     for path in sorted(root.rglob("*.py")):
-        if not SKIP_DIRS.intersection(path.relative_to(root).parts):
-            yield path
+        parts = path.relative_to(root).parts
+        # Skip hidden directories wholesale (tool scratch space like
+        # .baseline_wt worktrees), not just the enumerated names.
+        if SKIP_DIRS.intersection(parts):
+            continue
+        if any(p.startswith(".") for p in parts[:-1]):
+            continue
+        yield path
 
 
 def check_file(path: pathlib.Path, root: pathlib.Path) -> List[str]:

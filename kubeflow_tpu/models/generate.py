@@ -68,15 +68,23 @@ class DecodeConfig:
 
 
 def _layer_step(cfg: TransformerConfig, layer_params, x, cache_kv,
-                cache_len, positions, pad_amount=None):
+                cache_len, positions, pad_amount=None, write_cols=None):
     """One decoder block against the KV cache.
 
     x: [b, t, e] new activations (t = prompt len at prefill, 1 at decode);
     cache_kv: (k, v) each [b, max_len, hkv, d];
-    cache_len: number of valid cache positions before this call;
+    cache_len: number of valid cache positions before this call — a
+    scalar (whole batch at one length, the generate() path) or a [b]
+    array (per-row lengths, the slot-based decode_step path; t must be
+    1 there — each row writes its new k/v at its OWN column and attends
+    under its own causal frontier via the per-row kv_offset mask);
     pad_amount: per-row [b] left-pad width (bucketed mixed-length
     prompts) — cache columns before it hold pad-token garbage and are
     masked out of every attention.
+    write_cols: per-row [b] cache column for the new k/v when cache_len
+    is per-row (defaults to cache_len); rows that must not write this
+    step (retired slots) pass an out-of-range column — the scatter
+    drops it.
     Mirrors models/transformer.py Block but with explicit cache state.
     """
     from kubeflow_tpu.models.transformer import MLP, RMSNorm
@@ -101,7 +109,29 @@ def _layer_step(cfg: TransformerConfig, layer_params, x, cache_kv,
 
     ck, cv = cache_kv
     t = x.shape[1]
-    if isinstance(ck, QTensor):
+    per_row = not isinstance(cache_len, int) and cache_len.ndim == 1
+    if per_row:
+        # Slot-based decode: one new token per row, scattered to each
+        # row's own column.  mode="drop" makes an out-of-range column a
+        # no-op — that is how retired slots skip the write without a
+        # separate program.
+        rows = jnp.arange(x.shape[0])
+        cols = cache_len if write_cols is None else write_cols
+
+        def store(c, new):  # new: [b, 1, hk, d]
+            if isinstance(c, QTensor):
+                vals, s = quantize_array(new, (-1,))
+                return QTensor(
+                    c.values.at[rows, cols].set(vals[:, 0], mode="drop"),
+                    c.scale.at[rows, cols].set(s[:, 0], mode="drop"),
+                    c.axes,
+                )
+            return c.at[rows, cols].set(
+                new[:, 0].astype(c.dtype), mode="drop")
+
+        ck = store(ck, k)
+        cv = store(cv, v)
+    elif isinstance(ck, QTensor):
         def store(c, new):
             vals, s = quantize_array(new, (-1,))    # [b, t, hk, d]
             return QTensor(
@@ -163,16 +193,29 @@ def _layer_step(cfg: TransformerConfig, layer_params, x, cache_kv,
 
 
 def _forward_with_cache(cfg: TransformerConfig, params, tokens, cache,
-                        cache_len, pad_amount=None):
-    """tokens [b, t] -> (logits [b, t, v], new cache)."""
+                        cache_len, pad_amount=None, write_cols=None):
+    """tokens [b, t] -> (logits [b, t, v], new cache).
+
+    cache_len scalar: the whole batch sits at one length (generate()).
+    cache_len [b] array: per-row lengths (slot-based decode_step) —
+    requires t == 1; each row ropes at its own position, writes its own
+    cache column (write_cols, defaulting to cache_len), and attends
+    under its own causal frontier.
+    """
     from flax import linen as nn
 
     params = nn.unbox(params)  # accept raw model.init output
     dt = cfg.dtype
     embed = params["embed"]
     x = embed_lookup(embed, tokens, dt)  # int8-aware row gather
-    positions = cache_len + jnp.arange(tokens.shape[1])[None, :]
-    positions = jnp.broadcast_to(positions, tokens.shape)
+    per_row = not isinstance(cache_len, int) and cache_len.ndim == 1
+    if per_row:
+        assert tokens.shape[1] == 1, (
+            "per-row cache_len is the single-token decode path")
+        positions = cache_len[:, None]
+    else:
+        positions = cache_len + jnp.arange(tokens.shape[1])[None, :]
+        positions = jnp.broadcast_to(positions, tokens.shape)
     if pad_amount is not None:
         # Left-padded rows: real token i of a row sits at buffer column
         # pad + i but must see rope position i.  Pad columns clamp to 0
@@ -192,7 +235,7 @@ def _forward_with_cache(cfg: TransformerConfig, params, tokens, cache,
         layer_params, ck, cv = inputs
         x, (ck, cv) = _layer_step(
             cfg, layer_params, x, (ck, cv), cache_len, positions,
-            pad_amount=pad_amount,
+            pad_amount=pad_amount, write_cols=write_cols,
         )
         return x, (ck, cv)
 
@@ -227,6 +270,35 @@ def init_cache(cfg: TransformerConfig, batch: int, max_len: int,
     if kv_cache_dtype != "model":
         raise ValueError(f"unknown kv_cache_dtype {kv_cache_dtype!r}")
     return (jnp.zeros(shape, cfg.dtype), jnp.zeros(shape, cfg.dtype))
+
+
+def _filter_logits(decode: DecodeConfig, logits: jax.Array) -> jax.Array:
+    """Temperature/top_k/top_p-filtered logits ([..., vocab]), shared by
+    generate()'s batched sampler and the slot engine's per-slot one.
+    Static-shape TPU code: a top_k threshold compare and a sorted-cumsum
+    mask — no dynamic vocabulary subsets."""
+    logits = logits / decode.temperature
+    if decode.top_k > 0:
+        # Clamp to the vocabulary: an oversized k means "no filter",
+        # not a trace-time lax.top_k error on the first request.
+        k = min(decode.top_k, logits.shape[-1])
+        kth = jax.lax.top_k(logits, k)[0][..., -1:]
+        logits = jnp.where(logits >= kth, logits, -jnp.inf)
+    if decode.top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+        cum = jnp.cumsum(
+            jax.nn.softmax(sorted_logits, axis=-1), axis=-1)
+        # Keep every token whose PRECEDING mass is < p (so the
+        # boundary token crossing p stays in, matching the
+        # standard nucleus definition), then threshold by the
+        # smallest kept logit.
+        keep = cum - jax.nn.softmax(sorted_logits, axis=-1) \
+            < decode.top_p
+        cutoff = jnp.min(
+            jnp.where(keep, sorted_logits, jnp.inf),
+            axis=-1, keepdims=True)
+        logits = jnp.where(logits >= cutoff, logits, -jnp.inf)
+    return logits
 
 
 @partial(jax.jit, static_argnums=(0, 3))
@@ -268,28 +340,8 @@ def generate(
     def sample(logits, key):
         if decode.temperature <= 0.0:
             return jnp.argmax(logits, axis=-1)
-        logits = logits / decode.temperature
-        if decode.top_k > 0:
-            # Clamp to the vocabulary: an oversized k means "no filter",
-            # not a trace-time lax.top_k error on the first request.
-            k = min(decode.top_k, logits.shape[-1])
-            kth = jax.lax.top_k(logits, k)[0][..., -1:]
-            logits = jnp.where(logits >= kth, logits, -jnp.inf)
-        if decode.top_p < 1.0:
-            sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
-            cum = jnp.cumsum(
-                jax.nn.softmax(sorted_logits, axis=-1), axis=-1)
-            # Keep every token whose PRECEDING mass is < p (so the
-            # boundary token crossing p stays in, matching the
-            # standard nucleus definition), then threshold by the
-            # smallest kept logit.
-            keep = cum - jax.nn.softmax(sorted_logits, axis=-1) \
-                < decode.top_p
-            cutoff = jnp.min(
-                jnp.where(keep, sorted_logits, jnp.inf),
-                axis=-1, keepdims=True)
-            logits = jnp.where(logits >= cutoff, logits, -jnp.inf)
-        return jax.random.categorical(key, logits, axis=-1)
+        return jax.random.categorical(
+            key, _filter_logits(decode, logits), axis=-1)
 
     def step(carry, _):
         cache, last_logits, cache_len, key, done = carry
@@ -334,3 +386,202 @@ def generate(
             length=decode.max_new_tokens)
     tokens = jnp.concatenate([prompt, new_tokens.T], axis=1)
     return tokens, final_logits
+
+
+# ---------------------------------------------------------------------------
+# Continuous-batching slot engine: two jitted programs over a PERSISTENT
+# slot-based KV cache (serving/engine.py drives them).
+#
+# generate() is one program per (batch, bucket) that owns its rows from
+# prefill to the last token — a row admitted mid-generation waits for the
+# whole program, and every row pays the batch bucket's padded KV span.
+# These entry points split that lifecycle so a serving loop can interleave
+# admission with decode:
+#
+#   prefill_into_slot  one request's prompt -> slot `slot` of the cache
+#   decode_step        ALL live slots advance one token, each at its OWN
+#                      length (per-row rope position, per-row causal
+#                      frontier, per-row cache column scatter)
+#
+# Static shapes throughout: slot count, prefill width, and max_len are
+# fixed at engine construction, so the whole serving lifetime compiles
+# exactly two programs.  Retirement is a device-side `done` flag (a slot
+# that hits its stop length or EOS stops advancing and drops its cache
+# writes), so freeing + reusing a slot needs no third program — the next
+# prefill_into_slot simply overwrites it.
+# ---------------------------------------------------------------------------
+
+
+def init_slot_state(cfg: TransformerConfig, slots: int, max_len: int,
+                    kv_cache_dtype: str = "model"):
+    """Fresh engine state: every slot retired, caches zeroed.
+
+    The state dict is the carry both jitted entry points thread (and
+    donate): the [layers, slots, max_len, hkv, d] KV cache plus per-slot
+    scalars — lengths (valid cache columns), stop_len (length at which
+    the slot stops sampling), last_token (sampled but not yet in cache),
+    done, and a per-slot PRNG key (uint32[2]) so temperature sampling is
+    per-REQUEST deterministic regardless of co-batched slots.
+    """
+    cache_k, cache_v = init_cache(cfg, slots, max_len, kv_cache_dtype)
+    return {
+        "cache_k": cache_k,
+        "cache_v": cache_v,
+        "lengths": jnp.zeros((slots,), jnp.int32),
+        "stop_len": jnp.zeros((slots,), jnp.int32),
+        "last_token": jnp.zeros((slots,), jnp.int32),
+        "done": jnp.ones((slots,), bool),
+        "keys": jnp.zeros((slots, 2), jnp.uint32),
+    }
+
+
+def _insert_slot_cache(big, small, row, slot, width):
+    """Copy row `row` of a [L, A, width, ...] prefill cache into slot
+    `slot` of the persistent [L, slots, max_len, ...] cache
+    (QTensor-aware).  An out-of-range slot drops the write — that is
+    how unused admission rows of a partially-filled prefill batch
+    become no-ops."""
+    def ins(b, s):
+        return b.at[:, slot, :width].set(s[:, row].astype(b.dtype),
+                                         mode="drop")
+
+    if isinstance(big, QTensor):
+        return QTensor(ins(big.values, small.values),
+                       ins(big.scale, small.scale), big.axes)
+    return ins(big, small)
+
+
+@partial(jax.jit, static_argnums=(0, 3), donate_argnums=(2,))
+def prefill_into_slot(
+    cfg: TransformerConfig,
+    params,
+    state,
+    decode: DecodeConfig,
+    tokens: jax.Array,
+    prompt_len: jax.Array,
+    new_tokens: jax.Array,
+    slot: jax.Array,
+    seed: jax.Array,
+):
+    """Prefill up to A requests into their slots; returns
+    (state, first sampled token per admission row [A]).
+
+    tokens [A, prefill_width]: each row one prompt RIGHT-padded to the
+    engine's static prefill width — causal attention means pad
+    positions can only influence pad positions, so the real prefix
+    computes exactly as it would alone, and the garbage k/v written
+    beyond prompt_len is masked by every later per-row causal frontier
+    until decode writes overtake it column by column.  Right padding
+    (vs generate()'s left padding) is what lets every decode step run
+    pad-free: position i always sits at cache column i, so a slot's
+    per-step KV frontier is its OWN length, never a bucket's.
+
+    A (the admission width) is static and fixed per engine, so this
+    stays ONE compiled program; a call with fewer than A pending
+    requests pads the rest with out-of-range slots, whose writes every
+    scatter drops.  prompt_len/new_tokens/slot/seed are [A] vectors:
+    real token count, per-REQUEST completion budget (the static batcher
+    bakes max_new_tokens into the program — here it is data), target
+    slot, and per-request sampling seed.  A long prompt on a
+    flash-configured model flash-prefills exactly as generate() does
+    (the temp cache is empty, so the static-prefill gate holds).
+    """
+    a, prefill_width = tokens.shape
+    tmp = init_cache(cfg, a, prefill_width, decode.kv_cache_dtype)
+    logits, (tk, tv) = _forward_with_cache(cfg, params, tokens, tmp, 0)
+    last = jnp.take_along_axis(
+        logits, (prompt_len - 1)[:, None, None], axis=1)[:, 0]  # [A, V]
+    # Old-style uint32[2] keys (what a 32-bit jax.random.PRNGKey
+    # builds), stacked per admission row.
+    useed = seed.astype(jnp.uint32)
+    keys = jnp.stack([jnp.zeros_like(useed), useed], axis=-1)
+    split = jax.vmap(jax.random.split)(keys)
+    keys, subs = split[:, 0], split[:, 1]
+    if decode.temperature <= 0.0:
+        tok = jnp.argmax(last, axis=-1)
+    else:
+        tok = jax.vmap(jax.random.categorical)(
+            subs, _filter_logits(decode, last))
+    tok = tok.astype(jnp.int32)
+    # stop_len = length at which no further sampling is needed: after a
+    # step the slot has emitted (lengths - prompt_len + 1) tokens, so
+    # emitted >= new_tokens  <=>  lengths >= prompt_len + new_tokens - 1.
+    stop = prompt_len + jnp.maximum(new_tokens, 1) - 1
+    done = new_tokens <= 1
+    if decode.eos_token >= 0:
+        done = done | (tok == decode.eos_token)
+    ck, cv = state["cache_k"], state["cache_v"]
+    for row in range(a):  # static unroll: one scatter per admission row
+        ck = _insert_slot_cache(ck, tk, row, slot[row], prefill_width)
+        cv = _insert_slot_cache(cv, tv, row, slot[row], prefill_width)
+    state = dict(state)
+    state["cache_k"], state["cache_v"] = ck, cv
+    state["lengths"] = state["lengths"].at[slot].set(
+        prompt_len, mode="drop")
+    state["stop_len"] = state["stop_len"].at[slot].set(stop, mode="drop")
+    state["last_token"] = state["last_token"].at[slot].set(
+        tok, mode="drop")
+    state["done"] = state["done"].at[slot].set(done, mode="drop")
+    state["keys"] = state["keys"].at[slot].set(keys, mode="drop")
+    return state, tok
+
+
+@partial(jax.jit, static_argnums=(0, 3, 4), donate_argnums=(2,))
+def decode_step(cfg: TransformerConfig, params, state,
+                decode: DecodeConfig, steps: int = 1):
+    """Advance every live slot; returns (state, sampled [steps, S]).
+
+    One batched forward at t=1 per step: each slot ropes at its own
+    length, attends under its own causal frontier (vector kv_offset),
+    and scatters its new k/v to its own cache column.  Retired slots
+    ride along with dropped writes and zero emissions — the static
+    shape never changes, so this is the engine's single step program
+    for its whole lifetime.
+
+    ``steps`` (static) fuses that many steps into one program via scan:
+    per-call dispatch and runtime overhead amortize over k tokens at
+    the cost of k-token admission granularity (slots finishing mid-call
+    freeze via `done` on device, so at most k-1 slot-steps idle).  One
+    engine uses ONE value, so the two-program guarantee holds.
+    """
+    def one(state, _):
+        lengths, done = state["lengths"], state["done"]
+        max_len = state["cache_k"].shape[2]
+        advance = ~done
+        # Retired slots park their write out of range; the scatter
+        # drops it.
+        write_cols = jnp.where(advance, lengths, max_len)
+        logits, (ck, cv) = _forward_with_cache(
+            cfg, params, state["last_token"][:, None],
+            (state["cache_k"], state["cache_v"]), lengths,
+            write_cols=write_cols)
+        last = logits[:, -1]
+        if decode.temperature <= 0.0:
+            nxt = jnp.argmax(last, axis=-1)
+            keys = state["keys"]
+        else:
+            # Per-slot keys, split per step: slot r's sample stream
+            # depends only on its own seed and step index, never on
+            # which other requests happen to share the batch.
+            split = jax.vmap(jax.random.split)(state["keys"])
+            keys, subs = split[:, 0], split[:, 1]
+            nxt = jax.vmap(jax.random.categorical)(
+                subs, _filter_logits(decode, last))
+        nxt = jnp.where(advance, nxt.astype(jnp.int32), 0)
+        new_lengths = lengths + advance.astype(jnp.int32)
+        new_done = done | (new_lengths >= state["stop_len"])
+        if decode.eos_token >= 0:
+            new_done = new_done | (advance & (nxt == decode.eos_token))
+        state = dict(state)
+        state["cache_k"], state["cache_v"] = ck, cv
+        state["lengths"] = new_lengths
+        state["last_token"] = nxt
+        state["done"] = new_done
+        state["keys"] = keys
+        return state, nxt
+
+    if steps == 1:  # skip the scan wrapper on the canonical path
+        state, toks = one(state, None)
+        return state, toks[None]
+    state, toks = jax.lax.scan(one, state, None, length=steps)
+    return state, toks

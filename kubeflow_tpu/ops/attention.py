@@ -49,6 +49,8 @@ def dot_product_attention(
 
     kv_offset: absolute position of k[0] relative to q[0]'s frame — used by
     ring attention (rotating kv blocks) and decode (single-query vs cache).
+    A [b] array gives each row its own offset (slot-based continuous
+    decode: co-batched slots sit at different sequence lengths).
     kv_valid_start: per-row [b] first valid key position — keys before it
     are masked for every query (left-padded prompts in bucketed decode:
     pad rows carry garbage keys that must never receive weight).
@@ -114,9 +116,18 @@ def _build_mask(
     """Boolean keep-mask broadcastable to [b, h, q, k]."""
     mask = None
     if causal:
-        q_pos = jnp.arange(q_len)[:, None] + kv_offset
-        k_pos = jnp.arange(k_len)[None, :]
-        mask = (q_pos >= k_pos)[None, None, :, :]
+        if isinstance(kv_offset, jax.Array) and kv_offset.ndim == 1:
+            # Per-ROW offsets ([b]): each row's queries live at their own
+            # absolute positions — the slot-based decode step, where every
+            # slot carries a different sequence length in one batch.
+            q_pos = (jnp.arange(q_len)[None, :, None]
+                     + kv_offset[:, None, None])          # [b, q, 1]
+            k_pos = jnp.arange(k_len)[None, None, :]      # [1, 1, k]
+            mask = (q_pos >= k_pos)[:, None, :, :]        # [b, 1, q, k]
+        else:
+            q_pos = jnp.arange(q_len)[:, None] + kv_offset
+            k_pos = jnp.arange(k_len)[None, :]
+            mask = (q_pos >= k_pos)[None, None, :, :]
     if kv_valid_start is not None:
         valid = (jnp.arange(k_len)[None, :]
                  >= kv_valid_start[:, None])[:, None, None, :]

@@ -135,13 +135,19 @@ class Histogram(_Metric):
                 out.append(f"{self.name}_count 0")
                 return out
             for key, counts in sorted(self._counts.items()):
+                # le labels built outside the f-string expressions:
+                # backslash escapes inside an f-string expression are a
+                # SyntaxError before Python 3.12, and serving must run
+                # on 3.10.
                 for i, b in enumerate(self.buckets):
+                    le = 'le="%s"' % b
                     out.append(
                         f"{self.name}_bucket"
-                        f"{_fmt_labels(key, f'le=\"{b}\"')} {counts[i]}")
+                        f"{_fmt_labels(key, le)} {counts[i]}")
+                le_inf = 'le="+Inf"'
                 out.append(
                     f"{self.name}_bucket"
-                    f"{_fmt_labels(key, 'le=\"+Inf\"')} {counts[-1]}")
+                    f"{_fmt_labels(key, le_inf)} {counts[-1]}")
                 out.append(
                     f"{self.name}_sum{_fmt_labels(key)} {self._sums[key]}")
                 out.append(

@@ -1,0 +1,532 @@
+"""Continuous-batching LM decode engine: slot-based serving loop.
+
+The static batchers (MicroBatcher / BucketedLMBatcher) dispatch whole
+``generate()`` programs: a batch is assembled, padded, and OWNED by one
+device program from prefill to the last token.  Two structural costs
+follow — a request that arrives mid-generation waits for the entire
+program, and every row pays the batch bucket's padded KV span on every
+decode step (models/generate.py's docstring measures ~6x wasted decode
+compute on wide length distributions).
+
+This engine runs the slot entry points instead (models/generate.py:
+``prefill_into_slot`` / ``decode_step``) over ONE persistent KV cache of
+``slots`` rows:
+
+  - a dedicated step loop advances all live slots one token per
+    ``decode_step`` call;
+  - new requests are admitted into free slots BETWEEN steps (prefill
+    interleaved with decode) — admission latency is one step, not one
+    generation;
+  - finished rows retire immediately (device-side ``done`` flag) and
+    their slots are reused — no request ever waits for the batch to
+    drain, and per-request ``max_new_tokens`` is data, not a compiled
+    constant;
+  - every shape is static, so the engine's whole lifetime compiles
+    exactly two programs (prefill, step).
+
+The host loop reads sampled tokens with a small LAG (``sync_lag``
+steps): step N+lag is dispatched before step N's tokens are
+materialized, so host bookkeeping overlaps device compute instead of
+serializing on it.  Completion is detected deterministically from the
+per-request budget (and, when EOS is configured, from the lagged token
+stream — the device flag has already frozen the slot by then, so the
+lag costs at most ``sync_lag`` idle slot-steps).
+
+Interface-compatible with the batchers (submit/accepts/stats/close), so
+ModelServer.enable_batching wires it behind the REST and gRPC surfaces
+unchanged.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from kubeflow_tpu.serving.model_server import BatcherClosed, locked_snapshot
+
+# Step-duration histogram buckets: decode steps run ~0.1 ms (tiny CPU
+# smoke models) to ~100 ms (big models over a slow tunnel).
+_STEP_BUCKETS = (.0005, .001, .0025, .005, .01, .025, .05, .1, .25, .5,
+                 1.0, 2.5)
+
+
+class DecodeEngine:
+    """Continuous-batching decode over a persistent slot-based KV cache.
+
+    Args:
+      cfg/params/decode: the loaded model (loaders.lm_generate exposes
+        them as ``predict.engine_spec`` — params already staged to HBM).
+      slots: concurrent sequences (the persistent cache's row count).
+      prefill_len: static prompt width; prompts are right-padded to it.
+      max_len: cache columns per slot (default prefill_len +
+        decode.max_new_tokens).
+      sync_lag: how many step calls the host may run ahead of token
+        materialization (0 = fully synchronous loop).
+      steps_per_call: decode steps fused into one step-program call
+        (models/generate.py decode_step's static ``steps``): per-call
+        dispatch overhead amortizes over k tokens, admission waits at
+        most k steps.  One engine uses one value, so the two-program
+        guarantee holds either way.
+      admit_width: prefill program admission rows (static) — up to this
+        many queued requests prefill in ONE call; a burst of arrivals
+        amortizes per-call overhead instead of paying one serialized
+        prefill per request.  Unused rows are dropped on device.
+    """
+
+    def __init__(
+        self,
+        cfg,
+        params,
+        decode,
+        *,
+        slots: int = 8,
+        prefill_len: int = 256,
+        max_len: Optional[int] = None,
+        sync_lag: int = 2,
+        steps_per_call: int = 1,
+        admit_width: int = 4,
+        name: str = "engine",
+    ):
+        from kubeflow_tpu.models.generate import init_slot_state
+        from kubeflow_tpu.runtime.prom import REGISTRY
+
+        if slots < 1:
+            raise ValueError(f"slots must be >= 1, got {slots}")
+        self.cfg = cfg
+        self.params = params
+        self.decode = decode
+        self.slots = slots
+        self.prefill_len = int(prefill_len)
+        if self.prefill_len < 1:
+            # A non-positive width silently rejects EVERY prompt via
+            # accepts() — all traffic would fall back to the direct
+            # path while the engine holds a cache and a thread.  Can
+            # arise from the serving entrypoint's derived default when
+            # an export config has max_new_tokens >= max_seq_len.
+            raise ValueError(
+                f"prefill_len must be >= 1, got {self.prefill_len}")
+        self.max_len = int(max_len or prefill_len + decode.max_new_tokens)
+        if self.max_len <= self.prefill_len:
+            raise ValueError(
+                f"max_len {self.max_len} leaves no decode room beyond "
+                f"prefill_len {self.prefill_len}")
+        if getattr(cfg, "max_seq_len", self.max_len) < self.max_len:
+            raise ValueError(
+                f"max_len {self.max_len} exceeds model max_seq_len "
+                f"{cfg.max_seq_len}")
+        self.sync_lag = max(0, int(sync_lag))
+        self.steps_per_call = max(1, int(steps_per_call))
+        self.admit_width = max(1, min(int(admit_width), slots))
+        self._eos = decode.eos_token >= 0
+        self._state = init_slot_state(cfg, slots, self.max_len,
+                                      decode.kv_cache_dtype)
+        # AOT executables, built lazily by the loop thread: the step
+        # loop calls its two programs thousands of times per second,
+        # and the jitted wrapper re-hashes the whole params pytree
+        # signature per call (~0.4 ms on the smoke config — comparable
+        # to the step itself).  lower().compile() once, then call the
+        # executable.  This is also the two-program guarantee made
+        # literal: these two fields ARE the engine's compiled programs.
+        self._prefill_exec = None
+        self._step_exec = None
+
+        self._lock = threading.Lock()
+        self._work = threading.Condition(self._lock)
+        self._queue: List[dict] = []
+        self._stopped = False
+        self._drain_deadline: Optional[float] = None
+        # Host-side slot table: None = free, else the live request entry.
+        self._slot_req: List[Optional[dict]] = [None] * slots
+        # (tokens_array, [(slot, entry), ...]) emissions not yet read.
+        self._pending: List[tuple] = []
+        # Counters (mutated by the loop thread, snapshotted under the
+        # lock — the same locked-snapshot discipline MicroBatcher uses).
+        self._counters = {
+            "requests": 0, "tokens": 0, "steps": 0, "prefills": 0,
+            "occupancy_sum": 0, "busy_s": 0.0, "in_flight": 0,
+        }
+        self._step_times: List[float] = []   # bounded reservoir
+        self._metric_name = name
+        self._occ_gauge = REGISTRY.gauge(
+            "kft_engine_active_slots",
+            "decode engine live slots, by engine")
+        self._queue_gauge = REGISTRY.gauge(
+            "kft_engine_queue_depth",
+            "decode engine admission queue depth, by engine")
+        self._tok_counter = REGISTRY.counter(
+            "kft_engine_tokens_total",
+            "tokens emitted by the decode engine, by engine")
+        self._step_hist = REGISTRY.histogram(
+            "kft_engine_step_seconds",
+            "decode engine per-step (= per-token) latency, by engine",
+            buckets=_STEP_BUCKETS,
+        ).declare(engine=name)
+        self._occ_gauge.set(0, engine=name)
+        self._queue_gauge.set(0, engine=name)
+        # Last values pushed to the gauges — the step loop only touches
+        # the (locked) registry when a value actually changes.
+        self._occ_last = 0
+        self._queue_last = 0
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name=f"decode-engine-{name}")
+        self._thread.start()
+
+    # -- client surface ---------------------------------------------------
+
+    def accepts(self, inputs: Dict[str, Any]) -> bool:
+        """ModelServer routing hook: prompts beyond the static prefill
+        width fall back to the direct generate() path."""
+        tokens = np.asarray(inputs.get("tokens", ()))
+        length = tokens.shape[-1] if tokens.ndim else 0
+        return bool(0 < length <= self.prefill_len)
+
+    def submit(self, inputs: Dict[str, Any]) -> Dict[str, Any]:
+        """One request: tokens [t] or [1, t]; optional per-request
+        ``max_new_tokens`` (<= engine headroom) and sampling ``seed``.
+        Blocks until the completion is ready; returns
+        {"tokens": [1, t + emitted]}."""
+        tokens = np.asarray(inputs["tokens"], np.int32)
+        if tokens.ndim == 1:
+            tokens = tokens[None]
+        n, length = tokens.shape
+        if n != 1:
+            raise ValueError(
+                f"DecodeEngine.submit takes one prompt per call (got "
+                f"batch dim {n}); submit rows separately")
+        if not 0 < length <= self.prefill_len:
+            raise ValueError(
+                f"prompt length {length} outside (0, {self.prefill_len}]"
+                f" (engine prefill width)")
+        new = int(np.asarray(inputs.get(
+            "max_new_tokens", self.decode.max_new_tokens)).reshape(()))
+        if new < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, got {new}")
+        # Same budget contract as every other serving path: the export
+        # config's max_new_tokens is the ceiling (a client cannot buy a
+        # bigger completion than the model advertises), and the cache
+        # headroom caps it further.
+        new = min(new, self.decode.max_new_tokens, self.max_len - length)
+        seed = int(np.asarray(inputs.get("seed", 0)).reshape(()))
+        entry = {
+            "tokens": tokens, "new": new, "seed": seed,
+            "emitted": [], "scheduled": 0, "slot": None,
+            "event": threading.Event(), "out": None, "err": None,
+            "t": time.monotonic(),
+        }
+        with self._lock:
+            if self._stopped:
+                raise BatcherClosed(
+                    f"engine {self._metric_name!r} is closed")
+            self._queue.append(entry)
+            self._set_queue_gauge(len(self._queue))
+            self._work.notify()
+        entry["event"].wait()
+        if entry["err"] is not None:
+            raise entry["err"]
+        return entry["out"]
+
+    def compiled_programs(self) -> Dict[str, int]:
+        """How many device programs this engine has compiled — by
+        construction at most one prefill and one step executable (the
+        build sites are None-guarded), so a healthy engine reports
+        {"prefill": 1, "step": 1} for its whole lifetime."""
+        return {"prefill": int(self._prefill_exec is not None),
+                "step": int(self._step_exec is not None)}
+
+    def stats(self) -> Dict[str, Any]:
+        """Locked snapshot of the engine counters: occupancy, queue
+        depth, throughput, and per-token (= per-step) latency."""
+        c, extra = locked_snapshot(
+            self._lock, self._counters,
+            lambda: {
+                "queue_depth": len(self._queue),
+                "active_slots": sum(
+                    r is not None for r in self._slot_req),
+                "step_times": list(self._step_times),
+            })
+        steps = c["steps"]
+        times = sorted(extra["step_times"])
+
+        def pct(q):
+            if not times:
+                return 0.0
+            return round(times[min(len(times) - 1,
+                                   int(len(times) * q))] * 1e3, 3)
+
+        return {
+            "requests": c["requests"],
+            "tokens": c["tokens"],
+            "steps": steps,
+            "prefills": c["prefills"],
+            "slots": self.slots,
+            "active_slots": extra["active_slots"],
+            "queue_depth": extra["queue_depth"],
+            # Admitted but not yet delivered.  THIS is the drain signal:
+            # deterministic retirement frees a slot at dispatch (before
+            # the lagged emission reaches its client), so active_slots
+            # can touch zero while completions are still in flight.
+            "in_flight_requests": c["in_flight"],
+            "mean_occupancy": round(c["occupancy_sum"] / steps, 2)
+            if steps else 0.0,
+            "tokens_per_sec": round(c["tokens"] / c["busy_s"], 1)
+            if c["busy_s"] else 0.0,
+            "token_latency_p50_ms": pct(0.50),
+            "token_latency_p95_ms": pct(0.95),
+        }
+
+    def close(self, drain_s: float = 10.0) -> None:
+        """Deterministic shutdown: refuse new work, give in-flight
+        requests ``drain_s`` to finish, fail whatever remains with
+        BatcherClosed, and join the loop thread (bounded — mirrors
+        ModelServer.stop(); no background-thread leakage across a test
+        session)."""
+        with self._lock:
+            if self._stopped:
+                self._work.notify_all()
+            else:
+                self._stopped = True
+                self._drain_deadline = time.monotonic() + max(0.0, drain_s)
+                self._work.notify_all()
+        self._thread.join(timeout=max(5.0, drain_s + 5.0))
+        # A closed engine exports no live slots or queue: hot-swap
+        # retires the metric series at zero instead of freezing a
+        # stale occupancy in /metrics forever.
+        self._set_occ_gauge(0)
+        self._set_queue_gauge(0)
+
+    # -- step loop --------------------------------------------------------
+
+    def _free_slots_locked(self) -> List[int]:
+        return [i for i, r in enumerate(self._slot_req) if r is None]
+
+    def _set_queue_gauge(self, depth: int) -> None:
+        if depth != self._queue_last:
+            self._queue_last = depth
+            self._queue_gauge.set(depth, engine=self._metric_name)
+
+    def _set_occ_gauge(self, active: int) -> None:
+        if active != self._occ_last:
+            self._occ_last = active
+            self._occ_gauge.set(active, engine=self._metric_name)
+
+    def _admit(self, batch: List[tuple]) -> None:
+        """Prefill up to admit_width requests into their slots in ONE
+        program call (dispatch only — the first sampled tokens join the
+        lagged pending stream).  Unused admission rows point at an
+        out-of-range slot; the device drops their writes."""
+        from kubeflow_tpu.models.generate import prefill_into_slot
+
+        a = self.admit_width
+        tokens = np.zeros((a, self.prefill_len), np.int32)
+        plen = np.ones((a,), np.int32)
+        new = np.ones((a,), np.int32)
+        slots = np.full((a,), self.slots, np.int32)  # OOB = dropped
+        seeds = np.zeros((a,), np.int32)
+        snapshot = []
+        for row, (entry, slot) in enumerate(batch):
+            t = entry["tokens"]
+            tokens[row, :t.shape[1]] = t[0]
+            plen[row] = t.shape[1]
+            new[row] = entry["new"]
+            slots[row] = slot
+            seeds[row] = entry["seed"]
+            entry["scheduled"] = 1  # slot claimed at queue pop, locked
+            snapshot.append((row, entry))
+        if self._prefill_exec is None:
+            self._prefill_exec = prefill_into_slot.lower(
+                self.cfg, self.params, self._state, self.decode, tokens,
+                plen, new, slots, seeds).compile()
+        t0 = time.perf_counter()
+        self._state, first = self._prefill_exec(
+            self.params, self._state, tokens, plen, new, slots, seeds)
+        dt = time.perf_counter() - t0
+        self._pending.append((first, snapshot))
+        with self._lock:
+            self._counters["prefills"] += len(batch)
+            # Prefill emits each request's first token, so its compute
+            # belongs in busy_s — tokens_per_sec must not count tokens
+            # whose cost was never measured (short-completion workloads
+            # would otherwise read up to ~2x the real rate).
+            self._counters["busy_s"] += dt
+
+    def _finish(self, entry: dict) -> None:
+        """Resolve a completed request: prompt + emitted tokens."""
+        out = np.concatenate(
+            [entry["tokens"],
+             np.asarray(entry["emitted"], np.int32)[None]], axis=1)
+        entry["out"] = {"tokens": out}
+        entry["event"].set()
+
+    def _drain_one(self) -> None:
+        """Materialize the oldest pending emission and hand its tokens
+        to their requests; retire + resolve the ones that completed.
+        Counter merges are batched: one locked update per drained call,
+        not per token."""
+        arr, snapshot = self._pending.pop(0)
+        host = np.asarray(arr)
+        if host.ndim < 2:   # prefill emission: [A] first tokens, the
+            host = host[None]   # snapshot's cols are admission rows
+        emitted = 0
+        finished = 0
+        for row in host:           # fused calls carry [steps, slots]
+            for col, entry in snapshot:
+                if entry["event"].is_set() or len(entry["emitted"]) >= \
+                        entry["new"]:
+                    continue
+                tok = int(row[col])
+                entry["emitted"].append(tok)
+                emitted += 1
+                complete = len(entry["emitted"]) >= entry["new"] or (
+                    self._eos and tok == self.decode.eos_token)
+                if complete:
+                    # The device `done` flag froze this slot at the
+                    # same step, so freeing it here (possibly sync_lag
+                    # calls late on the EOS path) never races the cache.
+                    if self._slot_req[entry["slot"]] is entry:
+                        self._slot_req[entry["slot"]] = None
+                    self._finish(entry)
+                    finished += 1
+        with self._lock:
+            self._counters["tokens"] += emitted
+            self._counters["requests"] += finished
+            self._counters["in_flight"] -= finished
+        if emitted:
+            self._tok_counter.inc(emitted, engine=self._metric_name)
+
+    def _run(self) -> None:
+        from kubeflow_tpu.models.generate import decode_step
+
+        try:
+            while True:
+                with self._lock:
+                    while (not self._queue
+                           and all(r is None for r in self._slot_req)
+                           and not self._pending and not self._stopped):
+                        self._work.wait()
+                    if self._stopped and not self._queue \
+                            and all(r is None for r in self._slot_req) \
+                            and not self._pending:
+                        return
+                    stopping = self._stopped
+                    past_drain = (stopping and self._drain_deadline
+                                  is not None and time.monotonic()
+                                  > self._drain_deadline)
+                    admissions = []
+                    if not stopping:
+                        free = self._free_slots_locked()
+                        while free and self._queue:
+                            entry = self._queue.pop(0)
+                            slot = free.pop(0)
+                            # Claim the slot and bump in_flight in the
+                            # same locked section that pops the queue:
+                            # stats() must never see queue_depth==0 AND
+                            # in_flight_requests==0 while a request is
+                            # live (monitors treat that as "drained"),
+                            # and an entry registered here is reachable
+                            # by _abort even if its prefill dispatch
+                            # dies.
+                            entry["slot"] = slot
+                            self._slot_req[slot] = entry
+                            self._counters["in_flight"] += 1
+                            admissions.append((entry, slot))
+                        self._set_queue_gauge(len(self._queue))
+                if past_drain:
+                    self._abort(RuntimeError(
+                        f"engine {self._metric_name!r} drain deadline "
+                        "exceeded at close"))
+                    return
+                if stopping:
+                    # Refuse queued work immediately; keep stepping only
+                    # to drain in-flight slots.
+                    self._fail_queue(BatcherClosed(
+                        f"engine {self._metric_name!r} is closed"))
+                for i in range(0, len(admissions), self.admit_width):
+                    self._admit(admissions[i:i + self.admit_width])
+                active = sum(r is not None for r in self._slot_req)
+                self._set_occ_gauge(active)
+                if active:
+                    k = self.steps_per_call
+                    # Build (one-time) OUTSIDE the timed window: the
+                    # first per-token latency sample must not carry
+                    # seconds of XLA compile into the p50/p95 stats and
+                    # the step histogram.
+                    if self._step_exec is None:
+                        self._step_exec = decode_step.lower(
+                            self.cfg, self.params, self._state,
+                            self.decode, k).compile()
+                    t0 = time.perf_counter()
+                    self._state, sampled = self._step_exec(
+                        self.params, self._state)
+                    self._pending.append((sampled, [
+                        (i, r) for i, r in enumerate(self._slot_req)
+                        if r is not None]))
+                    # Deterministic retirement: with no EOS in play a
+                    # request's completion step is known at dispatch —
+                    # free the slot NOW so the next admission overlaps
+                    # the lagged read instead of waiting for it.  The
+                    # request stays visible in in_flight until its
+                    # lagged emission is delivered.
+                    for i, r in enumerate(self._slot_req):
+                        if r is None:
+                            continue
+                        r["scheduled"] = min(r["new"],
+                                             r["scheduled"] + k)
+                        if not self._eos and r["scheduled"] >= r["new"]:
+                            self._slot_req[i] = None
+                    while len(self._pending) > self.sync_lag:
+                        self._drain_one()
+                    dt = time.perf_counter() - t0
+                    per_step = dt / k
+                    with self._lock:
+                        self._counters["steps"] += k
+                        self._counters["occupancy_sum"] += active * k
+                        self._counters["busy_s"] += dt
+                        self._step_times.append(per_step)
+                        if len(self._step_times) > 4096:
+                            del self._step_times[:2048]
+                    self._step_hist.observe(per_step,
+                                            engine=self._metric_name)
+                else:
+                    while self._pending:
+                        self._drain_one()
+                self._set_occ_gauge(
+                    sum(r is not None for r in self._slot_req))
+        except BaseException as exc:  # noqa: BLE001 — fail loudly to waiters
+            self._abort(exc)
+
+    def _fail_queue(self, exc: Exception) -> None:
+        with self._lock:
+            queued, self._queue = self._queue, []
+            self._set_queue_gauge(0)
+        for entry in queued:
+            entry["err"] = exc
+            entry["event"].set()
+
+    def _abort(self, exc: BaseException) -> None:
+        """Engine death: every waiter gets the error, nobody hangs."""
+        with self._lock:
+            self._stopped = True
+            self._counters["in_flight"] = 0
+        err = exc if isinstance(exc, Exception) else \
+            RuntimeError(f"engine loop died: {exc!r}")
+        self._fail_queue(err)
+        # Fail live slots AND requests whose slots were already
+        # deterministically retired but whose lagged emissions still sit
+        # in _pending — those entries are in neither the queue nor the
+        # slot table, and clearing _pending without resolving them would
+        # leave their clients parked in submit() forever.
+        for i, entry in enumerate(self._slot_req):
+            if entry is not None and not entry["event"].is_set():
+                entry["err"] = err
+                entry["event"].set()
+            self._slot_req[i] = None
+        for _, snapshot in self._pending:
+            for _, entry in snapshot:
+                if not entry["event"].is_set():
+                    entry["err"] = err
+                    entry["event"].set()
+        self._pending.clear()
+        self._set_occ_gauge(0)

@@ -99,7 +99,14 @@ class PredictionServicer:
         resp = pb.GetModelMetadataResponse()
         resp.model_spec.name = model.name
         resp.model_spec.version = model.version
-        resp.metadata_json = json.dumps(model.meta)
+        meta = dict(model.meta)
+        # Live batching-plane stats ride the metadata face (the REST
+        # side serves the same snapshot on /model/<name>:stats) — gRPC
+        # clients monitoring engine occupancy need no extra RPC.
+        batcher_stats = self.server.batcher_stats(model.name)
+        if batcher_stats is not None:
+            meta["batcher_stats"] = batcher_stats
+        resp.metadata_json = json.dumps(meta)
         return resp
 
 

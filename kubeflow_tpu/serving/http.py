@@ -32,6 +32,7 @@ WELCOME = "kubeflow-tpu model server"
 
 _ROUTES = [
     ("GET", re.compile(r"^/model/(?P<name>[^/:]+):metadata$"), "metadata"),
+    ("GET", re.compile(r"^/model/(?P<name>[^/:]+):stats$"), "stats"),
     ("POST", re.compile(r"^/model/(?P<name>[^/:]+):predict$"), "predict"),
     ("POST", re.compile(r"^/model/(?P<name>[^/:]+):classify$"), "classify"),
     ("POST", re.compile(
@@ -109,6 +110,17 @@ class ServingAPI:
             },
         }
 
+    def stats(self, name: str) -> Dict[str, Any]:
+        """Live batching-plane stats for one model: the DecodeEngine's
+        slot occupancy / tokens-per-sec / queue depth / per-token
+        latency, or a batcher's dispatch profile (null on the direct
+        path)."""
+        model = self.server.get(name)  # 404 on unknown names
+        return {
+            "model_spec": {"name": name, "version": str(model.version)},
+            "batcher": self.server.batcher_stats(name),
+        }
+
     def predict(
         self, name: str, body: Dict[str, Any],
         version: Optional[int] = None,
@@ -183,6 +195,8 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(200, REGISTRY.render(), raw=True)
         elif action == "metadata":
             self._send(200, self.api.metadata(groups["name"]))
+        elif action == "stats":
+            self._send(200, self.api.stats(groups["name"]))
         else:
             import time as _time
 

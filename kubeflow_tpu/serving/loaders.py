@@ -165,17 +165,51 @@ def lm_generate(config: Dict[str, Any]) -> Callable:
 
         def predict(inputs: Dict[str, Any]) -> Dict[str, Any]:
             tokens = jnp.asarray(inputs["tokens"], jnp.int32)
+            sd = inputs.get("seed")
+            # Same sampling-seed contract as the DecodeEngine: a seeded
+            # request falling back to this path (prompt too wide for
+            # the engine, or engine disabled) must not silently sample
+            # from the fixed default stream.  One seed per CALL — the
+            # BucketedLMBatcher declines seeded requests so they arrive
+            # here unbatched.
+            rng = None
+            if sd is not None:
+                rng = jax.random.PRNGKey(
+                    int(jnp.asarray(sd).reshape(-1)[0]))
             plen = inputs.get("prompt_len")
             if plen is not None:
                 # Left-padded bucketed batch (BucketedLMBatcher): rows
                 # decode at their real lengths; pad keys are masked.
                 plen = jnp.asarray(plen, jnp.int32).reshape(-1)
                 out, _ = generate(cfg, params, tokens, decode,
-                                  prompt_len=plen)
+                                  rng=rng, prompt_len=plen)
             else:
-                out, _ = generate(cfg, params, tokens, decode)
+                out, _ = generate(cfg, params, tokens, decode, rng=rng)
+            req = inputs.get("max_new_tokens")
+            if req is not None:
+                # Per-request completion budget, same contract as the
+                # DecodeEngine: a prompt that falls back to this path
+                # (too wide for the engine's prefill width, or the
+                # engine disabled) must not silently get the config's
+                # full budget instead.  generate() still decodes the
+                # full program; only the surplus is trimmed.  The
+                # output array is rectangular, so a MULTI-row direct
+                # call trims every row to the batch's LARGEST budget
+                # (rows asking for less still get at least what they
+                # asked); per-row budgets need per-row calls or the
+                # engine/batcher paths.
+                lim = int(jnp.max(jnp.asarray(req)))
+                lim = max(1, min(lim, decode.max_new_tokens))
+                out = out[:, : tokens.shape[1] + lim]
             return {"tokens": out}
 
+        # Continuous-batching hook: the DecodeEngine (serving/engine.py)
+        # needs the model itself — config, HBM-staged params, decode
+        # settings — not a predict closure.  Exposing them here lets the
+        # serving entrypoint build the engine around every hot-swapped
+        # version exactly as it rebuilds batchers.
+        predict.engine_spec = {"cfg": cfg, "params": params,
+                               "decode": decode}
         return predict
 
     return make_predict

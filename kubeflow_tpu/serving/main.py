@@ -24,14 +24,25 @@ from kubeflow_tpu.serving.model_server import ModelServer
 
 def batcher_factory(*, micro_batch_size: int, batch_timeout_s: float,
                     lm_buckets: str = "",
-                    lm_max_promotion_factor: float = 4.0):
+                    lm_max_promotion_factor: float = 4.0,
+                    lm_engine: bool = True,
+                    lm_engine_slots: int = 8,
+                    lm_engine_prefill_len: int = 0,
+                    lm_engine_sync_lag: int = 2,
+                    lm_engine_steps_per_call: int = 1,
+                    lm_engine_admit_width: int = 4):
     """ModelServer.enable_batching factory: picks the batcher per model.
 
-    lm_generate models with buckets get the left-padding
-    BucketedLMBatcher (mixed-length prompts share decode programs);
-    everything else gets the shape-grouped MicroBatcher.  Rebuilt around
-    every hot-swapped version by ModelServer.
+    lm_generate models default to the continuous-batching DecodeEngine
+    (serving/engine.py: persistent slot cache, in-flight admission,
+    immediate retirement); ``lm_engine=False`` (--lm_static_batcher)
+    falls back to the static left-padding BucketedLMBatcher when
+    buckets are configured.  Everything else gets the shape-grouped
+    MicroBatcher when micro-batching is on, or no batcher at all
+    (build returns None -> direct predict path).  Rebuilt around every
+    hot-swapped version by ModelServer.
     """
+    from kubeflow_tpu.serving.engine import DecodeEngine
     from kubeflow_tpu.serving.model_server import (
         BucketedLMBatcher,
         MicroBatcher,
@@ -44,6 +55,46 @@ def batcher_factory(*, micro_batch_size: int, batch_timeout_s: float,
     buckets = [int(b) for b in lm_buckets.split(",") if b.strip()]
 
     def build(model):
+        spec = getattr(model.predict, "engine_spec", None)
+        if lm_engine and spec is not None:
+            # Prefill width: explicit flag > largest bucket > a capped
+            # share of whatever prompt room the model's max_seq_len
+            # leaves after the configured completion budget.  The width
+            # is a STATIC program shape (the two-program guarantee), so
+            # every admission prefills at this width no matter how
+            # short the prompt, and the persistent cache is sized
+            # slots x (width + budget) — hence the flagless cap: a
+            # 2048-ctx model must not pay near-full-context prefill
+            # per admission by default.  Prompts beyond the width fall
+            # back to the direct generate() path (exactly the old
+            # flagless behavior), and everything is clamped to the
+            # model's real prompt room so a config that fit the static
+            # batchers never turns into a construction crash here; if
+            # no room is left at all, fall through to the static paths.
+            cap = (spec["cfg"].max_seq_len
+                   - spec["decode"].max_new_tokens)
+            prefill = lm_engine_prefill_len or (
+                max(buckets) if buckets else min(cap, 512))
+            prefill = min(prefill, cap)
+            if prefill >= 1:
+                logging.info(
+                    "decode engine for %r v%d: %d slots, prefill width "
+                    "%d, cache %d cols/slot", model.name, model.version,
+                    lm_engine_slots, prefill,
+                    prefill + spec["decode"].max_new_tokens)
+                return DecodeEngine(
+                    spec["cfg"], spec["params"], spec["decode"],
+                    slots=lm_engine_slots, prefill_len=prefill,
+                    sync_lag=lm_engine_sync_lag,
+                    steps_per_call=lm_engine_steps_per_call,
+                    admit_width=lm_engine_admit_width,
+                    name=f"{model.name}-v{model.version}")
+            logging.warning(
+                "decode engine disabled for %r: max_new_tokens %d "
+                "leaves no prompt room in max_seq_len %d", model.name,
+                spec["decode"].max_new_tokens, spec["cfg"].max_seq_len)
+        if micro_batch_size <= 0:
+            return None  # direct predict path
         kwargs = dict(
             max_batch_size=micro_batch_size,
             batch_timeout_s=batch_timeout_s,
@@ -94,12 +145,46 @@ def main(argv=None) -> int:
                          "more than factor x its own bucket's KV span "
                          "per decode step); <=0 = unbounded, one "
                          "shared queue")
+    ap.add_argument("--lm_static_batcher", action="store_true",
+                    help="serve lm_generate models through the static "
+                         "BucketedLMBatcher (pad-at-dispatch whole-"
+                         "generation programs) instead of the default "
+                         "continuous-batching DecodeEngine")
+    ap.add_argument("--lm_engine_slots", type=int, default=8,
+                    help="DecodeEngine concurrent sequences (persistent "
+                         "KV-cache rows)")
+    ap.add_argument("--lm_engine_prefill_len", type=int, default=0,
+                    help="DecodeEngine static prompt width (0 = largest "
+                         "--lm_buckets entry, else max_seq_len minus "
+                         "max_new_tokens capped at 512; always clamped "
+                         "to the model's prompt room); longer prompts "
+                         "fall back to the direct generate() path.  "
+                         "Every admission prefills at this width and "
+                         "the persistent KV cache is sized by it — set "
+                         "it near your real prompt lengths on long-"
+                         "context models")
+    ap.add_argument("--lm_engine_sync_lag", type=int, default=2,
+                    help="DecodeEngine host-read lag in steps (host "
+                         "dispatches ahead of token materialization; "
+                         "0 = synchronous loop)")
+    ap.add_argument("--lm_engine_steps_per_call", type=int, default=1,
+                    help="DecodeEngine decode steps fused per step-"
+                         "program call: amortizes per-dispatch overhead "
+                         "k-fold at k-step admission granularity")
+    ap.add_argument("--lm_engine_admit_width", type=int, default=4,
+                    help="DecodeEngine prefill admission rows per call: "
+                         "bursts of arrivals prefill together instead "
+                         "of one serialized prefill per request")
     args = ap.parse_args(argv)
 
     logging.basicConfig(level=logging.INFO, stream=sys.stderr)
     server = ModelServer(poll_interval_s=args.poll_interval_s)
     server.add_model(args.model_name, args.model_base_path)
-    if args.micro_batch_size > 0:
+    # The factory is installed whenever ANY batching path might apply:
+    # lm_generate models default to the continuous DecodeEngine even
+    # with micro-batching off (it is the serving hot path, not an
+    # opt-in); --lm_static_batcher restores the old behavior.
+    if args.micro_batch_size > 0 or not args.lm_static_batcher:
         server.enable_batching(
             args.model_name,
             batcher_factory(
@@ -107,12 +192,22 @@ def main(argv=None) -> int:
                 batch_timeout_s=args.batch_timeout_ms / 1e3,
                 lm_buckets=args.lm_buckets,
                 lm_max_promotion_factor=args.lm_max_promotion_factor,
+                lm_engine=not args.lm_static_batcher,
+                lm_engine_slots=args.lm_engine_slots,
+                lm_engine_prefill_len=args.lm_engine_prefill_len,
+                lm_engine_sync_lag=args.lm_engine_sync_lag,
+                lm_engine_steps_per_call=args.lm_engine_steps_per_call,
+                lm_engine_admit_width=args.lm_engine_admit_width,
             ),
         )
-        logging.info("request batching on: size<=%d, window %.1f ms%s",
-                     args.micro_batch_size, args.batch_timeout_ms,
-                     f", lm buckets {args.lm_buckets}"
-                     if args.lm_buckets else "")
+        logging.info(
+            "request batching on: %s%s",
+            ("continuous decode engine (slots=%d)"
+             % args.lm_engine_slots if not args.lm_static_batcher
+             else "static batchers"),
+            (", micro batch size<=%d, window %.1f ms"
+             % (args.micro_batch_size, args.batch_timeout_ms)
+             if args.micro_batch_size > 0 else ""))
     server.start_watcher()
     httpd, _ = make_http_server(server, port=args.port, host=args.host)
     grpc_server = None

@@ -32,6 +32,20 @@ class BatcherClosed(RuntimeError):
     reference (hot-swap races) retry against the replacement."""
 
 
+def locked_snapshot(lock, data: Dict[str, Any],
+                    extra: Optional[Callable[[], Dict[str, Any]]] = None):
+    """Copy mutable stats counters under their owning lock.
+
+    Returns (dict(data), extra() or {}) taken atomically.  Every stats()
+    surface (MicroBatcher, BucketedLMBatcher, DecodeEngine) reads its
+    counters through this ONE helper, and writers merge under the same
+    lock — a /metrics scrape mid-dispatch must never see a torn
+    half-updated cycle profile or an occupancy that sums to more
+    requests than exist."""
+    with lock:
+        return dict(data), (extra() if extra is not None else {})
+
+
 # One name/help for the request counter shared by the REST and gRPC
 # faces — divergent literals would silently create a second series.
 REQUESTS_TOTAL = "kft_serving_requests_total"
@@ -96,14 +110,35 @@ class ModelServer:
                 del self._models[name][v]
             old_batcher = self._batchers.pop(name, None)
             factory = self._batcher_factories.get(name)
-            if factory is not None:
-                self._batchers[name] = factory(model)
-        if old_batcher is not None:
-            # Outside the lock: close blocks on in-flight requests, which
-            # themselves may be waiting on get()/predict().
-            old_batcher.close()
+        self._swap_batcher(name, factory, model, old_batcher)
         log.info("model %r now serving version %d", name, latest)
         return True
+
+    def _swap_batcher(self, name, factory, model, old_batcher) -> None:
+        """Close-old / build / install / close-displaced, the ONE
+        batcher swap sequence (reload and enable_batching share it).
+
+        Runs outside the server lock: close blocks on in-flight
+        requests, which themselves may be waiting on get()/predict().
+        The old batcher closes BEFORE the successor is built — a
+        DecodeEngine owns a device-resident KV cache, and build-then-
+        close would hold two at once (OOM on models sized to fit one);
+        requests landing in the gap take the direct predict path.  A
+        factory may decline a model (return None) — e.g. the serving
+        entrypoint's factory engines LM models but leaves others on
+        the direct path when micro-batching is off — which DISABLES
+        batching rather than leaving the old batcher serving."""
+        if old_batcher is not None:
+            old_batcher.close()
+        if factory is None or model is None:
+            return
+        batcher = factory(model)
+        if batcher is not None:
+            with self._lock:
+                displaced = self._batchers.get(name)
+                self._batchers[name] = batcher
+            if displaced is not None and displaced is not batcher:
+                displaced.close()  # lost a swap race; don't leak it
 
     def start_watcher(self) -> None:
         """Background version polling — the hot-swap path."""
@@ -140,8 +175,8 @@ class ModelServer:
             versions = self._models.get(name)
             if versions:
                 model = versions[max(versions)]
-            if model is not None:
-                self._batchers[name] = factory(model)
+            old_batcher = self._batchers.pop(name, None)
+        self._swap_batcher(name, factory, model, old_batcher)
 
     def stop(self) -> None:
         self._stop.set()
@@ -177,6 +212,15 @@ class ModelServer:
     def has_model(self, name: str) -> bool:
         with self._lock:
             return name in self._models
+
+    def batcher_stats(self, name: str) -> Optional[Dict[str, Any]]:
+        """Live stats of the model's batcher/engine (None when the model
+        serves on the direct path) — the :stats REST route and the gRPC
+        metadata face both read through here."""
+        with self._lock:
+            batcher = self._batchers.get(name)
+        stats = getattr(batcher, "stats", None)
+        return stats() if callable(stats) else None
 
     @staticmethod
     def _single_row(inputs: Dict[str, Any]) -> bool:
@@ -379,11 +423,13 @@ class MicroBatcher:
         """Effective-batch-size distribution over dispatched batches,
         plus the mean per-batch cost of each dispatch-cycle stage and
         the achieved pipeline depth (max concurrent _process calls)."""
-        with self._lock:
-            hist = dict(sorted(self._batch_sizes.items()))
-            requests = self._requests
-            cycle = dict(self._cycle)
-            max_overlap = self._max_in_process
+        cycle, extra = locked_snapshot(
+            self._lock, self._cycle,
+            lambda: {"hist": dict(sorted(self._batch_sizes.items())),
+                     "requests": self._requests,
+                     "max_overlap": self._max_in_process})
+        hist, requests = extra["hist"], extra["requests"]
+        max_overlap = extra["max_overlap"]
         batches = sum(hist.values())
         return {
             "requests": requests,
@@ -491,9 +537,13 @@ class MicroBatcher:
 
     def _process(self, batch: List[dict]) -> None:
         try:
-            cyc = self._cycle  # float += on dict values is one
-            # BINARY_OP under the interpreter lock; the races are
-            # benign (stats are advisory, read after close in bench)
+            # Stage timings accumulate LOCALLY and merge into
+            # self._cycle under the queue lock at the end — _process
+            # runs on dispatch threads while stats()/the /metrics
+            # scrape snapshot the counters, and an unlocked float +=
+            # against that read shows torn cycle profiles (impossible
+            # occupancy was the observed symptom).
+            cyc = {k: 0.0 for k in self._cycle}
             t0 = time.perf_counter()
             metas: Optional[List[Any]] = None
             n = len(batch)
@@ -550,6 +600,9 @@ class MicroBatcher:
                 e["out"] = row
                 e["event"].set()
             cyc["deliver"] += time.perf_counter() - t4
+            with self._lock:
+                for k, v in cyc.items():
+                    self._cycle[k] += v
         except Exception as exc:
             # Propagate to all waiters still pending.  Rows already
             # delivered (event set) keep their results — a `finish`
@@ -640,7 +693,14 @@ class BucketedLMBatcher:
 
     def _collate(self, rows: List[Dict[str, Any]]):
         """Stack raw single-row submissions, left-padding every prompt
-        to the batch bucket (smallest bucket >= the longest prompt)."""
+        to the batch bucket (smallest bucket >= the longest prompt).
+
+        A per-request ``max_new_tokens`` never reaches the device (the
+        generate program bakes the config budget in); it rides the
+        per-row meta so _strip trims the surplus on the way out — the
+        same budget contract as the DecodeEngine and the direct path,
+        minus the decode compute savings only the engine can deliver.
+        """
         tokens = [np.asarray(r["tokens"]) for r in rows]
         lengths = [t.shape[1] for t in tokens]
         bucket = self.bucket_for(max(lengths))
@@ -654,7 +714,13 @@ class BucketedLMBatcher:
             "tokens": np.concatenate(padded, axis=0),
             "prompt_len": np.asarray(lengths, np.int32),
         }
-        return stacked, [bucket - n for n in lengths]
+        meta = [
+            (bucket - n, n,
+             max(1, int(np.asarray(r["max_new_tokens"]).reshape(())))
+             if r.get("max_new_tokens") is not None else None)
+            for r, n in zip(rows, lengths)
+        ]
+        return stacked, meta
 
     # Output keys aligned to the FULL padded position axis (pad keys at
     # the left, like the input tokens), stripped per-row on the way
@@ -666,9 +732,18 @@ class BucketedLMBatcher:
     _POSITIONAL_KEYS = ("tokens",)
 
     @classmethod
-    def _strip(cls, row: Dict[str, Any], pad: int) -> Dict[str, Any]:
+    def _strip(cls, row: Dict[str, Any], meta) -> Dict[str, Any]:
+        pad, prompt_len, new = meta
+
+        def cut(v):
+            if pad:
+                v = v[:, pad:]
+            if new is not None:
+                v = v[:, : prompt_len + new]  # per-request budget trim
+            return v
+
         return {
-            k: (v[:, pad:] if k in cls._POSITIONAL_KEYS and pad else v)
+            k: (cut(v) if k in cls._POSITIONAL_KEYS else v)
             for k, v in row.items()
         }
 
@@ -683,7 +758,13 @@ class BucketedLMBatcher:
     def accepts(self, inputs: Dict[str, Any]) -> bool:
         """ModelServer routing hook: prompts beyond the largest bucket
         fall back to the direct predict path (they served fine before
-        batching was enabled; enabling it must not break them)."""
+        batching was enabled; enabling it must not break them).  Seeded
+        requests also go direct: all rows of a batched generate program
+        share one sample stream, so a per-request seed can only be
+        honored unbatched (the DecodeEngine, with per-slot keys, keeps
+        them batched)."""
+        if inputs.get("seed") is not None:
+            return False
         tokens = np.asarray(inputs.get("tokens", ()))
         length = tokens.shape[-1] if tokens.ndim else 0
         return bool(length and length <= self.buckets[-1])
@@ -703,8 +784,13 @@ class BucketedLMBatcher:
         self.bucket_for(length)  # reject oversize up front, pre-queue
         # Raw tokens go into the shared queue; _collate pads the whole
         # batch to one bucket at dispatch and _strip restores this
-        # row's natural shape on the way out.
-        return self._inner.submit({"tokens": tokens})
+        # row's natural shape on the way out.  A per-request
+        # max_new_tokens rides along as row meta (never a device
+        # input): _strip trims the surplus of the config budget.
+        row = {"tokens": tokens}
+        if inputs.get("max_new_tokens") is not None:
+            row["max_new_tokens"] = inputs["max_new_tokens"]
+        return self._inner.submit(row)
 
     def stats(self) -> Dict[str, Any]:
         return self._inner.stats()

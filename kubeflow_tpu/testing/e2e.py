@@ -90,6 +90,93 @@ def serving_smoke(namespace: str = "kubeflow-test") -> None:
             httpd.shutdown()
 
 
+def engine_smoke(namespace: str = "kubeflow-test") -> None:
+    """Admit mixed-length LM requests through the HTTP surface against
+    the in-process continuous-batching DecodeEngine: all must complete
+    (in-flight admission + slot reuse, 3 requests through 2 slots) and
+    the engine must report zero occupancy and an empty queue after."""
+    import json
+    import tempfile
+    import threading
+    import urllib.request
+
+    import jax
+    import numpy as np
+
+    from kubeflow_tpu.models.transformer import Transformer
+    from kubeflow_tpu.serving.export import export
+    from kubeflow_tpu.serving.http import make_http_server
+    from kubeflow_tpu.serving.loaders import _model_config
+    from kubeflow_tpu.serving.main import batcher_factory
+    from kubeflow_tpu.serving.model_server import ModelServer
+
+    overrides = {
+        "vocab_size": 128, "d_model": 32, "n_layers": 2, "n_heads": 4,
+        "n_kv_heads": 2, "d_ff": 64, "head_dim": 8, "max_seq_len": 64,
+        "dtype": "float32",
+    }
+    max_new = 8
+    model = Transformer(_model_config(overrides))
+    variables = model.init(jax.random.key(0), np.zeros((1, 4), np.int32))
+    with tempfile.TemporaryDirectory() as tmp:
+        export(f"{tmp}/lm", 1, variables,
+               loader="kubeflow_tpu.serving.loaders:lm_generate",
+               config={"model": overrides, "max_new_tokens": max_new,
+                       "temperature": 0.0})
+        server = ModelServer()
+        server.add_model("lm", f"{tmp}/lm")
+        server.enable_batching("lm", batcher_factory(
+            micro_batch_size=0, batch_timeout_s=0.005,
+            lm_engine=True, lm_engine_slots=2,
+            lm_engine_prefill_len=16))
+        httpd, _ = make_http_server(server, port=0, host="127.0.0.1")
+        try:
+            port = httpd.server_address[1]
+            rng = np.random.RandomState(0)
+            prompts = [rng.randint(1, 128, size=(n,)).tolist()
+                       for n in (3, 9, 16)]
+            outs: dict = {}
+
+            def client(i, prompt):
+                body = json.dumps(
+                    {"instances": [{"tokens": prompt}]}).encode()
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{port}/model/lm:predict",
+                    data=body)
+                with urllib.request.urlopen(req, timeout=120) as resp:
+                    outs[i] = json.loads(resp.read())
+
+            threads = [threading.Thread(target=client, args=(i, p))
+                       for i, p in enumerate(prompts)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            for i, prompt in enumerate(prompts):
+                tokens = outs[i]["predictions"][0]["tokens"]
+                assert tokens[:len(prompt)] == prompt
+                assert len(tokens) == len(prompt) + max_new
+            # Occupancy must return to zero once the work drains (the
+            # :stats route reads the engine's locked snapshot).
+            deadline = time.time() + 30
+            while True:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/model/lm:stats",
+                        timeout=30) as resp:
+                    stats = json.loads(resp.read())["batcher"]
+                if (stats["active_slots"] == 0
+                        and stats["queue_depth"] == 0
+                        and stats["in_flight_requests"] == 0):
+                    break
+                assert time.time() < deadline, (
+                    f"engine never drained: {stats}")
+                time.sleep(0.05)
+            assert stats["requests"] == len(prompts)
+        finally:
+            httpd.shutdown()
+            server.stop()
+
+
 def train_smoke(namespace: str = "kubeflow-test") -> None:
     """A few real SPMD train steps on whatever devices exist."""
     import subprocess
@@ -219,6 +306,7 @@ def teardown(namespace: str = "kubeflow-test") -> None:
 COMMANDS = {
     "tpujob": tpujob_smoke,
     "serving": serving_smoke,
+    "engine": engine_smoke,
     "train": train_smoke,
     "deploy": deploy_real,
     "deploy-crds": deploy_crds,

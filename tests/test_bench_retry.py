@@ -144,7 +144,7 @@ def test_soft_deadline_skips_tail_but_prints_headline(monkeypatch, capsys):
         raise AssertionError("sub-bench ran past the deadline")
 
     for name in ("bench_lm", "bench_serving", "bench_lm_decode",
-                 "bench_data"):
+                 "bench_lm_engine", "bench_data"):
         monkeypatch.setattr(bench, name, boom)
     monkeypatch.setattr(
         bench, "acquire_devices",
@@ -155,7 +155,8 @@ def test_soft_deadline_skips_tail_but_prints_headline(monkeypatch, capsys):
     record = json.loads(out[0])
     assert record["metric"] == "resnet50_images_per_sec_per_chip"
     assert set(record["detail"]["skipped_sub_benches"]) == {
-        "lm", "lm_moe", "serving", "lm_decode", "lm_decode_int8", "data"}
+        "lm", "lm_moe", "serving", "lm_decode", "lm_decode_int8",
+        "lm_engine", "data"}
 
 
 def _both_result():

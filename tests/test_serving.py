@@ -799,3 +799,38 @@ class TestBatcherLifecycleRaces:
         finally:
             bmb.close()
             srv.stop()
+
+    def test_per_request_budget_trims_batched_rows(self):
+        """A per-request max_new_tokens must be honored on the static
+        batcher path: the generate program still decodes the config's
+        full budget (it is baked into the program), but each row's
+        surplus is trimmed on the way out — same contract as the
+        DecodeEngine and the direct path."""
+        import concurrent.futures as cf
+
+        from kubeflow_tpu.serving.model_server import BucketedLMBatcher
+
+        config_new = 10
+
+        def predict(inputs):
+            toks = np.asarray(inputs["tokens"])
+            fill = np.full((toks.shape[0], config_new), 7, toks.dtype)
+            return {"tokens": np.concatenate([toks, fill], axis=1)}
+
+        bmb = BucketedLMBatcher(
+            predict, buckets=[8], max_batch_size=2, batch_timeout_s=0.2,
+            allowed_batch_sizes=[1, 2], name="budget")
+        try:
+            with cf.ThreadPoolExecutor(2) as ex:
+                small = ex.submit(bmb.submit, {
+                    "tokens": np.ones((1, 3), np.int32),
+                    "max_new_tokens": 2})
+                full = ex.submit(bmb.submit, {
+                    "tokens": np.ones((1, 8), np.int32)})
+                # Row with a budget: prompt 3 + 2 new, pad stripped.
+                assert small.result(timeout=30)["tokens"].shape == (1, 5)
+                # Row without one keeps the config budget untouched.
+                assert full.result(timeout=30)["tokens"].shape \
+                    == (1, 8 + config_new)
+        finally:
+            bmb.close()

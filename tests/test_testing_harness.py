@@ -4,7 +4,11 @@ import xml.etree.ElementTree as ET
 
 import pytest
 
-from kubeflow_tpu.testing.e2e import serving_smoke, tpujob_smoke
+from kubeflow_tpu.testing.e2e import (
+    engine_smoke,
+    serving_smoke,
+    tpujob_smoke,
+)
 from kubeflow_tpu.testing.junit import JUnitSuite
 from kubeflow_tpu.testing.workflow import Step, default_e2e
 
@@ -58,6 +62,12 @@ class TestE2EDrivers:
 
     def test_serving_smoke(self):
         serving_smoke()
+
+    def test_engine_smoke(self):
+        # The ci/e2e_config.yaml hermetic `engine` step: mixed-length
+        # requests through the HTTP surface against the in-process
+        # continuous-batching engine, occupancy drains to zero.
+        engine_smoke()
 
 
 class _FakeKubectl:
