@@ -21,7 +21,9 @@ from __future__ import annotations
 
 import json
 import os
+import random
 import ssl
+import time
 import urllib.error
 import urllib.parse
 import urllib.request
@@ -29,12 +31,25 @@ from typing import Dict, List, Optional
 
 from kubeflow_tpu.operator import crd
 from kubeflow_tpu.operator.kube import Conflict, NotFound, ObjectDict
+from kubeflow_tpu.testing import faults
 
 SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
 
 
 class HttpKube:
-    """Reconciler kube backend over the raw Kubernetes REST API."""
+    """Reconciler kube backend over the raw Kubernetes REST API.
+
+    Transient apiserver weather — 5xx (leader elections, webhook blips)
+    and connection resets — is retried with capped, jittered
+    exponential backoff, so one blip does not fail a whole reconcile
+    pass.  Two hard limits on the retry policy: semantic statuses
+    (404/409 and other 4xx) are NEVER retried — they are answers, not
+    weather — and only IDEMPOTENT verbs (GET/PUT/PATCH) retry at all.
+    A POST or DELETE whose response was lost may have landed
+    server-side; replaying it would double-apply (duplicate create ->
+    spurious Conflict, re-delete -> spurious NotFound), so mutations
+    fail fast and lean on the reconciler's level-triggered resweep as
+    their natural retry."""
 
     def __init__(
         self,
@@ -42,6 +57,9 @@ class HttpKube:
         token: Optional[str] = None,
         ca_cert: Optional[str] = None,
         timeout_s: float = 30.0,
+        retries: int = 3,
+        retry_backoff_s: float = 0.05,
+        retry_backoff_cap_s: float = 2.0,
     ):
         if base_url is None:
             host = os.environ.get("KUBERNETES_SERVICE_HOST")
@@ -59,6 +77,9 @@ class HttpKube:
         if ca_cert is None and os.path.exists(f"{SA_DIR}/ca.crt"):
             ca_cert = f"{SA_DIR}/ca.crt"
         self._timeout_s = timeout_s
+        self._retries = max(0, int(retries))
+        self._retry_backoff_s = retry_backoff_s
+        self._retry_backoff_cap_s = retry_backoff_cap_s
         if self.base_url.startswith("https"):
             self._ssl = ssl.create_default_context(cafile=ca_cert)
         else:
@@ -78,25 +99,58 @@ class HttpKube:
         if params:
             url += "?" + urllib.parse.urlencode(params)
         data = json.dumps(body).encode() if body is not None else None
-        req = urllib.request.Request(url, data=data, method=method)
-        req.add_header("Accept", "application/json")
-        if data is not None:
-            req.add_header("Content-Type", content_type)
-        if self._token:
-            req.add_header("Authorization", f"Bearer {self._token}")
-        try:
-            with urllib.request.urlopen(
-                    req, timeout=self._timeout_s, context=self._ssl) as r:
-                payload = r.read()
-        except urllib.error.HTTPError as e:
-            detail = e.read().decode(errors="replace")[:500]
-            if e.code == 404:
-                raise NotFound(f"{method} {path}: {detail}") from None
-            if e.code == 409:
-                raise Conflict(f"{method} {path}: {detail}") from None
-            raise RuntimeError(
-                f"{method} {path} -> {e.code}: {detail}") from None
+        # See the class docstring: replaying a mutation whose response
+        # was lost can double-apply it, so only idempotent verbs retry.
+        retries = self._retries if method in ("GET", "PUT", "PATCH") \
+            else 0
+        attempt = 0
+        while True:
+            # Rebuilt per attempt: a urllib Request is not guaranteed
+            # reusable after a failed send.
+            req = urllib.request.Request(url, data=data, method=method)
+            req.add_header("Accept", "application/json")
+            if data is not None:
+                req.add_header("Content-Type", content_type)
+            if self._token:
+                req.add_header("Authorization", f"Bearer {self._token}")
+            try:
+                # Chaos hook: scripted connection failures land here,
+                # BEFORE the socket — the retry layer sees them exactly
+                # as it would a refused connect.
+                faults.fire("kube.request")
+                with urllib.request.urlopen(
+                        req, timeout=self._timeout_s,
+                        context=self._ssl) as r:
+                    payload = r.read()
+                break
+            except urllib.error.HTTPError as e:
+                detail = e.read().decode(errors="replace")[:500]
+                if e.code == 404:
+                    raise NotFound(f"{method} {path}: {detail}") from None
+                if e.code == 409:
+                    raise Conflict(f"{method} {path}: {detail}") from None
+                if e.code >= 500 and attempt < retries:
+                    self._backoff(attempt)
+                    attempt += 1
+                    continue
+                raise RuntimeError(
+                    f"{method} {path} -> {e.code}: {detail}") from None
+            except (urllib.error.URLError, ConnectionError,
+                    TimeoutError, faults.FaultInjected) as e:
+                if attempt < retries:
+                    self._backoff(attempt)
+                    attempt += 1
+                    continue
+                raise RuntimeError(
+                    f"{method} {path} failed after "
+                    f"{attempt + 1} attempts: {e}") from e
         return json.loads(payload) if payload else {}
+
+    def _backoff(self, attempt: int) -> None:
+        delay = min(self._retry_backoff_cap_s,
+                    self._retry_backoff_s * (2 ** attempt))
+        # Full jitter: concurrent reconcilers must not retry in phase.
+        time.sleep(delay * (0.5 + 0.5 * random.random()))
 
     @staticmethod
     def _selector(labels: Optional[Dict[str, str]]) -> Dict[str, str]:

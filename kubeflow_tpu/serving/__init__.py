@@ -8,6 +8,12 @@ http.py the reference-compatible REST surface, main.py the container
 entrypoint.
 """
 
+from kubeflow_tpu.serving.errors import (
+    BatcherClosed,
+    DeadlineExceeded,
+    Overloaded,
+    ServingError,
+)
 from kubeflow_tpu.serving.export import export, list_versions, load_version
 from kubeflow_tpu.serving.http import ServingAPI, make_http_server
 from kubeflow_tpu.serving.model_server import MicroBatcher, ModelServer
@@ -20,4 +26,8 @@ __all__ = [
     "make_http_server",
     "MicroBatcher",
     "ModelServer",
+    "ServingError",
+    "BatcherClosed",
+    "DeadlineExceeded",
+    "Overloaded",
 ]

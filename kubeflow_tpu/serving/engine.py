@@ -45,7 +45,19 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
-from kubeflow_tpu.serving.model_server import BatcherClosed, locked_snapshot
+from kubeflow_tpu.serving.errors import (
+    BatcherClosed,
+    DeadlineExceeded,
+    Overloaded,
+)
+from kubeflow_tpu.serving.model_server import (
+    EXPIRED_HELP,
+    EXPIRED_TOTAL,
+    SHED_HELP,
+    SHED_TOTAL,
+    locked_snapshot,
+)
+from kubeflow_tpu.testing import faults
 
 # Step-duration histogram buckets: decode steps run ~0.1 ms (tiny CPU
 # smoke models) to ~100 ms (big models over a slow tunnel).
@@ -74,6 +86,14 @@ class DecodeEngine:
         many queued requests prefill in ONE call; a burst of arrivals
         amortizes per-call overhead instead of paying one serialized
         prefill per request.  Unused rows are dropped on device.
+      max_queue_depth: bounded admission — a submit arriving with this
+        many requests already waiting for slots fails fast with
+        Overloaded (HTTP 429 / gRPC RESOURCE_EXHAUSTED) instead of
+        queueing unboundedly; 0 = unbounded.  The in-flight cap is
+        ``slots`` by construction, so total accepted work is bounded
+        by slots + max_queue_depth.
+      overload_retry_after_s: the Retry-After hint a shed submission
+        carries back to the client.
     """
 
     def __init__(
@@ -88,6 +108,8 @@ class DecodeEngine:
         sync_lag: int = 2,
         steps_per_call: int = 1,
         admit_width: int = 4,
+        max_queue_depth: int = 0,
+        overload_retry_after_s: float = 1.0,
         name: str = "engine",
     ):
         from kubeflow_tpu.models.generate import init_slot_state
@@ -120,6 +142,8 @@ class DecodeEngine:
         self.sync_lag = max(0, int(sync_lag))
         self.steps_per_call = max(1, int(steps_per_call))
         self.admit_width = max(1, min(int(admit_width), slots))
+        self.max_queue_depth = max(0, int(max_queue_depth))
+        self.overload_retry_after_s = overload_retry_after_s
         self._eos = decode.eos_token >= 0
         self._state = init_slot_state(cfg, slots, self.max_len,
                                       decode.kv_cache_dtype)
@@ -147,6 +171,7 @@ class DecodeEngine:
         self._counters = {
             "requests": 0, "tokens": 0, "steps": 0, "prefills": 0,
             "occupancy_sum": 0, "busy_s": 0.0, "in_flight": 0,
+            "shed": 0, "expired": 0,
         }
         self._step_times: List[float] = []   # bounded reservoir
         self._metric_name = name
@@ -164,6 +189,10 @@ class DecodeEngine:
             "decode engine per-step (= per-token) latency, by engine",
             buckets=_STEP_BUCKETS,
         ).declare(engine=name)
+        # Fault-layer series: same names as the static batchers', so
+        # shed/expired rates read uniformly across batching planes.
+        self._shed_ctr = REGISTRY.counter(SHED_TOTAL, SHED_HELP)
+        self._expired_ctr = REGISTRY.counter(EXPIRED_TOTAL, EXPIRED_HELP)
         self._occ_gauge.set(0, engine=name)
         self._queue_gauge.set(0, engine=name)
         # Last values pushed to the gauges — the step loop only touches
@@ -183,11 +212,20 @@ class DecodeEngine:
         length = tokens.shape[-1] if tokens.ndim else 0
         return bool(0 < length <= self.prefill_len)
 
-    def submit(self, inputs: Dict[str, Any]) -> Dict[str, Any]:
+    def submit(self, inputs: Dict[str, Any],
+               deadline: Optional[float] = None) -> Dict[str, Any]:
         """One request: tokens [t] or [1, t]; optional per-request
         ``max_new_tokens`` (<= engine headroom) and sampling ``seed``.
         Blocks until the completion is ready; returns
-        {"tokens": [1, t + emitted]}."""
+        {"tokens": [1, t + emitted]}.
+
+        ``deadline`` (absolute faults.monotonic() instant) is enforced
+        everywhere the request lives: expired-on-arrival raises here,
+        an expired queued request is failed before admission, and an
+        expired IN-FLIGHT request is retired mid-generation through
+        the deterministic-retirement path — its slot frees for the
+        next admission while its lagged device emissions are dropped
+        on the floor, exactly like a normally-retired slot's."""
         tokens = np.asarray(inputs["tokens"], np.int32)
         if tokens.ndim == 1:
             tokens = tokens[None]
@@ -210,9 +248,17 @@ class DecodeEngine:
         # headroom caps it further.
         new = min(new, self.decode.max_new_tokens, self.max_len - length)
         seed = int(np.asarray(inputs.get("seed", 0)).reshape(()))
+        if deadline is not None and faults.monotonic() >= deadline:
+            with self._lock:
+                self._counters["expired"] += 1
+            self._expired_ctr.inc(batcher=self._metric_name)
+            raise DeadlineExceeded(
+                f"deadline expired before engine "
+                f"{self._metric_name!r} admission")
         entry = {
             "tokens": tokens, "new": new, "seed": seed,
             "emitted": [], "scheduled": 0, "slot": None,
+            "deadline": deadline,
             "event": threading.Event(), "out": None, "err": None,
             "t": time.monotonic(),
         }
@@ -220,6 +266,18 @@ class DecodeEngine:
             if self._stopped:
                 raise BatcherClosed(
                     f"engine {self._metric_name!r} is closed")
+            if self.max_queue_depth \
+                    and len(self._queue) >= self.max_queue_depth:
+                # Bounded admission: all slots busy and the wait line
+                # is full — fail fast instead of queueing unboundedly
+                # (under overload a 429 now beats a 504 later).
+                self._counters["shed"] += 1
+                self._shed_ctr.inc(batcher=self._metric_name)
+                raise Overloaded(
+                    f"engine {self._metric_name!r} admission queue "
+                    f"full ({len(self._queue)} waiting, "
+                    f"{self.slots} slots busy)",
+                    retry_after_s=self.overload_retry_after_s)
             self._queue.append(entry)
             self._set_queue_gauge(len(self._queue))
             self._work.notify()
@@ -269,6 +327,11 @@ class DecodeEngine:
             # the lagged emission reaches its client), so active_slots
             # can touch zero while completions are still in flight.
             "in_flight_requests": c["in_flight"],
+            # Fault-layer outcomes: admissions refused at the queue cap
+            # and requests failed by their deadline (queued or
+            # in-flight) — the chaos scenario's primary assertions.
+            "shed": c["shed"],
+            "deadline_expired": c["expired"],
             "mean_occupancy": round(c["occupancy_sum"] / steps, 2)
             if steps else 0.0,
             "tokens_per_sec": round(c["tokens"] / c["busy_s"], 1)
@@ -301,6 +364,72 @@ class DecodeEngine:
 
     def _free_slots_locked(self) -> List[int]:
         return [i for i, r in enumerate(self._slot_req) if r is None]
+
+    def _sweep_expired_locked(self) -> List[dict]:
+        """Pull every deadline-expired request out of the queue AND the
+        live slot table (caller fails them outside the lock).
+
+        In-flight expiry rides the deterministic-retirement path: the
+        slot is freed NOW — the next admission prefills over it, which
+        is the device-side abort — and the request's lagged emissions
+        still in _pending are dropped by _drain_one's event-set check,
+        exactly like a normally-retired slot's.  No other slot's state
+        is touched, so co-resident generations are unaffected."""
+        pnow = faults.monotonic()
+        expired: List[dict] = []
+        live = []
+        for entry in self._queue:
+            d = entry["deadline"]
+            if d is not None and d <= pnow:
+                expired.append(entry)
+            else:
+                live.append(entry)
+        if len(live) != len(self._queue):
+            self._queue[:] = live
+            self._set_queue_gauge(len(self._queue))
+        for i, entry in enumerate(self._slot_req):
+            if entry is None:
+                continue
+            d = entry["deadline"]
+            if d is not None and d <= pnow:
+                self._slot_req[i] = None
+                self._counters["in_flight"] -= 1
+                expired.append(entry)
+        # Deterministically-retired requests live in NEITHER the queue
+        # nor the slot table while their lagged emissions sit in
+        # _pending — a request is in_flight until delivery, so its
+        # deadline is enforced on this tail too (under wedged steps the
+        # lag is unbounded; the client must get its 504, not a late
+        # 200).  A snapshot entry still slot-resident cannot reach the
+        # append: the slot scan above already moved every expired slot
+        # entry into `expired`, and the identity dedup skips those (and
+        # entries recurring across snapshots).
+        for _, snapshot in self._pending:
+            for _, entry in snapshot:
+                if entry["event"].is_set():
+                    continue
+                d = entry["deadline"]
+                if d is None or d > pnow:
+                    continue
+                if any(entry is e for e in expired):
+                    continue
+                self._counters["in_flight"] -= 1
+                expired.append(entry)
+        if expired:
+            self._counters["expired"] += len(expired)
+        return expired
+
+    def _fail_expired(self, expired: List[dict]) -> None:
+        if not expired:
+            return
+        self._expired_ctr.inc(len(expired), batcher=self._metric_name)
+        for entry in expired:
+            if not entry["event"].is_set():
+                entry["err"] = DeadlineExceeded(
+                    f"deadline expired after {len(entry['emitted'])} "
+                    f"of {entry['new']} tokens "
+                    f"(engine {self._metric_name!r})")
+                entry["event"].set()
 
     def _set_queue_gauge(self, depth: int) -> None:
         if depth != self._queue_last:
@@ -335,6 +464,9 @@ class DecodeEngine:
             seeds[row] = entry["seed"]
             entry["scheduled"] = 1  # slot claimed at queue pop, locked
             snapshot.append((row, entry))
+        # Chaos hook: sleep = slow admission; raise = device death at
+        # prefill (propagates to _abort, every waiter resolved).
+        faults.fire("engine.admit")
         if self._prefill_exec is None:
             self._prefill_exec = prefill_into_slot.lower(
                 self.cfg, self.params, self._state, self.decode, tokens,
@@ -414,6 +546,7 @@ class DecodeEngine:
                     past_drain = (stopping and self._drain_deadline
                                   is not None and time.monotonic()
                                   > self._drain_deadline)
+                    expired = self._sweep_expired_locked()
                     admissions = []
                     if not stopping:
                         free = self._free_slots_locked()
@@ -433,6 +566,7 @@ class DecodeEngine:
                             self._counters["in_flight"] += 1
                             admissions.append((entry, slot))
                         self._set_queue_gauge(len(self._queue))
+                self._fail_expired(expired)
                 if past_drain:
                     self._abort(RuntimeError(
                         f"engine {self._metric_name!r} drain deadline "
@@ -457,6 +591,12 @@ class DecodeEngine:
                         self._step_exec = decode_step.lower(
                             self.cfg, self.params, self._state,
                             self.decode, k).compile()
+                    # Chaos hook: sleep = slow/wedged step (deadlines
+                    # expire mid-generation); raise = device death.
+                    # Outside the timed window so the injected stall
+                    # does not masquerade as device latency in the
+                    # step histogram.
+                    faults.fire("engine.step")
                     t0 = time.perf_counter()
                     self._state, sampled = self._step_exec(
                         self.params, self._state)

@@ -1,0 +1,49 @@
+"""Typed serving errors — the wire-status contract of the fault layer.
+
+Every fault-tolerance path in the serving stack resolves to one of
+these, and the two transport faces map them to the SAME status pair so
+a client sees one failure semantics regardless of protocol:
+
+    DeadlineExceeded  -> HTTP 504            / gRPC DEADLINE_EXCEEDED
+    Overloaded        -> HTTP 429+Retry-After/ gRPC RESOURCE_EXHAUSTED
+    BatcherClosed     -> never reaches the wire: ModelServer.predict
+                         retries the replacement batcher or falls back
+                         to the direct path (hot-swap / drain races)
+
+They live in their own module (not model_server.py) because every layer
+imports them — batchers, engine, both transports, the gRPC client
+helpers — and the transports must not import the batching plane just to
+classify an exception.
+"""
+
+from __future__ import annotations
+
+
+class ServingError(RuntimeError):
+    """Base of the typed serving failures."""
+
+
+class BatcherClosed(ServingError):
+    """Raised by submit() on a closed batcher — callers holding a stale
+    reference (hot-swap races, drain) retry against the replacement."""
+
+
+class DeadlineExceeded(ServingError):
+    """The request's deadline passed before its result was ready.
+
+    Raised on admission when the deadline is already spent, from the
+    queue when it expires pre-dispatch, and mid-generation when the
+    engine retires an expired in-flight slot.  HTTP 504 / gRPC
+    DEADLINE_EXCEEDED."""
+
+
+class Overloaded(ServingError):
+    """Admission refused: queue depth or in-flight cap reached.
+
+    Fails fast instead of queueing unboundedly — under overload a
+    bounded 429 beats a timed-out 200.  ``retry_after_s`` rides to the
+    HTTP ``Retry-After`` header and the gRPC status detail."""
+
+    def __init__(self, message: str, retry_after_s: float = 1.0):
+        super().__init__(message)
+        self.retry_after_s = float(retry_after_s)

@@ -20,10 +20,24 @@ from typing import Optional
 import grpc
 import numpy as np
 
+from kubeflow_tpu.serving.errors import DeadlineExceeded, Overloaded
 from kubeflow_tpu.serving.model_server import ModelServer
 from kubeflow_tpu.serving.protos import prediction_pb2 as pb
+from kubeflow_tpu.testing import faults
 
 log = logging.getLogger(__name__)
+
+
+def _deadline_from(context: grpc.ServicerContext):
+    """Client-supplied gRPC deadline -> the absolute policy-clock
+    instant the batching planes enforce.  gRPC carries deadlines in the
+    transport (grpc-timeout header), so unlike REST no body field is
+    needed — whatever deadline the client set on the call propagates
+    into queues and the engine's mid-generation sweep."""
+    remaining = context.time_remaining()
+    if remaining is None:
+        return None
+    return faults.monotonic() + remaining
 
 SERVICE = "kft.serving.PredictionService"
 GRPC_PORT = 9000  # same port the reference's model server bound
@@ -57,7 +71,8 @@ class PredictionServicer:
         # it does to REST.
         version = request.model_spec.version \
             if request.model_spec.version > 0 else None
-        outputs = self.server.predict(model.name, inputs, version)
+        outputs = self.server.predict(model.name, inputs, version,
+                                      deadline=_deadline_from(context))
         resp = pb.PredictResponse()
         resp.model_spec.name = model.name
         resp.model_spec.version = model.version
@@ -72,8 +87,9 @@ class PredictionServicer:
         version = request.model_spec.version \
             if request.model_spec.version > 0 else None
         outputs = {k: np.asarray(v) for k, v in
-                   self.server.predict(model.name, inputs,
-                                       version).items()}
+                   self.server.predict(
+                       model.name, inputs, version,
+                       deadline=_deadline_from(context)).items()}
         resp = pb.ClassifyResponse()
         resp.model_spec.name = model.name
         resp.model_spec.version = model.version
@@ -149,6 +165,20 @@ def _wrap(servicer: PredictionServicer, name: str):
             context.abort(grpc.StatusCode.NOT_FOUND, str(e))
         except ValueError as e:
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        except Overloaded as e:
+            # Same status pair as the REST face's 429/504: one failure
+            # semantics across transports.  The Retry-After hint rides
+            # STRUCTURED trailing metadata (the gRPC analogue of the
+            # REST header) — clients must not parse prose.
+            outcome = "shed"
+            context.set_trailing_metadata(
+                (("retry-after", f"{e.retry_after_s}"),))
+            context.abort(
+                grpc.StatusCode.RESOURCE_EXHAUSTED,
+                f"{e} (retry after {e.retry_after_s:.1f}s)")
+        except DeadlineExceeded as e:
+            outcome = "deadline_exceeded"
+            context.abort(grpc.StatusCode.DEADLINE_EXCEEDED, str(e))
         finally:
             REGISTRY.counter(REQUESTS_TOTAL, REQUESTS_HELP).inc(
                 model=model_label, route=route, outcome=outcome)
@@ -205,7 +235,16 @@ def make_grpc_server(
 
 
 class PredictionClient:
-    """Minimal client — heir of inception-client/label.py:40-57."""
+    """Minimal client — heir of inception-client/label.py:40-57.
+
+    ``timeout`` is the CLIENT-SUPPLIED deadline, in seconds, propagated
+    on the wire (gRPC grpc-timeout): the server enforces it in its
+    queues and — for the decode engine — mid-generation, so the default
+    is None (no deadline) rather than an arbitrary hard-coded number;
+    pass what your caller can actually afford.  Transport-level
+    deadline/overload statuses come back as the typed serving errors
+    (DeadlineExceeded / Overloaded), matching what in-process callers
+    of ModelServer.predict see."""
 
     def __init__(self, target: str):
         self._channel = grpc.insecure_channel(target)
@@ -218,29 +257,61 @@ class PredictionClient:
             for name, (req, resp) in _METHODS.items()
         }
 
+    def _call(self, name: str, req, timeout: Optional[float]):
+        try:
+            return self._methods[name](req, timeout=timeout)
+        except grpc.RpcError as e:
+            code = e.code() if callable(getattr(e, "code", None)) else None
+            details = e.details() if callable(
+                getattr(e, "details", None)) else str(e)
+            if code == grpc.StatusCode.DEADLINE_EXCEEDED:
+                # Covers both: the server's typed expiry AND a pure
+                # transport timeout (request never completed in time).
+                raise DeadlineExceeded(f"{name}: {details}") from e
+            if code == grpc.StatusCode.RESOURCE_EXHAUSTED:
+                # Recover the server's Retry-After hint from the
+                # trailing metadata _wrap attaches (falling back to the
+                # 1.0 s default against servers that sent none) so
+                # clients backing off via the typed field honor the
+                # server's number.
+                retry_after = 1.0
+                trailing = getattr(e, "trailing_metadata", None)
+                for key, value in (trailing() if callable(trailing)
+                                   else None) or ():
+                    if key == "retry-after":
+                        try:
+                            retry_after = float(value)
+                        except ValueError:
+                            pass
+                raise Overloaded(
+                    f"{name}: {details}", retry_after_s=retry_after,
+                ) from e
+            raise
+
     def predict(self, model: str, inputs: dict,
-                version: int = 0, timeout: float = 60.0):
+                version: int = 0, timeout: Optional[float] = None):
         req = pb.PredictRequest()
         req.model_spec.name = model
         req.model_spec.version = version
         for key, value in inputs.items():
             req.inputs[key].CopyFrom(numpy_to_tensor(np.asarray(value)))
-        resp = self._methods["Predict"](req, timeout=timeout)
+        resp = self._call("Predict", req, timeout)
         return {k: tensor_to_numpy(t) for k, t in resp.outputs.items()}
 
     def classify(self, model: str, inputs: dict, top_k: int = 5,
-                 timeout: float = 60.0):
+                 timeout: Optional[float] = None):
         req = pb.ClassifyRequest(top_k=top_k)
         req.model_spec.name = model
         for key, value in inputs.items():
             req.inputs[key].CopyFrom(numpy_to_tensor(np.asarray(value)))
-        resp = self._methods["Classify"](req, timeout=timeout)
+        resp = self._call("Classify", req, timeout)
         return [list(zip(r.classes, r.scores)) for r in resp.results]
 
-    def metadata(self, model: str, timeout: float = 60.0) -> dict:
+    def metadata(self, model: str,
+                 timeout: Optional[float] = None) -> dict:
         req = pb.GetModelMetadataRequest()
         req.model_spec.name = model
-        resp = self._methods["GetModelMetadata"](req, timeout=timeout)
+        resp = self._call("GetModelMetadata", req, timeout)
         return json.loads(resp.metadata_json)
 
     def close(self) -> None:

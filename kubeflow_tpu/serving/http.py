@@ -17,6 +17,7 @@ from __future__ import annotations
 import base64
 import json
 import logging
+import math
 import re
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -24,7 +25,9 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from kubeflow_tpu.serving.errors import DeadlineExceeded, Overloaded
 from kubeflow_tpu.serving.model_server import ModelServer
+from kubeflow_tpu.testing import faults
 
 log = logging.getLogger(__name__)
 
@@ -43,6 +46,11 @@ _ROUTES = [
      "classify"),
     ("GET", re.compile(r"^/$"), "index"),
     ("GET", re.compile(r"^/healthz$"), "health"),
+    # Readiness (load-balancer signal) is deliberately a DIFFERENT
+    # route from liveness: /readyz flips 503 during SIGTERM drain so
+    # rolling updates stop routing here, while /healthz stays 200 so
+    # the kubelet does not kill a pod that is busy draining.
+    ("GET", re.compile(r"^/readyz$"), "ready"),
     ("GET", re.compile(r"^/metrics$"), "metrics"),
 ]
 
@@ -128,13 +136,35 @@ class ServingAPI:
         instances = body.get("instances")
         if instances is None:
             raise ValueError("Request json object must use the key: instances")
+        # Per-request deadline: {"deadline_ms": 500, "instances": [...]}
+        # becomes an absolute policy-clock instant enforced in the
+        # batching planes (queued AND, on the engine, mid-generation).
+        # Expiry surfaces as DeadlineExceeded -> HTTP 504.
+        deadline = None
+        deadline_ms = body.get("deadline_ms")
+        if deadline_ms is not None:
+            try:
+                deadline_ms = float(deadline_ms)
+            except (TypeError, ValueError):
+                raise ValueError(
+                    f"deadline_ms must be a number, got "
+                    f"{deadline_ms!r}") from None
+            # NaN would sail through `<= 0` and then lose every later
+            # comparison — a deadline the client believes is set but
+            # nothing enforces.
+            if not math.isfinite(deadline_ms) or deadline_ms <= 0:
+                raise ValueError(
+                    f"deadline_ms must be a positive finite number, "
+                    f"got {deadline_ms}")
+            deadline = faults.monotonic() + deadline_ms / 1e3
         instances = decode_b64_if_needed(instances)
         model = self.server.get(name, version)
         sig_inputs = list(
             model.meta.get("signature", {}).get("inputs", []) or []
         )
         inputs = instances_to_inputs(instances, sig_inputs or None)
-        outputs = self.server.predict(name, inputs, version)
+        outputs = self.server.predict(name, inputs, version,
+                                      deadline=deadline)
         return {"predictions": outputs_to_predictions(outputs)}
 
     def classify(
@@ -165,6 +195,17 @@ class _Handler(BaseHTTPRequestHandler):
         log.debug("http: " + fmt, *args)
 
     def _dispatch(self, method: str) -> None:
+        # Bracket the WHOLE dispatch — body read included — in the
+        # server's in-flight count: a drain must wait for a request
+        # that was accepted but is still parsing, not just for ones
+        # already inside predict().
+        self.api.server.enter_request()
+        try:
+            self._dispatch_inner(method)
+        finally:
+            self.api.server.exit_request()
+
+    def _dispatch_inner(self, method: str) -> None:
         for m, pattern, action in _ROUTES:
             if m != method:
                 continue
@@ -177,6 +218,15 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send(404, {"error": str(e)})
             except ValueError as e:
                 self._send(400, {"error": str(e)})
+            except Overloaded as e:
+                # Load shed: bounded-admission refusal.  Retry-After
+                # carries the batcher's hint so well-behaved clients
+                # back off instead of hammering a full queue.
+                self._send(429, {"error": str(e)},
+                           headers={"Retry-After":
+                                    f"{max(1, round(e.retry_after_s))}"})
+            except DeadlineExceeded as e:
+                self._send(504, {"error": str(e)})
             except Exception as e:  # noqa: BLE001 — serving must not die
                 log.exception("handler error")
                 self._send(500, {"error": f"{type(e).__name__}: {e}"})
@@ -189,6 +239,15 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(200, WELCOME, raw=True)
         elif action == "health":
             self._send(200, {"status": "ok", "models": self.api.server.models()})
+        elif action == "ready":
+            server = self.api.server
+            if server.is_ready():
+                self._send(200, {"status": "ready",
+                                 "models": server.models()})
+            else:
+                self._send(503, {
+                    "status": "draining" if server.draining()
+                    else "no models loaded"})
         elif action == "metrics":
             from kubeflow_tpu.runtime.prom import REGISTRY
 
@@ -222,6 +281,12 @@ class _Handler(BaseHTTPRequestHandler):
             try:
                 out = fn(name, body, version)
                 outcome = "ok"
+            except Overloaded:
+                outcome = "shed"
+                raise
+            except DeadlineExceeded:
+                outcome = "deadline_exceeded"
+                raise
             finally:
                 REGISTRY.counter(REQUESTS_TOTAL, REQUESTS_HELP).inc(
                     model=model_label, route=action, outcome=outcome)
@@ -232,13 +297,16 @@ class _Handler(BaseHTTPRequestHandler):
                 ).observe(_time.perf_counter() - t0, route=action)
             self._send(200, out)
 
-    def _send(self, code: int, payload: Any, raw: bool = False) -> None:
+    def _send(self, code: int, payload: Any, raw: bool = False,
+              headers: Optional[Dict[str, str]] = None) -> None:
         data = (payload if raw else json.dumps(payload)).encode()
         self.send_response(code)
         self.send_header(
             "Content-Type", "text/plain" if raw else "application/json"
         )
         self.send_header("Content-Length", str(len(data)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
         self.end_headers()
         self.wfile.write(data)
 

@@ -17,9 +17,11 @@ import logging
 import signal
 import sys
 import threading
+import time
 
 from kubeflow_tpu.serving.http import make_http_server
 from kubeflow_tpu.serving.model_server import ModelServer
+from kubeflow_tpu.testing import faults
 
 
 def batcher_factory(*, micro_batch_size: int, batch_timeout_s: float,
@@ -30,7 +32,9 @@ def batcher_factory(*, micro_batch_size: int, batch_timeout_s: float,
                     lm_engine_prefill_len: int = 0,
                     lm_engine_sync_lag: int = 2,
                     lm_engine_steps_per_call: int = 1,
-                    lm_engine_admit_width: int = 4):
+                    lm_engine_admit_width: int = 4,
+                    max_queue_depth: int = 0,
+                    overload_retry_after_s: float = 1.0):
     """ModelServer.enable_batching factory: picks the batcher per model.
 
     lm_generate models default to the continuous-batching DecodeEngine
@@ -88,6 +92,8 @@ def batcher_factory(*, micro_batch_size: int, batch_timeout_s: float,
                     sync_lag=lm_engine_sync_lag,
                     steps_per_call=lm_engine_steps_per_call,
                     admit_width=lm_engine_admit_width,
+                    max_queue_depth=max_queue_depth,
+                    overload_retry_after_s=overload_retry_after_s,
                     name=f"{model.name}-v{model.version}")
             logging.warning(
                 "decode engine disabled for %r: max_new_tokens %d "
@@ -99,6 +105,8 @@ def batcher_factory(*, micro_batch_size: int, batch_timeout_s: float,
             max_batch_size=micro_batch_size,
             batch_timeout_s=batch_timeout_s,
             allowed_batch_sizes=sizes,
+            max_queue_depth=max_queue_depth,
+            overload_retry_after_s=overload_retry_after_s,
             name=f"{model.name}-v{model.version}",
         )
         loader = str(model.meta.get("loader", ""))
@@ -175,10 +183,46 @@ def main(argv=None) -> int:
                     help="DecodeEngine prefill admission rows per call: "
                          "bursts of arrivals prefill together instead "
                          "of one serialized prefill per request")
+    ap.add_argument("--max_queue_depth", type=int, default=256,
+                    help="bounded admission: submissions beyond this "
+                         "many pending requests per model fail fast "
+                         "with HTTP 429 / gRPC RESOURCE_EXHAUSTED "
+                         "instead of queueing unboundedly (0 = "
+                         "unbounded)")
+    ap.add_argument("--max_inflight", type=int, default=512,
+                    help="per-model in-flight request cap across ALL "
+                         "paths — including the direct (un-batched) "
+                         "one, which has no queue to bound it; beyond "
+                         "it submissions shed with 429 (0 = unbounded)")
+    ap.add_argument("--overload_retry_after_s", type=float, default=1.0,
+                    help="Retry-After hint carried by shed (429) "
+                         "responses")
+    ap.add_argument("--drain_deadline_s", type=float, default=30.0,
+                    help="graceful-drain budget on SIGTERM: /readyz "
+                         "flips not-ready immediately, then in-flight "
+                         "requests get this long to finish before the "
+                         "listeners close (match it to the pod's "
+                         "terminationGracePeriodSeconds)")
+    ap.add_argument("--reload_backoff_s", type=float, default=0.5,
+                    help="initial circuit-breaker backoff after a "
+                         "model (re)load failure (doubles per failure, "
+                         "jittered; the last-good version keeps "
+                         "serving while the breaker is open)")
+    ap.add_argument("--reload_backoff_cap_s", type=float, default=60.0,
+                    help="circuit-breaker backoff ceiling")
     args = ap.parse_args(argv)
 
     logging.basicConfig(level=logging.INFO, stream=sys.stderr)
-    server = ModelServer(poll_interval_s=args.poll_interval_s)
+    # Scripted chaos (KFT_FAULTS env var): no-op unless set — see
+    # kubeflow_tpu/testing/faults.py for the grammar.
+    if faults.install_from_env() is not None:
+        logging.warning("fault injection ACTIVE (KFT_FAULTS set)")
+    server = ModelServer(
+        poll_interval_s=args.poll_interval_s,
+        reload_backoff_s=args.reload_backoff_s,
+        reload_backoff_cap_s=args.reload_backoff_cap_s,
+        max_inflight=args.max_inflight,
+        overload_retry_after_s=args.overload_retry_after_s)
     server.add_model(args.model_name, args.model_base_path)
     # The factory is installed whenever ANY batching path might apply:
     # lm_generate models default to the continuous DecodeEngine even
@@ -198,6 +242,8 @@ def main(argv=None) -> int:
                 lm_engine_sync_lag=args.lm_engine_sync_lag,
                 lm_engine_steps_per_call=args.lm_engine_steps_per_call,
                 lm_engine_admit_width=args.lm_engine_admit_width,
+                max_queue_depth=args.max_queue_depth,
+                overload_retry_after_s=args.overload_retry_after_s,
             ),
         )
         logging.info(
@@ -230,14 +276,52 @@ def main(argv=None) -> int:
           file=sys.stderr, flush=True)
 
     stop = threading.Event()
-    signal.signal(signal.SIGTERM, lambda *_: stop.set())
-    signal.signal(signal.SIGINT, lambda *_: stop.set())
+
+    def on_signal(*_):
+        # Readiness flips INSIDE the handler: the load balancer must
+        # see /readyz go 503 at the first possible instant, while
+        # /healthz stays 200 (a draining pod is alive, not dead).
+        server.begin_drain()
+        stop.set()
+
+    signal.signal(signal.SIGTERM, on_signal)
+    signal.signal(signal.SIGINT, on_signal)
     stop.wait()
+    # Graceful drain: requests already accepted — and stragglers routed
+    # here before the endpoint controller catches up — finish inside
+    # the drain budget; only then do the listeners close.  Rolling
+    # updates on GKE therefore lose zero accepted requests (the engine
+    # additionally drains its in-flight slots in server.stop()).
+    drained = wait_for_drain(server, args.drain_deadline_s)
+    logging.info("drain %s after SIGTERM (in-flight now %d)",
+                 "complete" if drained else "deadline exceeded",
+                 server.inflight())
     httpd.shutdown()
     if grpc_server is not None:
         grpc_server.stop(grace=1)
     server.stop()
     return 0
+
+
+def wait_for_drain(server: ModelServer, deadline_s: float,
+                   settle_s: float = 0.25,
+                   poll_s: float = 0.02) -> bool:
+    """Block until the server's in-flight count stays at zero for
+    ``settle_s`` (new stragglers may still arrive while load balancers
+    catch up with the readiness flip) or ``deadline_s`` passes.
+    Returns True when the server quiesced inside the budget."""
+    deadline = time.monotonic() + max(0.0, deadline_s)
+    quiet_since = None
+    while time.monotonic() < deadline:
+        if server.inflight() == 0:
+            if quiet_since is None:
+                quiet_since = time.monotonic()
+            elif time.monotonic() - quiet_since >= settle_s:
+                return True
+        else:
+            quiet_since = None
+        time.sleep(poll_s)
+    return server.inflight() == 0
 
 
 if __name__ == "__main__":

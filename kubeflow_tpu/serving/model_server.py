@@ -16,20 +16,22 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import random
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
 
+from kubeflow_tpu.serving.errors import (  # noqa: F401 — re-exported
+    BatcherClosed,
+    DeadlineExceeded,
+    Overloaded,
+)
 from kubeflow_tpu.serving.export import list_versions, load_version
+from kubeflow_tpu.testing import faults
 
 log = logging.getLogger(__name__)
-
-
-class BatcherClosed(RuntimeError):
-    """Raised by submit() on a closed batcher — callers holding a stale
-    reference (hot-swap races) retry against the replacement."""
 
 
 def locked_snapshot(lock, data: Dict[str, Any],
@@ -52,6 +54,17 @@ REQUESTS_TOTAL = "kft_serving_requests_total"
 REQUESTS_HELP = "serving requests by model/route/outcome (REST + gRPC)"
 LATENCY_SECONDS = "kft_serving_request_seconds"
 LATENCY_HELP = "serving request latency by route (REST + gRPC)"
+# Fault-layer series shared by every batching plane (MicroBatcher,
+# BucketedLMBatcher, DecodeEngine) — one series per batcher label, so
+# overload sheds and deadline expiries are comparable across planes.
+SHED_TOTAL = "kft_serving_shed_total"
+SHED_HELP = "admissions refused at the queue/in-flight caps, by batcher"
+EXPIRED_TOTAL = "kft_serving_deadline_expired_total"
+EXPIRED_HELP = "requests failed by their deadline, by batcher"
+RELOAD_FAILURES_TOTAL = "kft_serving_reload_failures_total"
+RELOAD_FAILURES_HELP = "model (re)load attempts that raised, by model"
+BREAKER_OPEN = "kft_serving_reload_breaker_open"
+BREAKER_OPEN_HELP = "1 while a model's reload circuit breaker is open"
 
 
 @dataclasses.dataclass
@@ -62,10 +75,90 @@ class LoadedModel:
     meta: Dict[str, Any]
 
 
+class _ReloadBreaker:
+    """Exponential-backoff circuit breaker for one model's (re)loads.
+
+    A corrupt checkpoint directory must not hot-loop the version
+    watcher: after a load failure the breaker OPENS for a jittered,
+    exponentially-growing backoff during which reload() skips the disk
+    entirely (the last-good version keeps serving).  When the backoff
+    expires the breaker goes HALF-OPEN: exactly one trial load runs;
+    success closes it, failure re-opens with a doubled backoff.  A NEW
+    latest version (different from the one that failed) resets the
+    breaker immediately — the breaker guards the corrupt artifact, not
+    the model name.
+
+    The backoff clock is faults.monotonic() (the skewable policy
+    clock), so chaos tests drive the open -> half-open -> closed walk
+    without wall-clock sleeps."""
+
+    def __init__(self, base_s: float = 0.5, cap_s: float = 60.0,
+                 rng: Optional[random.Random] = None):
+        self._base_s = base_s
+        self._cap_s = cap_s
+        # OS-seeded by default: each replica must walk a DIFFERENT
+        # jitter sequence or concurrent replicas watching one shared
+        # model path retry in lockstep.  Tests needing a fixed walk
+        # pass their own rng.
+        self._rng = rng or random.Random()
+        self._lock = threading.Lock()
+        self.failures = 0
+        self.open_until = 0.0
+        self.failing_version: Optional[int] = None
+        self._half_open = False
+
+    def allow(self, version: int) -> bool:
+        """May a load of ``version`` run now?  Claims the single
+        half-open trial slot when the backoff has expired."""
+        with self._lock:
+            if self.failures == 0:
+                return True
+            if version != self.failing_version:
+                self._reset_locked()
+                return True
+            if self._half_open:
+                return False  # a trial is already in flight
+            if faults.monotonic() < self.open_until:
+                return False
+            self._half_open = True
+            return True
+
+    def record_failure(self, version: int) -> None:
+        with self._lock:
+            self.failures += 1
+            self.failing_version = version
+            self._half_open = False
+            backoff = min(self._cap_s,
+                          self._base_s * (2 ** (self.failures - 1)))
+            # Full jitter up to +25%: concurrent replicas watching one
+            # shared model path must not retry in lockstep.
+            backoff *= 1.0 + 0.25 * self._rng.random()
+            self.open_until = faults.monotonic() + backoff
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._reset_locked()
+
+    def _reset_locked(self) -> None:
+        self.failures = 0
+        self.open_until = 0.0
+        self.failing_version = None
+        self._half_open = False
+
+    @property
+    def open(self) -> bool:
+        with self._lock:
+            return self.failures > 0
+
+
 class ModelServer:
     """Serves N named models, each from a versioned base path."""
 
-    def __init__(self, poll_interval_s: float = 2.0):
+    def __init__(self, poll_interval_s: float = 2.0,
+                 reload_backoff_s: float = 0.5,
+                 reload_backoff_cap_s: float = 60.0,
+                 max_inflight: int = 0,
+                 overload_retry_after_s: float = 1.0):
         self._models: Dict[str, Dict[int, LoadedModel]] = {}
         self._base_paths: Dict[str, str] = {}
         self._lock = threading.RLock()
@@ -77,6 +170,24 @@ class ModelServer:
         # hot-swap keeps batching without a restart.
         self._batcher_factories: Dict[str, Callable] = {}
         self._batchers: Dict[str, Any] = {}
+        # Reload circuit breakers, one per model (see _ReloadBreaker).
+        self._reload_backoff_s = reload_backoff_s
+        self._reload_backoff_cap_s = reload_backoff_cap_s
+        self._breakers: Dict[str, _ReloadBreaker] = {}
+        # Readiness: /readyz flips not-ready on begin_drain() (SIGTERM)
+        # while /healthz stays live — the rolling-update contract.
+        self._draining = threading.Event()
+        # Requests inside predict() right now, across REST + gRPC +
+        # direct callers — the graceful-drain quiescence signal.
+        self._inflight = 0
+        # Per-model in-flight cap covering EVERY path — including the
+        # direct one (multi-row requests, prompts a batcher's accepts()
+        # declines), which has no batcher queue to bound it: each such
+        # request otherwise runs a whole device program on its own
+        # transport thread, unbounded.  0 = unbounded.
+        self._max_inflight = max(0, int(max_inflight))
+        self._overload_retry_after_s = overload_retry_after_s
+        self._inflight_by_model: Dict[str, int] = {}
 
     # -- loading ----------------------------------------------------------
 
@@ -88,7 +199,14 @@ class ModelServer:
 
     def reload(self, name: str) -> bool:
         """Scan the base path; load new latest version, drop stale ones.
-        Returns True if the served version changed."""
+        Returns True if the served version changed.
+
+        Load failures (corrupt checkpoint directory, bad loader) raise
+        to the caller AND trip the model's circuit breaker: until its
+        jittered exponential backoff expires, further reload() calls of
+        the same version return False without touching the loader, so
+        the version watcher cannot hot-loop on a bad artifact while the
+        last-good version keeps serving."""
         base = self._base_paths[name]
         versions = list_versions(base)
         if not versions:
@@ -99,7 +217,33 @@ class ModelServer:
             have = self._models[name]
             if latest in have:
                 return False
-        predict, meta = load_version(base, latest)
+            breaker = self._breakers.get(name)
+            if breaker is None:
+                breaker = self._breakers[name] = _ReloadBreaker(
+                    self._reload_backoff_s, self._reload_backoff_cap_s)
+        if not breaker.allow(latest):
+            return False
+        from kubeflow_tpu.runtime.prom import REGISTRY
+
+        try:
+            faults.fire("loader.load")
+            predict, meta = load_version(base, latest)
+        except Exception:
+            breaker.record_failure(latest)
+            REGISTRY.counter(
+                RELOAD_FAILURES_TOTAL, RELOAD_FAILURES_HELP).inc(
+                    model=name)
+            REGISTRY.gauge(BREAKER_OPEN, BREAKER_OPEN_HELP).set(
+                1, model=name)
+            log.warning(
+                "load of %r v%d failed; breaker open until +%.1fs "
+                "(failure #%d), last-good version keeps serving",
+                name, latest,
+                max(0.0, breaker.open_until - faults.monotonic()),
+                breaker.failures)
+            raise
+        breaker.record_success()
+        REGISTRY.gauge(BREAKER_OPEN, BREAKER_OPEN_HELP).set(0, model=name)
         with self._lock:
             model = LoadedModel(
                 name=name, version=latest, predict=predict, meta=meta
@@ -213,6 +357,50 @@ class ModelServer:
         with self._lock:
             return name in self._models
 
+    # -- readiness / drain ------------------------------------------------
+
+    def begin_drain(self) -> None:
+        """Flip /readyz not-ready (SIGTERM).  Requests already accepted
+        — and late arrivals from load balancers that have not yet seen
+        the readiness flip — keep being served; only the readiness
+        signal changes, so rolling updates drain without dropping."""
+        if not self._draining.is_set():
+            log.info("drain: readiness flipped to not-ready")
+        self._draining.set()
+
+    def draining(self) -> bool:
+        return self._draining.is_set()
+
+    def is_ready(self) -> bool:
+        """Readiness = at least one model loaded and not draining —
+        distinct from /healthz liveness, which stays true throughout a
+        drain (a draining pod is alive, just not accepting NEW work)."""
+        if self._draining.is_set():
+            return False
+        with self._lock:
+            return any(self._models.values())
+
+    def inflight(self) -> int:
+        """Requests currently inside predict() plus accepted transport
+        requests still being parsed (enter_request) — the graceful-
+        drain quiescence signal."""
+        with self._lock:
+            return self._inflight
+
+    def enter_request(self) -> None:
+        """Transport-level in-flight bracket: the REST handler wraps
+        its WHOLE dispatch (body read and parse included) so drain
+        cannot conclude quiescence while an accepted connection is
+        still deserializing the request it would then lose.  Nests
+        with predict()'s own bracket — inflight() is a zero/nonzero
+        quiescence signal, not a request count."""
+        with self._lock:
+            self._inflight += 1
+
+    def exit_request(self) -> None:
+        with self._lock:
+            self._inflight -= 1
+
     def batcher_stats(self, name: str) -> Optional[Dict[str, Any]]:
         """Live stats of the model's batcher/engine (None when the model
         serves on the direct path) — the :stats REST route and the gRPC
@@ -239,7 +427,41 @@ class ModelServer:
     def predict(
         self, name: str, inputs: Dict[str, Any],
         version: Optional[int] = None,
+        deadline: Optional[float] = None,
     ) -> Dict[str, Any]:
+        """``deadline`` is an absolute faults.monotonic() instant: the
+        batching planes enforce it in their queues and (the engine) mid-
+        generation; the direct path checks it at entry only — a jitted
+        whole-generation program cannot be interrupted, which is exactly
+        why the engine owns the LM hot path."""
+        with self._lock:
+            if self._max_inflight and self._inflight_by_model.get(
+                    name, 0) >= self._max_inflight:
+                from kubeflow_tpu.runtime.prom import REGISTRY
+
+                REGISTRY.counter(SHED_TOTAL, SHED_HELP).inc(
+                    batcher=f"{name}-inflight")
+                raise Overloaded(
+                    f"model {name!r} at its in-flight cap "
+                    f"({self._max_inflight})",
+                    retry_after_s=self._overload_retry_after_s)
+            self._inflight += 1
+            self._inflight_by_model[name] = \
+                self._inflight_by_model.get(name, 0) + 1
+        try:
+            return self._predict(name, inputs, version, deadline)
+        finally:
+            with self._lock:
+                self._inflight -= 1
+                self._inflight_by_model[name] -= 1
+
+    def _predict(
+        self, name: str, inputs: Dict[str, Any],
+        version: Optional[int], deadline: Optional[float],
+    ) -> Dict[str, Any]:
+        if deadline is not None and faults.monotonic() >= deadline:
+            raise DeadlineExceeded(
+                f"deadline expired before dispatch of {name!r}")
         if version is None:
             # Convert list-typed payloads (raw REST JSON) to arrays ONCE
             # before the batched path touches them — _single_row,
@@ -249,9 +471,13 @@ class ModelServer:
                 k: v if hasattr(v, "shape") else np.asarray(v)
                 for k, v in inputs.items()
             }
-            # Bounded retry: a hot-swap can close the batcher between
-            # the lookup and submit (BatcherClosed); the second lap
-            # picks up the replacement built by reload().
+            # Bounded retry: a hot-swap or drain can close the batcher
+            # between the lookup and submit — and close() now FAILS
+            # queued entries with BatcherClosed instead of draining
+            # them — so the second lap picks up the replacement built
+            # by reload(), and a missing replacement falls through to
+            # the direct path: an accepted request is never dropped by
+            # a swap race.
             for _ in range(2):
                 with self._lock:
                     batcher = self._batchers.get(name)
@@ -261,10 +487,19 @@ class ModelServer:
                 if accepts is not None and not accepts(converted):
                     break  # e.g. prompt beyond the largest bucket
                 try:
-                    return batcher.submit(converted)
+                    if deadline is None:
+                        return batcher.submit(converted)
+                    return batcher.submit(converted, deadline=deadline)
                 except BatcherClosed:
                     continue
         model = self.get(name, version)
+        # Re-checked at the fallthrough: the request may have spent its
+        # whole budget queued in a batcher that closed under it (drain,
+        # swap race) — launching an uninterruptible whole-generation
+        # program now would return a late 200 the caller abandoned.
+        if deadline is not None and faults.monotonic() >= deadline:
+            raise DeadlineExceeded(
+                f"deadline expired before direct dispatch of {name!r}")
         return model.predict(inputs)
 
 
@@ -299,6 +534,8 @@ class MicroBatcher:
         batch_timeout_s: float = 0.005,
         allowed_batch_sizes: Optional[List[int]] = None,
         in_flight: int = 2,
+        max_queue_depth: int = 0,
+        overload_retry_after_s: float = 1.0,
         name: str = "default",
         group_key: Optional[Callable[[Dict[str, Any]], Any]] = None,
         collate: Optional[Callable[
@@ -349,6 +586,15 @@ class MicroBatcher:
         self._stopped = False
         self._batch_sizes: Dict[int, int] = {}
         self._requests = 0
+        # Bounded admission: > max_queue_depth pending entries shed new
+        # submissions with Overloaded (fail-fast 429) instead of
+        # queueing unboundedly; 0 = unbounded (library default — the
+        # serving entrypoint configures a bound).
+        self.max_queue_depth = max(0, int(max_queue_depth))
+        self.overload_retry_after_s = overload_retry_after_s
+        self._pending_total = 0
+        self._shed = 0
+        self._expired = 0
         # Per-stage dispatch-cycle accounting (seconds, cumulative) —
         # the first thing VERDICT r4 asked for when capacity came in 5x
         # under the device rate: queue_wait is oldest-entry age at
@@ -375,6 +621,8 @@ class MicroBatcher:
             "occupied micro-batch size at dispatch, by batcher",
             buckets=(1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0),
         ).declare(batcher=name)
+        self._shed_ctr = REGISTRY.counter(SHED_TOTAL, SHED_HELP)
+        self._expired_ctr = REGISTRY.counter(EXPIRED_TOTAL, EXPIRED_HELP)
         self._runners = [
             threading.Thread(target=self._run, daemon=True,
                              name=f"microbatcher-{i}")
@@ -383,17 +631,30 @@ class MicroBatcher:
         for r in self._runners:
             r.start()
 
-    def submit(self, inputs: Dict[str, Any]) -> Dict[str, Any]:
+    def submit(self, inputs: Dict[str, Any],
+               deadline: Optional[float] = None) -> Dict[str, Any]:
         """One logical request of batch-dim 1 ([1, ...] rows).
 
         Enforced here (loudly, to the offending caller only): each
         entry gets exactly ONE result row back at delivery, so a
         multi-row submission would silently lose every row but the
         first.  Hooked batchers (group_key/collate) validate in their
-        own submit (e.g. BucketedLMBatcher)."""
+        own submit (e.g. BucketedLMBatcher).
+
+        ``deadline`` (absolute faults.monotonic() instant): expired-on-
+        arrival raises DeadlineExceeded immediately; a queued entry
+        whose deadline passes pre-dispatch is failed by the runner
+        sweep instead of being dispatched."""
         entry = {"inputs": inputs,
-                 "t": time.monotonic(),
+                 "t": time.monotonic(), "deadline": deadline,
                  "event": threading.Event(), "out": None, "err": None}
+        if deadline is not None and faults.monotonic() >= deadline:
+            with self._lock:
+                self._expired += 1
+            self._expired_ctr.inc(batcher=self._metric_name)
+            raise DeadlineExceeded(
+                f"deadline expired before batcher "
+                f"{self._metric_name!r} admission")
         # Signature computed once, outside the lock: np.asarray on
         # list-typed payloads (the REST JSON path) is O(payload).
         if self._group_key is not None:
@@ -412,7 +673,18 @@ class MicroBatcher:
                 # appended now would wait forever on its Event.
                 raise BatcherClosed(f"batcher {self._metric_name!r} "
                                     "is closed")
+            if self.max_queue_depth \
+                    and self._pending_total >= self.max_queue_depth:
+                # Fail fast: under overload a bounded 429 beats an
+                # unbounded queue whose every entry times out.
+                self._shed += 1
+                self._shed_ctr.inc(batcher=self._metric_name)
+                raise Overloaded(
+                    f"batcher {self._metric_name!r} queue full "
+                    f"({self._pending_total} pending)",
+                    retry_after_s=self.overload_retry_after_s)
             self._groups.setdefault(sig, []).append(entry)
+            self._pending_total += 1
             self._flusher.notify()
         entry["event"].wait()
         if entry["err"] is not None:
@@ -427,7 +699,9 @@ class MicroBatcher:
             self._lock, self._cycle,
             lambda: {"hist": dict(sorted(self._batch_sizes.items())),
                      "requests": self._requests,
-                     "max_overlap": self._max_in_process})
+                     "max_overlap": self._max_in_process,
+                     "queue_depth": self._pending_total,
+                     "shed": self._shed, "expired": self._expired})
         hist, requests = extra["hist"], extra["requests"]
         max_overlap = extra["max_overlap"]
         batches = sum(hist.values())
@@ -441,12 +715,30 @@ class MicroBatcher:
                 k: round(v / batches * 1e3, 3) for k, v in cycle.items()
             } if batches else {},
             "max_pipeline_depth": max_overlap,
+            "queue_depth": extra["queue_depth"],
+            "shed": extra["shed"],
+            "deadline_expired": extra["expired"],
         }
 
     def close(self) -> None:
+        """Refuse new work AND fail queued-undispatched entries with
+        BatcherClosed (batches already dispatched complete normally) —
+        the same contract as DecodeEngine.close.  Failing instead of
+        draining keeps every path consistent: ModelServer.predict
+        catches BatcherClosed and retries the replacement batcher (hot
+        swap) or falls through to the direct path (drain/stop), so an
+        accepted request is never dropped — it just stops waiting on a
+        dying queue."""
         with self._lock:
             self._stopped = True
+            queued = [e for q in self._groups.values() for e in q]
+            self._groups.clear()
+            self._pending_total = 0
             self._flusher.notify_all()
+        err = BatcherClosed(f"batcher {self._metric_name!r} is closed")
+        for e in queued:
+            e["err"] = err
+            e["event"].set()
         for r in self._runners:
             r.join(timeout=5)
 
@@ -458,7 +750,8 @@ class MicroBatcher:
             sig.append((k, a.shape, a.dtype.str))
         return tuple(sig)
 
-    def _take_batch_locked(self) -> Optional[List[dict]]:
+    def _take_batch_locked(
+            self, expired: List[dict]) -> Optional[List[dict]]:
         """Pop the next dispatchable shape group, or None with no group
         ready yet (caller waits until the earliest group deadline).
 
@@ -471,19 +764,48 @@ class MicroBatcher:
         groups get no priority over expired ones, or a saturating
         majority shape would starve minority shapes forever (their
         clients block in submit with no timeout).
+
+        Request deadlines are swept here too: entries whose deadline
+        (policy clock) has passed move into ``expired`` — the caller
+        fails them with DeadlineExceeded outside the lock — and pending
+        request deadlines join the wakeup computation so an expiring
+        entry is failed promptly even when no batch deadline is near.
         """
         now = time.monotonic()
+        pnow = faults.monotonic()  # policy clock (skewable) — deadlines
         best_sig, best_t = None, None
         self._next_deadline = None
-        for sig, q in self._groups.items():
+
+        def note_wake(at: float) -> None:
+            if self._next_deadline is None or at < self._next_deadline:
+                self._next_deadline = at
+
+        for sig in list(self._groups):
+            q = self._groups[sig]
+            keep = []
+            for e in q:
+                d = e["deadline"]
+                if d is not None and d <= pnow:
+                    expired.append(e)
+                    continue
+                keep.append(e)
+                if d is not None:
+                    # Policy-clock remaining converted onto the real
+                    # clock the flusher waits against.
+                    note_wake(now + (d - pnow))
+            if len(keep) != len(q):
+                self._pending_total -= len(q) - len(keep)
+                if not keep:
+                    del self._groups[sig]
+                    continue
+                self._groups[sig] = q = keep
             deadline = q[0]["t"] + self.batch_timeout_s
             if (len(q) >= self.max_batch_size or deadline <= now
                     or self._stopped):
                 if best_t is None or q[0]["t"] < best_t:
                     best_sig, best_t = sig, q[0]["t"]
-            elif (self._next_deadline is None
-                  or deadline < self._next_deadline):
-                self._next_deadline = deadline
+            else:
+                note_wake(deadline)
         if best_sig is None:
             return None
         q = self._groups[best_sig]
@@ -492,37 +814,59 @@ class MicroBatcher:
             self._groups[best_sig] = rest
         else:
             del self._groups[best_sig]
+        self._pending_total -= len(batch)
         return batch
 
     def _run(self) -> None:
         while True:
+            expired: List[dict] = []
             with self._lock:
                 batch = None
-                while batch is None:
+                while batch is None and not expired:
                     if not self._groups:
                         if self._stopped:
                             return
                         self._flusher.wait()
                         continue
-                    batch = self._take_batch_locked()
-                    if batch is None:
+                    batch = self._take_batch_locked(expired)
+                    if batch is None and not expired:
                         # Sleep only until the earliest group's own
-                        # deadline — each shape ages independently.
+                        # deadline — each shape ages independently —
+                        # or the earliest request deadline, whichever
+                        # comes first.
                         self._flusher.wait(
-                            timeout=max(0.0, self._next_deadline
-                                        - time.monotonic()))
-                # stats() and the scrapeable histogram record the
-                # same quantity at the same site.
-                self._batch_sizes[len(batch)] = \
-                    self._batch_sizes.get(len(batch), 0) + 1
-                self._requests += len(batch)
-                self._size_hist.observe(
-                    float(len(batch)), batcher=self._metric_name)
-                self._cycle["queue_wait"] += (
-                    time.monotonic() - batch[0]["t"])
-                self._in_process += 1
-                self._max_in_process = max(self._max_in_process,
-                                           self._in_process)
+                            timeout=None if self._next_deadline is None
+                            else max(0.0, self._next_deadline
+                                     - time.monotonic()))
+                if expired:
+                    self._expired += len(expired)
+                if batch is not None:
+                    # stats() and the scrapeable histogram record the
+                    # same quantity at the same site.
+                    self._batch_sizes[len(batch)] = \
+                        self._batch_sizes.get(len(batch), 0) + 1
+                    self._requests += len(batch)
+                    self._size_hist.observe(
+                        float(len(batch)), batcher=self._metric_name)
+                    self._cycle["queue_wait"] += (
+                        time.monotonic() - batch[0]["t"])
+                    self._in_process += 1
+                    self._max_in_process = max(self._max_in_process,
+                                               self._in_process)
+            if expired:
+                # Failed OUTSIDE the lock: waking a waiter is not queue
+                # work, and the swept entries are no longer reachable
+                # from the groups.
+                self._expired_ctr.inc(len(expired),
+                                      batcher=self._metric_name)
+                err = DeadlineExceeded(
+                    f"deadline expired in batcher "
+                    f"{self._metric_name!r} queue")
+                for e in expired:
+                    e["err"] = err
+                    e["event"].set()
+            if batch is None:
+                continue
             try:
                 self._process(batch)
             finally:
@@ -537,6 +881,12 @@ class MicroBatcher:
 
     def _process(self, batch: List[dict]) -> None:
         try:
+            # Chaos hook: a scripted stall here simulates a wedged
+            # dispatch (queue builds, deadlines expire, admission
+            # sheds); a scripted raise takes the same propagate-to-
+            # waiters path as a device failure.  See
+            # kubeflow_tpu/testing/faults.py.
+            faults.fire("batcher.dispatch")
             # Stage timings accumulate LOCALLY and merge into
             # self._cycle under the queue lock at the end — _process
             # runs on dispatch threads while stats()/the /metrics
@@ -769,7 +1119,8 @@ class BucketedLMBatcher:
         length = tokens.shape[-1] if tokens.ndim else 0
         return bool(length and length <= self.buckets[-1])
 
-    def submit(self, inputs: Dict[str, Any]) -> Dict[str, Any]:
+    def submit(self, inputs: Dict[str, Any],
+               deadline: Optional[float] = None) -> Dict[str, Any]:
         """One logical request: tokens [t] or [1, t] (the MicroBatcher
         hands each entry exactly one result row back, so multi-row
         submissions would silently lose rows — rejected up front)."""
@@ -790,7 +1141,7 @@ class BucketedLMBatcher:
         row = {"tokens": tokens}
         if inputs.get("max_new_tokens") is not None:
             row["max_new_tokens"] = inputs["max_new_tokens"]
-        return self._inner.submit(row)
+        return self._inner.submit(row, deadline=deadline)
 
     def stats(self) -> Dict[str, Any]:
         return self._inner.stats()

@@ -177,6 +177,217 @@ def engine_smoke(namespace: str = "kubeflow-test") -> None:
             server.stop()
 
 
+def fault_injection_smoke(namespace: str = "kubeflow-test") -> None:
+    """Seeded chaos scenario against the whole serving fault layer,
+    driven by the KFT_FAULTS harness (kubeflow_tpu/testing/faults.py):
+
+      1. overload shed — slots full + queue full => HTTP 429 with a
+         Retry-After header, while accepted requests still complete;
+      2. deadline expiry MID-GENERATION (slow steps injected) => HTTP
+         504, and the freed slot serves a follow-up request;
+      3. loader circuit-break — a corrupt model version trips the
+         reload breaker (no loader hot-loop) while the last-good
+         version keeps serving; a fixed version recovers;
+      4. graceful drain — /readyz flips 503 with a request in flight,
+         /healthz stays 200, and the accepted request completes;
+      5. every shed/expired/reload-failure is visible in kft_* metrics.
+
+    Override the scenario by exporting KFT_FAULTS (same grammar).
+    """
+    import json
+    import os
+    import tempfile
+    import threading
+    import urllib.error
+    import urllib.request
+
+    import jax
+    import numpy as np
+
+    from kubeflow_tpu.models.transformer import Transformer
+    from kubeflow_tpu.serving.export import export
+    from kubeflow_tpu.serving.http import make_http_server
+    from kubeflow_tpu.serving.loaders import _model_config
+    from kubeflow_tpu.serving.main import batcher_factory, wait_for_drain
+    from kubeflow_tpu.serving.model_server import ModelServer
+    from kubeflow_tpu.testing import faults
+
+    overrides = {
+        "vocab_size": 128, "d_model": 32, "n_layers": 2, "n_heads": 4,
+        "n_kv_heads": 2, "d_ff": 64, "head_dim": 8, "max_seq_len": 64,
+        "dtype": "float32",
+    }
+    max_new = 16
+    scenario = os.environ.get(faults.ENV) or \
+        "seed=20260803;engine.step:sleep=0.03"
+    model = Transformer(_model_config(overrides))
+    variables = model.init(jax.random.key(0), np.zeros((1, 4), np.int32))
+
+    def predict_req(port, body):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/model/lm:predict",
+            data=json.dumps(body).encode())
+        try:
+            with urllib.request.urlopen(req, timeout=180) as resp:
+                return resp.status, dict(resp.headers), \
+                    json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            return e.code, dict(e.headers), json.loads(e.read())
+
+    def engine_stats(port):
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/model/lm:stats",
+                timeout=30) as resp:
+            return json.loads(resp.read())["batcher"]
+
+    prompt = list(range(1, 9))
+    body_full = {"instances": [{"tokens": prompt}]}
+    with faults.injected(scenario) as inj, \
+            tempfile.TemporaryDirectory() as tmp:
+        export(f"{tmp}/lm", 1, variables,
+               loader="kubeflow_tpu.serving.loaders:lm_generate",
+               config={"model": overrides, "max_new_tokens": max_new,
+                       "temperature": 0.0})
+        server = ModelServer(reload_backoff_s=0.5)
+        server.add_model("lm", f"{tmp}/lm")
+        server.enable_batching("lm", batcher_factory(
+            micro_batch_size=0, batch_timeout_s=0.005,
+            lm_engine=True, lm_engine_slots=1,
+            lm_engine_prefill_len=16, max_queue_depth=1))
+        httpd, _ = make_http_server(server, port=0, host="127.0.0.1")
+        port = httpd.server_address[1]
+        try:
+            # -- 1. overload shed ---------------------------------------
+            results: dict = {}
+
+            def client(i, body):
+                results[i] = predict_req(port, body)
+
+            t0 = threading.Thread(target=client, args=(0, body_full))
+            t0.start()
+            deadline = time.time() + 120
+            while engine_stats(port)["in_flight_requests"] < 1:
+                assert time.time() < deadline, "first request never ran"
+                time.sleep(0.01)
+            # Slot busy (slow steps injected): 4 more arrivals — the
+            # single queue seat takes one, the rest shed as 429.
+            burst = [threading.Thread(target=client, args=(i, body_full))
+                     for i in range(1, 5)]
+            for t in burst:
+                t.start()
+            for t in [t0] + burst:
+                t.join(timeout=180)
+            codes = sorted(results[i][0] for i in range(5))
+            assert codes.count(429) >= 1, codes
+            assert codes.count(200) >= 2, codes  # slot + queue seat
+            shed_headers = [results[i][1] for i in range(5)
+                            if results[i][0] == 429]
+            assert all(h.get("Retry-After") for h in shed_headers), (
+                "429 responses must carry Retry-After")
+            ok = [results[i][2] for i in range(5)
+                  if results[i][0] == 200]
+            for out in ok:
+                tokens = out["predictions"][0]["tokens"]
+                assert tokens[:len(prompt)] == prompt
+                assert len(tokens) == len(prompt) + max_new
+            # -- 2. deadline expiry mid-generation ----------------------
+            code, _, payload = predict_req(
+                port, {**body_full, "deadline_ms": 120})
+            assert code == 504, (code, payload)
+            assert "deadline" in payload["error"].lower()
+            # The expired request's slot is reclaimed: a follow-up
+            # full-budget request completes on the same single slot.
+            code, _, payload = predict_req(port, body_full)
+            assert code == 200, (code, payload)
+            stats = engine_stats(port)
+            assert stats["deadline_expired"] >= 1, stats
+            assert stats["shed"] >= 1, stats
+            # -- 3. loader circuit-break --------------------------------
+            os.makedirs(f"{tmp}/lm/2")
+            with open(f"{tmp}/lm/2/model.json", "w") as f:
+                f.write("{corrupt json")
+            raised = False
+            try:
+                server.reload("lm")
+            except Exception:
+                raised = True
+            assert raised, "corrupt version must raise"
+            attempts = inj.fired("loader.load")
+            # Breaker open: repeated polls (the watcher loop) skip the
+            # loader entirely — no hot-loop on the corrupt artifact.
+            for _ in range(5):
+                assert server.reload("lm") is False
+            assert inj.fired("loader.load") == attempts
+            # Last-good version keeps serving through the open breaker.
+            code, _, _ = predict_req(port, body_full)
+            assert code == 200
+            assert server.get("lm").version == 1
+            # Half-open after backoff (policy clock skipped forward):
+            # the trial load runs, still corrupt, breaker re-opens.
+            inj.advance_clock(30)
+            raised = False
+            try:
+                server.reload("lm")
+            except Exception:
+                raised = True
+            assert raised, "still-corrupt version must raise"
+            assert inj.fired("loader.load") == attempts + 1
+            # A NEW good version resets the breaker and loads at once.
+            export(f"{tmp}/lm", 3, variables,
+                   loader="kubeflow_tpu.serving.loaders:lm_generate",
+                   config={"model": overrides,
+                           "max_new_tokens": max_new,
+                           "temperature": 0.0})
+            assert server.reload("lm") is True
+            assert server.get("lm").version == 3
+            code, _, _ = predict_req(port, body_full)
+            assert code == 200
+            # -- 4. graceful drain --------------------------------------
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/readyz", timeout=30) as r:
+                assert r.status == 200
+            holder: dict = {}
+            t = threading.Thread(
+                target=lambda: holder.update(
+                    {"resp": predict_req(port, body_full)}))
+            t.start()
+            deadline = time.time() + 120
+            while server.inflight() < 1:
+                assert time.time() < deadline, "drain request never ran"
+                time.sleep(0.01)
+            server.begin_drain()
+            try:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/readyz", timeout=30)
+                raise AssertionError("/readyz must be 503 while draining")
+            except urllib.error.HTTPError as e:
+                assert e.code == 503
+                assert json.loads(e.read())["status"] == "draining"
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/healthz", timeout=30) as r:
+                assert r.status == 200  # alive, just not ready
+            t.join(timeout=180)
+            assert holder["resp"][0] == 200, (
+                "request accepted before drain was lost")
+            assert wait_for_drain(server, deadline_s=30)
+            # -- 5. shed/expired/breaker visible in kft_* metrics -------
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics", timeout=30) as r:
+                metrics = r.read().decode()
+            for needle in ('kft_serving_shed_total{batcher="lm-v1"}',
+                           'kft_serving_deadline_expired_total'
+                           '{batcher="lm-v1"}',
+                           'kft_serving_reload_failures_total'
+                           '{model="lm"}'):
+                line = [ln for ln in metrics.splitlines()
+                        if ln.startswith(needle)]
+                assert line and float(line[0].rsplit(" ", 1)[1]) >= 1, (
+                    f"expected a nonzero {needle} series")
+        finally:
+            httpd.shutdown()
+            server.stop()
+
+
 def train_smoke(namespace: str = "kubeflow-test") -> None:
     """A few real SPMD train steps on whatever devices exist."""
     import subprocess
@@ -307,6 +518,7 @@ COMMANDS = {
     "tpujob": tpujob_smoke,
     "serving": serving_smoke,
     "engine": engine_smoke,
+    "faults": fault_injection_smoke,
     "train": train_smoke,
     "deploy": deploy_real,
     "deploy-crds": deploy_crds,

@@ -53,6 +53,7 @@ def _parse_selector(qs: str) -> Optional[dict]:
 
 class _Handler(BaseHTTPRequestHandler):
     kube: FakeKube  # set by make_fake_apiserver
+    fail_queue: list  # injected failure codes; set by make_fake_apiserver
 
     def log_message(self, fmt, *args):
         pass
@@ -72,6 +73,20 @@ class _Handler(BaseHTTPRequestHandler):
         self.wfile.write(data)
 
     def _dispatch(self, method: str) -> None:
+        # Injected-failure queue (httpd.fail_queue): each entry is an
+        # HTTP status code served verbatim for one request, before any
+        # routing — how the retry layer in operator/kube_http.py is
+        # integration-tested against real 5xx over real sockets.
+        if self.fail_queue:
+            try:
+                code = self.fail_queue.pop(0)
+            except IndexError:
+                code = None  # raced another handler thread; serve real
+            if code is not None:
+                self._send(int(code), {
+                    "kind": "Status", "code": int(code),
+                    "message": "injected failure"})
+                return
         path, _, qs = self.path.partition("?")
         try:
             handled = self._route(method, path, qs)
@@ -189,6 +204,10 @@ def make_fake_apiserver(
 
     Returns (httpd, thread, store): ``store`` is the backing FakeKube —
     drive pod phases / read events through it while clients talk HTTP.
+    ``httpd.fail_queue`` is the injected-failure queue: append HTTP
+    status codes and the server serves each to exactly one upcoming
+    request (any route) before handling resumes — apiserver weather on
+    demand for retry/backoff tests.
     """
     store = kube or FakeKube()
 
@@ -196,7 +215,9 @@ def make_fake_apiserver(
         pass
 
     Handler.kube = store
+    Handler.fail_queue = []
     httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+    httpd.fail_queue = Handler.fail_queue
     thread = threading.Thread(target=httpd.serve_forever, daemon=True,
                               name="fake-apiserver")
     thread.start()

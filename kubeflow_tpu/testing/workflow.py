@@ -133,7 +133,7 @@ def default_e2e(name: str = "e2e", namespace: str = "kubeflow-test",
 # Per-platform default step lists (ci/e2e_config.yaml's `steps:` values
 # resolve to kubeflow_tpu.testing.e2e subcommands).
 PLATFORM_STEPS = {
-    "hermetic": ["tpujob", "serving", "engine", "train"],
+    "hermetic": ["tpujob", "serving", "engine", "faults", "train"],
     "kind": ["deploy-crds", "tpujob-real"],
     "gke": ["deploy", "tpujob-real"],
 }

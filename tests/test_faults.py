@@ -1,0 +1,139 @@
+"""The deterministic fault-injection harness (testing/faults.py):
+grammar, scripted actions, seeded probability, the policy clock, and
+the install/uninstall lifecycle.  The serving-side behavior the harness
+drives lives in tests/test_fault_tolerance.py."""
+
+import threading
+import time
+
+import pytest
+
+from kubeflow_tpu.testing import faults
+
+
+class TestGrammar:
+    def test_parse_actions_times_prob_seed(self):
+        inj = faults.parse(
+            "seed=7; engine.step:sleep=0.05*3@0.5 ;loader.load:raise;"
+            "clock.site:skew=2.5*1")
+        specs = inj._specs
+        s = specs["engine.step"][0]
+        assert (s.action, s.value, s.times, s.prob) == \
+            ("sleep", 0.05, 3, 0.5)
+        s = specs["loader.load"][0]
+        assert (s.action, s.value, s.times, s.prob) == \
+            ("raise", 0.0, -1, 1.0)
+        s = specs["clock.site"][0]
+        assert (s.action, s.value, s.times) == ("skew", 2.5, 1)
+
+    def test_bad_entries_rejected(self):
+        with pytest.raises(ValueError, match="site:action"):
+            faults.parse("just-a-site")
+        with pytest.raises(ValueError, match="unknown fault action"):
+            faults.parse("x:explode")
+
+    def test_empty_entries_ignored(self):
+        inj = faults.parse(";;seed=3;;")
+        assert inj._specs == {}
+
+
+class TestFiring:
+    def test_raise_action_and_times_bound(self):
+        inj = faults.parse("x:raise*2")
+        for _ in range(2):
+            with pytest.raises(faults.FaultInjected):
+                inj.fire("x")
+        inj.fire("x")  # budget spent: passes through
+        assert inj.fired("x") == 3  # encounters, not firings
+
+    def test_encounters_counted_without_spec(self):
+        # Production hooks at sites with no spec still count — tests
+        # use this to prove code did NOT reach a hook (breaker open).
+        inj = faults.parse("seed=1")
+        inj.fire("loader.load")
+        assert inj.fired("loader.load") == 1
+
+    def test_sleep_action_blocks(self):
+        inj = faults.parse("x:sleep=0.05*1")
+        t0 = time.perf_counter()
+        inj.fire("x")
+        assert time.perf_counter() - t0 >= 0.04
+        t0 = time.perf_counter()
+        inj.fire("x")  # budget spent
+        assert time.perf_counter() - t0 < 0.04
+
+    def test_seeded_probability_is_replayable(self):
+        def run():
+            inj = faults.parse("seed=42;x:raise@0.5")
+            hits = []
+            for _ in range(32):
+                try:
+                    inj.fire("x")
+                    hits.append(0)
+                except faults.FaultInjected:
+                    hits.append(1)
+            return hits
+
+        first, second = run(), run()
+        assert first == second
+        assert 0 < sum(first) < 32  # actually probabilistic
+
+
+class TestPolicyClock:
+    def test_skew_action_and_advance_clock(self):
+        inj = faults.parse("x:skew=5*1")
+        base = time.monotonic()
+        assert abs(inj.monotonic() - base) < 1.0
+        inj.fire("x")
+        assert inj.monotonic() - time.monotonic() >= 4.9
+        inj.advance_clock(10)
+        assert inj.monotonic() - time.monotonic() >= 14.9
+
+    def test_module_monotonic_tracks_installed_injector(self):
+        assert faults.active() is None
+        before = faults.monotonic()
+        assert abs(before - time.monotonic()) < 1.0
+        with faults.injected("seed=0") as inj:
+            inj.advance_clock(100)
+            assert faults.monotonic() - time.monotonic() >= 99
+        assert abs(faults.monotonic() - time.monotonic()) < 1.0
+
+
+class TestLifecycle:
+    def test_injected_context_restores_previous(self):
+        outer = faults.parse("a:raise")
+        faults.install(outer)
+        try:
+            with faults.injected("b:raise") as inner:
+                assert faults.active() is inner
+                with pytest.raises(faults.FaultInjected):
+                    faults.fire("b")
+            assert faults.active() is outer
+        finally:
+            faults.install(None)
+
+    def test_module_fire_is_noop_when_uninstalled(self):
+        assert faults.active() is None
+        faults.fire("anything")  # must not raise
+
+    def test_install_from_env(self):
+        inj = faults.install_from_env({"KFT_FAULTS": "x:raise*1"})
+        try:
+            assert faults.active() is inj
+            with pytest.raises(faults.FaultInjected):
+                faults.fire("x")
+        finally:
+            faults.install(None)
+        assert faults.install_from_env({}) is None
+        assert faults.active() is None
+
+    def test_thread_safety_of_counts(self):
+        inj = faults.parse("seed=0")
+        threads = [threading.Thread(
+            target=lambda: [inj.fire("x") for _ in range(200)])
+            for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert inj.fired("x") == 800

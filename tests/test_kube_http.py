@@ -94,6 +94,92 @@ class TestCustomResources:
         assert store.events and store.events[0]["reason"] == "Admitted"
 
 
+class TestTransientRetry:
+    """Satellite: transient apiserver 5xx / connection failures are
+    retried with capped jittered backoff so one blip does not fail a
+    reconcile pass; semantic 4xx are answers, never retried."""
+
+    def _client(self, httpd, **kw):
+        kw.setdefault("retries", 3)
+        kw.setdefault("retry_backoff_s", 0.002)
+        return HttpKube(
+            base_url=f"http://127.0.0.1:{httpd.server_address[1]}", **kw)
+
+    @pytest.fixture()
+    def raw(self):
+        from kubeflow_tpu.testing.fake_apiserver import make_fake_apiserver
+
+        httpd, thread, store = make_fake_apiserver()
+        yield httpd, store
+        httpd.shutdown()
+        httpd.server_close()
+
+    def test_5xx_retried_to_success(self, raw):
+        httpd, store = raw
+        client = self._client(httpd)
+        store.create_pod(_pod("ns1", "p0"))
+        httpd.fail_queue.extend([503, 500])
+        pods = client.list_pods("ns1")
+        assert [p["metadata"]["name"] for p in pods] == ["p0"]
+        assert httpd.fail_queue == []  # both injected failures consumed
+
+    def test_retries_exhausted_raises(self, raw):
+        httpd, _ = raw
+        client = self._client(httpd, retries=2)
+        httpd.fail_queue.extend([503, 503, 503])  # one more than budget
+        with pytest.raises(RuntimeError, match="-> 503"):
+            client.list_pods("ns1")
+
+    def test_semantic_4xx_never_retried(self, raw):
+        from kubeflow_tpu.testing import faults
+
+        httpd, _ = raw
+        client = self._client(httpd)
+        with faults.injected("seed=0") as inj:
+            with pytest.raises(NotFound):
+                client.get_pod("ns1", "ghost")
+            # Exactly one transport attempt: 404 is an answer.
+            assert inj.fired("kube.request") == 1
+
+    def test_connection_errors_retried(self, raw):
+        """Scripted connection failures (fault harness, fired before
+        the socket) are transparently retried like 5xx weather."""
+        from kubeflow_tpu.testing import faults
+
+        httpd, store = raw
+        client = self._client(httpd)
+        store.create_pod(_pod("ns1", "p0"))
+        with faults.injected("seed=0;kube.request:raise*2") as inj:
+            pods = client.list_pods("ns1")
+            assert len(pods) == 1
+            assert inj.fired("kube.request") == 3  # 2 failures + success
+
+    def test_mutations_never_retried(self, raw):
+        """POST/DELETE fail fast on 5xx: a replay of a mutation whose
+        response was lost could double-apply it (duplicate create ->
+        spurious Conflict); the reconciler's resweep is their retry."""
+        from kubeflow_tpu.testing import faults
+
+        httpd, store = raw
+        client = self._client(httpd)
+        httpd.fail_queue.append(503)
+        with faults.injected("seed=0") as inj:
+            with pytest.raises(RuntimeError, match="-> 503"):
+                client.create_pod(_pod("ns1", "p0"))
+            assert inj.fired("kube.request") == 1  # no replay
+        assert httpd.fail_queue == []
+        assert store.pods == {}  # nothing half-applied either
+
+    def test_connection_errors_exhausted_raise(self, raw):
+        from kubeflow_tpu.testing import faults
+
+        httpd, _ = raw
+        client = self._client(httpd, retries=1)
+        with faults.injected("kube.request:raise"):
+            with pytest.raises(RuntimeError, match="after 2 attempts"):
+                client.list_pods("ns1")
+
+
 class TestReconcileOverHTTP:
     def test_full_job_lifecycle_through_real_sockets(self, served):
         """The SAME controller the in-memory tests drive, now with every

@@ -533,7 +533,12 @@ class TestDispatchFairness:
         mb._groups = {}
         mb._next_deadline = None
         mb._stopped = False
+        mb._pending_total = 0
         return mb
+
+    @staticmethod
+    def _entry(t, id_, deadline=None):
+        return {"t": t, "id": id_, "deadline": deadline}
 
     def test_expired_minority_beats_full_majority(self):
         import time as _t
@@ -541,10 +546,10 @@ class TestDispatchFairness:
         mb = self._bare(max_batch_size=2, timeout=0.01)
         now = _t.monotonic()
         # Majority shape A: full group, fresh heads (sustained load).
-        mb._groups["A"] = [{"t": now, "id": i} for i in range(2)]
+        mb._groups["A"] = [self._entry(now, i) for i in range(2)]
         # Minority shape B: one entry, long expired.
-        mb._groups["B"] = [{"t": now - 1.0, "id": "b"}]
-        batch = mb._take_batch_locked()
+        mb._groups["B"] = [self._entry(now - 1.0, "b")]
+        batch = mb._take_batch_locked([])
         assert [e["id"] for e in batch] == ["b"], batch
 
     def test_full_group_dispatches_before_its_own_timeout(self):
@@ -552,9 +557,9 @@ class TestDispatchFairness:
 
         mb = self._bare(max_batch_size=2, timeout=10.0)
         now = _t.monotonic()
-        mb._groups["A"] = [{"t": now, "id": 0}, {"t": now, "id": 1}]
-        mb._groups["B"] = [{"t": now, "id": "b"}]  # neither full nor old
-        batch = mb._take_batch_locked()
+        mb._groups["A"] = [self._entry(now, 0), self._entry(now, 1)]
+        mb._groups["B"] = [self._entry(now, "b")]  # neither full nor old
+        batch = mb._take_batch_locked([])
         assert [e["id"] for e in batch] == [0, 1]
         # B stays queued with its own deadline registered.
         assert "B" in mb._groups and mb._next_deadline is not None
@@ -564,12 +569,30 @@ class TestDispatchFairness:
 
         mb = self._bare(max_batch_size=4, timeout=10.0)
         now = _t.monotonic()
-        mb._groups["A"] = [{"t": now, "id": 0}]
-        mb._groups["B"] = [{"t": now - 5.0, "id": "b"}]  # older, not expired
-        batch = mb._take_batch_locked()
+        mb._groups["A"] = [self._entry(now, 0)]
+        mb._groups["B"] = [self._entry(now - 5.0, "b")]  # older, not expired
+        batch = mb._take_batch_locked([])
         assert batch is None
         # Earliest deadline is B's (older head).
         assert abs(mb._next_deadline - (now - 5.0 + 10.0)) < 0.5
+
+    def test_request_deadline_swept_before_dispatch(self):
+        """A deadline-expired entry is swept into the expired list, not
+        dispatched — even when its group is otherwise dispatchable."""
+        import time as _t
+
+        mb = self._bare(max_batch_size=2, timeout=0.01)
+        now = _t.monotonic()
+        mb._pending_total = 2
+        mb._groups["A"] = [
+            self._entry(now - 1.0, "dead", deadline=now - 0.5),
+            self._entry(now - 1.0, "live"),
+        ]
+        expired = []
+        batch = mb._take_batch_locked(expired)
+        assert [e["id"] for e in expired] == ["dead"]
+        assert [e["id"] for e in batch] == ["live"]
+        assert mb._pending_total == 0
 
 
 class TestDeployedBatching:

@@ -6,6 +6,7 @@ import pytest
 
 from kubeflow_tpu.testing.e2e import (
     engine_smoke,
+    fault_injection_smoke,
     serving_smoke,
     tpujob_smoke,
 )
@@ -68,6 +69,14 @@ class TestE2EDrivers:
         # requests through the HTTP surface against the in-process
         # continuous-batching engine, occupancy drains to zero.
         engine_smoke()
+
+    def test_fault_injection_smoke(self):
+        # The ci/e2e_config.yaml hermetic `faults` step: the seeded
+        # KFT_FAULTS chaos scenario — overload shed (429+Retry-After),
+        # mid-generation deadline expiry (504) with slot reuse, loader
+        # circuit-break with last-good serving, graceful drain, and
+        # kft_* metric visibility of every outcome.
+        fault_injection_smoke()
 
 
 class _FakeKubectl:
