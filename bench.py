@@ -14,6 +14,9 @@ the benchmarked loop):
                    reference never had.
   --model=serving  predict p50/p99 + micro-batcher throughput (the
                    reference published only a correctness golden).
+  --model=fleet    router-hop overhead vs direct single-replica p50 +
+                   delivered tok/s through the fleet router at 1 -> 3
+                   replicas.
   --model=data     KFTR input pipeline examples/sec, native vs python.
   --model=both     ResNet headline with the others nested in detail.
 
@@ -1276,6 +1279,245 @@ def bench_lm_engine(args, devices, n_chips, on_tpu):
     }
 
 
+def bench_fleet(args, devices, n_chips, on_tpu):
+    """Fleet router overhead + scale-out delivered throughput.
+
+    Two questions the fleet control plane must answer with numbers:
+
+      1. What does the router HOP cost?  Sequential closed-loop
+         requests against one replica, first direct, then through the
+         router (same replica, same process): the p50 delta is the
+         router tax (target: < 10% of direct-path latency — the
+         acceptance bound; the hop is one localhost round trip plus a
+         JSON deadline parse).
+      2. Does adding replicas add delivered tok/s?  The same
+         concurrent open-loop burst through the router at 1 and then 3
+         in-process replicas; delivered tokens/sec per fleet size and
+         the 3-vs-1 scaling ratio.  In-process replicas share the GIL
+         and the host's cores, so the hermetic CPU ratio UNDERSTATES
+         on-metal scaling — the number that matters there is that the
+         ratio exceeds 1 (the router actually spreads work); per-pod
+         replicas on real accelerators scale by device count.
+    """
+    import http.client
+    import json as _json
+    import tempfile
+    import threading
+
+    import jax
+    import numpy as np
+
+    from kubeflow_tpu.fleet.endpoints import (
+        Endpoint,
+        EndpointRegistry,
+        StaticEndpoints,
+    )
+    from kubeflow_tpu.fleet.router import FleetRouter, make_router_server
+    from kubeflow_tpu.models.transformer import Transformer
+    from kubeflow_tpu.serving.export import export
+    from kubeflow_tpu.serving.http import make_http_server
+    from kubeflow_tpu.serving.loaders import _model_config
+    from kubeflow_tpu.serving.main import batcher_factory
+    from kubeflow_tpu.serving.model_server import ModelServer
+
+    if on_tpu:
+        overrides = {
+            "vocab_size": 32_000, "d_model": 1024, "n_layers": 12,
+            "n_heads": 8, "n_kv_heads": 8, "d_ff": 2816,
+            "head_dim": 128, "max_seq_len": 2048, "dtype": "bfloat16",
+        }
+        max_new, prompt_len, slots = 64, 64, 8
+        seq_requests, burst_requests, clients = 24, 48, 8
+    else:
+        overrides = {
+            "vocab_size": 256, "d_model": 64, "n_layers": 2,
+            "n_heads": 4, "n_kv_heads": 4, "d_ff": 128, "head_dim": 16,
+            "max_seq_len": 128, "dtype": "float32",
+        }
+        max_new, prompt_len, slots = 32, 8, 4
+        seq_requests, burst_requests, clients = 16, 32, 8
+    print(f"bench: fleet router, d_model={overrides['d_model']} "
+          f"L{overrides['n_layers']}, {seq_requests} sequential + "
+          f"{burst_requests}-request bursts, "
+          f"{devices[0].device_kind}", file=sys.stderr)
+
+    cfg = _model_config(overrides)
+    model = Transformer(cfg)
+    rng = np.random.RandomState(0)
+    variables = model.init(jax.random.key(0),
+                           np.zeros((1, prompt_len), np.int32))
+    prompt = rng.randint(1, cfg.vocab_size,
+                         size=(prompt_len,)).tolist()
+    body = _json.dumps({"instances": [{"tokens": prompt}]}).encode()
+
+    def make_replica(base):
+        server = ModelServer()
+        server.add_model("lm", base)
+        server.enable_batching("lm", batcher_factory(
+            micro_batch_size=0, batch_timeout_s=0.005,
+            lm_engine=True, lm_engine_slots=slots,
+            lm_engine_prefill_len=prompt_len))
+        httpd, _ = make_http_server(server, port=0, host="127.0.0.1")
+        return server, httpd
+
+    class _Client:
+        """Keep-alive client (both measured paths pay identical
+        client-side costs; fresh-connection clients were measured to
+        dominate the sub-10ms signal this bench exists to read)."""
+
+        def __init__(self, port):
+            self._port = port
+            self._conn = None
+
+        def predict(self):
+            if self._conn is None:
+                self._conn = http.client.HTTPConnection(
+                    "127.0.0.1", self._port, timeout=600)
+            try:
+                self._conn.request("POST", "/model/lm:predict",
+                                   body=body)
+                resp = self._conn.getresponse()
+                payload = _json.loads(resp.read())
+                if resp.will_close:
+                    self.close()
+                return payload
+            except Exception:
+                self.close()
+                raise
+
+        def close(self):
+            if self._conn is not None:
+                self._conn.close()
+                self._conn = None
+
+    def predict(port):
+        client = _Client(port)
+        try:
+            return client.predict()
+        finally:
+            client.close()
+
+    def p50_of(port, n):
+        client = _Client(port)
+        lat = []
+        try:
+            client.predict()  # connection + route warm
+            for _ in range(n):
+                t0 = time.perf_counter()
+                out = client.predict()
+                lat.append(time.perf_counter() - t0)
+                assert len(out["predictions"][0]["tokens"]) \
+                    == prompt_len + max_new
+        finally:
+            client.close()
+        lat.sort()
+        return lat[len(lat) // 2]
+
+    def burst_tokps(port, n_requests, n_clients):
+        """Closed-loop client pool; delivered new tokens / wall."""
+        errors = []
+        done = []
+        lock = threading.Lock()
+        work = list(range(n_requests))
+
+        def client():
+            conn = _Client(port)
+            try:
+                while True:
+                    with lock:
+                        if not work:
+                            return
+                        work.pop()
+                    try:
+                        conn.predict()
+                        done.append(1)
+                    except Exception as exc:  # noqa: BLE001 — recorded
+                        errors.append(exc)
+            finally:
+                conn.close()
+
+        threads = [threading.Thread(target=client)
+                   for _ in range(n_clients)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        return len(done) * max_new / wall, len(errors)
+
+    replicas = []
+    router_httpd = None
+    registry = None
+    with tempfile.TemporaryDirectory() as tmp:
+        export(f"{tmp}/lm", 1, variables,
+               loader="kubeflow_tpu.serving.loaders:lm_generate",
+               config={"model": overrides, "max_new_tokens": max_new,
+                       "temperature": 0.0})
+        try:
+            replicas = [make_replica(f"{tmp}/lm") for _ in range(3)]
+            ports = [h.server_address[1] for _, h in replicas]
+            # Warm every engine (compile outside every timed window).
+            for port in ports:
+                predict(port)
+
+            # -- 1. router hop tax on one replica ---------------------
+            direct_p50 = p50_of(ports[0], seq_requests)
+            single = StaticEndpoints([Endpoint(
+                name="r0", url=f"http://127.0.0.1:{ports[0]}")])
+            registry = EndpointRegistry(single, probe_interval_s=0.5)
+            registry.refresh()
+            router = FleetRouter(registry, max_tries=3,
+                                 try_timeout_s=600.0)
+            router_httpd, _ = make_router_server(
+                router, port=0, host="127.0.0.1")
+            rport = router_httpd.server_address[1]
+            router_p50 = p50_of(rport, seq_requests)
+            overhead = (router_p50 - direct_p50) / direct_p50
+
+            # -- 2. delivered tok/s at 1 -> 3 replicas ----------------
+            tokps_1, err_1 = burst_tokps(rport, burst_requests,
+                                         clients)
+            fleet = StaticEndpoints([
+                Endpoint(name=f"r{i}", url=f"http://127.0.0.1:{p}")
+                for i, p in enumerate(ports)])
+            registry.set_source(fleet)
+            registry.refresh()
+            tokps_3, err_3 = burst_tokps(rport, burst_requests,
+                                         clients)
+        finally:
+            if router_httpd is not None:
+                router_httpd.shutdown()
+            for srv, httpd in replicas:
+                httpd.shutdown()
+                httpd.server_close()
+                srv.stop()
+
+    ratio = tokps_3 / tokps_1 if tokps_1 else 0.0
+    return {
+        "metric": "fleet_delivered_tokens_per_sec",
+        "value": round(tokps_3, 1),
+        "unit": "tok/s @ 3 replicas (router path)",
+        "vs_baseline": round(ratio, 3),
+        "detail": {
+            "device": devices[0].device_kind,
+            "direct_p50_ms": round(direct_p50 * 1e3, 2),
+            "router_p50_ms": round(router_p50 * 1e3, 2),
+            "router_overhead_frac": round(overhead, 4),
+            "router_overhead_target": "< 0.10 of direct p50",
+            "delivered_tokps_1_replica": round(tokps_1, 1),
+            "delivered_tokps_3_replicas": round(tokps_3, 1),
+            "scaling_ratio_3v1": round(ratio, 3),
+            "failed_requests": err_1 + err_3,
+            "requests_per_burst": burst_requests,
+            "clients": clients,
+            "max_new_tokens": max_new,
+            "note": "in-process replicas share the GIL/cores: the "
+                    "hermetic ratio understates per-pod scaling",
+        },
+    }
+
+
 def bench_data(args, devices, n_chips, on_tpu):
     """KFTR input pipeline throughput: the default path vs the python
     decode/stack loop, at two record sizes.
@@ -1367,7 +1609,7 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--model",
                     choices=["resnet", "lm", "serving", "lm-decode",
-                             "lm-engine", "data", "both"],
+                             "lm-engine", "fleet", "data", "both"],
                     default="both",
                     help="'both' = ResNet headline (the reference's own "
                          "benchmark) with the LM suite nested in detail")
@@ -1472,6 +1714,8 @@ def main() -> None:
         result = bench_lm_decode(args, devices, n_chips, on_tpu)
     elif args.model == "lm-engine":
         result = bench_lm_engine(args, devices, n_chips, on_tpu)
+    elif args.model == "fleet":
+        result = bench_fleet(args, devices, n_chips, on_tpu)
     elif args.model == "data":
         result = bench_data(args, devices, n_chips, on_tpu)
     else:

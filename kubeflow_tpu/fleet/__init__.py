@@ -1,0 +1,21 @@
+"""Serving fleet control plane: the layer BETWEEN model-server replicas.
+
+PR 1 made one replica fast (continuous-batching DecodeEngine) and PR 2
+made it fail well (deadlines, admission control, drain).  This package
+adds what a fleet of such replicas needs to serve real traffic:
+
+  endpoints.py   replica discovery (static lists or label-selected pods
+                 through the kube client) + /readyz-driven readiness and
+                 outlier ejection state
+  router.py      load-aware HTTP reverse proxy: power-of-two-choices on
+                 scraped in-flight depth, deadline and Retry-After
+                 propagation, budgeted cross-replica retries, drain
+                 awareness
+  autoscaler.py  level-triggered control loop scaling the serving
+                 Deployment from scraped kft_serving_* load gauges
+  main.py        the router/autoscaler container entrypoint
+
+Everything is stdlib + the existing serving/operator surfaces; the
+whole plane runs hermetically against in-process replicas and
+testing/fake_apiserver.py (the `fleet` e2e scenario).
+"""

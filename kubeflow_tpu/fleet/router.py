@@ -1,0 +1,502 @@
+"""Load-aware HTTP router for a fleet of model-server replicas.
+
+A reverse proxy for the serving REST contract (serving/http.py routes:
+predict/classify/stats/metadata) with the behaviors a fleet needs that
+a dumb round-robin LB lacks:
+
+  balancing   power-of-two-choices: pick two random routable replicas,
+              send to the lower-scored one (scraped in-flight + queue
+              depth + router-local outstanding).  P2C gets most of the
+              benefit of full least-loaded without herding every router
+              onto one stale-scrape "winner".
+  deadlines   a request's ``deadline_ms`` becomes an absolute policy-
+              clock budget at arrival; each forwarded attempt carries
+              the REMAINING budget (rewritten ``deadline_ms``) and a
+              matching socket timeout, and an expired budget answers
+              504 without burning a replica slot.
+  retries     a bounded retry budget (token bucket refilled by a
+              fraction of admitted requests) retries on a DIFFERENT
+              replica — but only work that provably did not execute:
+              429 Overloaded sheds (the replica refused it) and
+              connection-refused failures (nothing was sent).  A POST
+              whose bytes reached a replica is NEVER replayed — predict
+              with sampling is not idempotent — while GETs (stats/
+              metadata) retry on any transport failure.
+  Retry-After when every candidate shed, the router answers 429 with
+              the SMALLEST Retry-After observed — the earliest instant
+              any replica predicted it would have room.
+  ejection    request failures feed the registry's per-endpoint breaker
+              (consecutive failures -> jittered-backoff ejection with
+              half-open probe recovery, see fleet/endpoints.py), so a
+              dead replica leaves rotation within one probe interval.
+  drain       a draining replica (/readyz 503 "draining") receives no
+              new work but keeps its in-flight — rolling restarts lose
+              zero accepted requests.
+
+Metrics: kft_router_requests_total{outcome,code},
+kft_router_retries_total{reason}, kft_router_retry_budget_exhausted_
+total, kft_router_request_seconds, plus the registry's endpoint-state
+gauges and ejection counters.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import logging
+import random
+import threading
+import time
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Tuple
+
+from kubeflow_tpu.fleet.endpoints import EndpointRegistry, EndpointState
+from kubeflow_tpu.runtime.prom import REGISTRY
+from kubeflow_tpu.testing import faults
+
+log = logging.getLogger(__name__)
+
+REQUESTS_TOTAL = "kft_router_requests_total"
+REQUESTS_HELP = "router requests by outcome and upstream status code"
+RETRIES_TOTAL = "kft_router_retries_total"
+RETRIES_HELP = "cross-replica retries by reason"
+BUDGET_EXHAUSTED_TOTAL = "kft_router_retry_budget_exhausted_total"
+BUDGET_EXHAUSTED_HELP = "retries skipped because the budget was empty"
+LATENCY_SECONDS = "kft_router_request_seconds"
+LATENCY_HELP = "router end-to-end request latency"
+
+# Proxied routes: everything under /model/... plus the replicas' own
+# health surface is ROUTED; the router's own health/metrics live on
+# distinct paths so a fleet of routers is itself probeable.
+_HOP_HEADERS = {"connection", "keep-alive", "transfer-encoding",
+                "te", "trailer", "upgrade", "proxy-authorization",
+                "proxy-authenticate", "host", "content-length"}
+
+
+class _UpstreamPool:
+    """Keep-alive connection pool, one stack per replica URL.
+
+    A fresh TCP connect plus a new handler thread on the replica costs
+    ~3.5 ms p50 on loopback (measured) — pure hop tax on every proxied
+    request.  Persistent HTTP/1.1 connections amortize both; the pool
+    is bounded per endpoint and a connection is only returned after a
+    complete, non-close response."""
+
+    def __init__(self, per_endpoint: int = 16):
+        self._lock = threading.Lock()
+        self._conns: Dict[str, List[http.client.HTTPConnection]] = {}
+        self._per_endpoint = per_endpoint
+
+    def get(self, url: str) -> Optional[http.client.HTTPConnection]:
+        with self._lock:
+            stack = self._conns.get(url)
+            return stack.pop() if stack else None
+
+    def put(self, url: str, conn: http.client.HTTPConnection) -> None:
+        with self._lock:
+            stack = self._conns.setdefault(url, [])
+            if len(stack) < self._per_endpoint:
+                stack.append(conn)
+                return
+        conn.close()
+
+    def close_endpoint(self, url: str) -> None:
+        """Drop every pooled connection to one replica (called on
+        ejection: a conn pooled before a crash is guaranteed stale)."""
+        with self._lock:
+            stack = self._conns.pop(url, [])
+        for conn in stack:
+            conn.close()
+
+    def close(self) -> None:
+        with self._lock:
+            conns = [c for stack in self._conns.values()
+                     for c in stack]
+            self._conns.clear()
+        for conn in conns:
+            conn.close()
+
+
+class _RetryBudget:
+    """Token bucket: every admitted request deposits ``ratio`` tokens
+    (capped), every retry withdraws one — so retries are bounded to a
+    fraction of live traffic and a brown-out cannot double itself
+    through retry amplification."""
+
+    def __init__(self, ratio: float = 0.2, cap: float = 10.0,
+                 initial: Optional[float] = None):
+        self._lock = threading.Lock()
+        self._ratio = ratio
+        self._cap = cap
+        self._tokens = cap if initial is None else initial
+
+    def deposit(self) -> None:
+        with self._lock:
+            self._tokens = min(self._cap, self._tokens + self._ratio)
+
+    def withdraw(self) -> bool:
+        with self._lock:
+            if self._tokens >= 1.0:
+                self._tokens -= 1.0
+                return True
+            return False
+
+
+class FleetRouter:
+    """Routing core, transport-independent (the HTTP handler and the
+    tests both drive handle())."""
+
+    def __init__(self, registry: EndpointRegistry, *,
+                 max_tries: int = 3,
+                 try_timeout_s: float = 120.0,
+                 retry_budget_ratio: float = 0.2,
+                 retry_budget_cap: float = 10.0,
+                 rng: Optional[random.Random] = None):
+        self.registry = registry
+        self.max_tries = max(1, int(max_tries))
+        self.try_timeout_s = try_timeout_s
+        self.budget = _RetryBudget(retry_budget_ratio, retry_budget_cap)
+        self._pool = _UpstreamPool()
+        # Probe-driven ejections must purge the pool too: connections
+        # pooled before a crash are guaranteed stale, and handing one
+        # to the replica's first post-recovery request would turn a
+        # never-executed POST into a non-retryable 502.
+        registry.on_eject = \
+            lambda state: self._pool.close_endpoint(state.endpoint.url)
+        self._rng = rng or random.Random()
+        self._draining = threading.Event()
+        self._requests = REGISTRY.counter(REQUESTS_TOTAL, REQUESTS_HELP)
+        self._retries = REGISTRY.counter(RETRIES_TOTAL, RETRIES_HELP)
+        self._exhausted = REGISTRY.counter(BUDGET_EXHAUSTED_TOTAL,
+                                           BUDGET_EXHAUSTED_HELP)
+        self._latency = REGISTRY.histogram(LATENCY_SECONDS, LATENCY_HELP)
+
+    # -- balancing ---------------------------------------------------------
+
+    def pick(self, exclude: Tuple[str, ...] = ()) -> \
+            Optional[EndpointState]:
+        """Power-of-two-choices among routable endpoints not already
+        tried this request: two uniform draws, lower load score wins
+        (one candidate short-circuits; zero returns None)."""
+        candidates = [s for s in self.registry.routable()
+                      if s.name not in exclude]
+        if not candidates:
+            return None
+        if len(candidates) == 1:
+            return candidates[0]
+        a, b = self._rng.sample(candidates, 2)
+        return a if a.score() <= b.score() else b
+
+    # -- request handling --------------------------------------------------
+
+    def handle(self, method: str, path: str, body: bytes,
+               headers: Dict[str, str]) -> Tuple[int, Dict[str, str],
+                                                 bytes]:
+        """Proxy one request; returns (status, headers, body).
+
+        The response is whatever the chosen replica answered (verbatim,
+        minus hop-by-hop headers) or a router-synthesized 429/502/503/
+        504 when no replica could take the request."""
+        t0 = time.perf_counter()
+        status, out_headers, out_body, outcome = self._route(
+            method, path, body, headers)
+        self._requests.inc(outcome=outcome, code=str(status))
+        self._latency.observe(time.perf_counter() - t0)
+        return status, out_headers, out_body
+
+    def _route(self, method, path, body, headers):
+        self.budget.deposit()
+        deadline, body = self._extract_deadline(method, path, body)
+        tried: List[str] = []
+        retry_after_hints: List[float] = []
+        last_error = "no endpoints"
+        idempotent = method == "GET"
+        for _ in range(self.max_tries):
+            if deadline is not None \
+                    and faults.monotonic() >= deadline:
+                return 504, {}, _jerr("deadline expired in router"), \
+                    "deadline_exceeded"
+            state = self.pick(exclude=tuple(tried))
+            if state is None:
+                break
+            tried.append(state.name)
+            verdict = self._forward_once(state, method, path, body,
+                                         headers, deadline)
+            kind = verdict[0]
+            if kind == "response":
+                _, status, resp_headers, resp_body = verdict
+                if status == 429:
+                    hint = _parse_retry_after(resp_headers)
+                    if hint is not None:
+                        retry_after_hints.append(hint)
+                    last_error = "overloaded"
+                    if self._grant_retry("overloaded"):
+                        continue
+                    break
+                outcome = "ok" if status < 500 else "upstream_error"
+                return status, resp_headers, resp_body, outcome
+            # kind == "connect" (nothing sent) or "transport" (bytes
+            # were sent; only idempotent work may be replayed).
+            last_error = verdict[1]
+            if kind == "connect" or (kind == "transport" and idempotent):
+                if self._grant_retry(kind):
+                    continue
+            break
+        if last_error == "overloaded":
+            hint = min(retry_after_hints) if retry_after_hints else 1.0
+            return 429, {"Retry-After": f"{max(1, round(hint))}"}, \
+                _jerr("all replicas overloaded"), "shed"
+        if last_error == "no endpoints":
+            return 503, {}, _jerr("no routable replicas"), \
+                "no_endpoints"
+        return 502, {}, _jerr(f"upstream failed: {last_error}"), \
+            "upstream_error"
+
+    def _grant_retry(self, reason: str) -> bool:
+        if not self.budget.withdraw():
+            self._exhausted.inc()
+            return False
+        self._retries.inc(reason=reason)
+        return True
+
+    def _forward_once(self, state: EndpointState, method, path, body,
+                      headers, deadline):
+        """One attempt against one replica over a pooled keep-alive
+        connection.  Returns a verdict tuple: ("response", status,
+        headers, body) when the replica answered, ("connect", detail)
+        when the request provably never executed (retry-safe for any
+        method), or ("transport", detail) when bytes may have been
+        processed (failure semantics: non-idempotent work must not be
+        replayed).
+
+        A reused keep-alive connection that dies before any response
+        bytes is classified "transport", NOT "connect": RFC 7230
+        §6.3.1 would permit treating it as a close race, but the same
+        signature is produced by a replica crashing MID-GENERATION on
+        our request, and the never-replay guarantee for non-idempotent
+        work is absolute — so only GETs (which _route retries on any
+        transport failure) benefit from the ambiguity."""
+        send_body = body
+        timeout = self.try_timeout_s
+        if deadline is not None:
+            remaining = deadline - faults.monotonic()
+            if remaining <= 0:
+                return "connect", "deadline expired"
+            timeout = min(timeout, remaining)
+            if method == "POST" and body:
+                send_body = _rewrite_deadline(body, remaining)
+        url = state.endpoint.url
+        conn = self._pool.get(url)
+        reused = conn is not None
+        if conn is None:
+            parsed = urllib.parse.urlsplit(url)
+            conn = http.client.HTTPConnection(
+                parsed.hostname, parsed.port, timeout=timeout)
+        if conn.sock is not None:
+            conn.sock.settimeout(timeout)
+        fwd_headers = {k: v for k, v in headers.items()
+                       if k.lower() not in _HOP_HEADERS}
+        state.enter()
+        try:
+            # Chaos hook: scripted connection failures land before the
+            # socket, exactly like kube.request's.
+            faults.fire("router.forward")
+            conn.request(method, path, body=send_body or None,
+                         headers=fwd_headers)
+            resp = conn.getresponse()
+            payload = resp.read()
+            resp_headers = _copy_headers(resp.headers)
+            if resp.will_close:
+                conn.close()
+            else:
+                self._pool.put(url, conn)
+            # An HTTP status is an ANSWER — the replica is alive.  429
+            # is a healthy replica protecting itself; 5xx counts
+            # against the breaker (the replica is failing requests).
+            if resp.status >= 500:
+                self._note_failure(state)
+            else:
+                state.note_success()
+            return "response", resp.status, resp_headers, payload
+        except (ConnectionRefusedError, faults.FaultInjected) as e:
+            conn.close()
+            self._note_failure(state)
+            return "connect", f"{state.name}: {e}"
+        except (http.client.RemoteDisconnected, ConnectionResetError,
+                BrokenPipeError) as e:
+            conn.close()
+            self._note_failure(state)
+            detail = "reused conn" if reused else "fresh conn"
+            return "transport", \
+                f"{state.name} ({detail}): {type(e).__name__}: {e}"
+        except (http.client.HTTPException, ConnectionError,
+                TimeoutError, OSError) as e:
+            conn.close()
+            self._note_failure(state)
+            return "transport", f"{state.name}: {e}"
+        finally:
+            state.exit()
+
+    def _note_failure(self, state: EndpointState) -> None:
+        if state.note_failure():
+            # Ejected: every pooled connection predates the failure
+            # streak and is guaranteed stale.
+            self._pool.close_endpoint(state.endpoint.url)
+
+    def close(self) -> None:
+        self._pool.close()
+
+    @staticmethod
+    def _extract_deadline(method, path, body):
+        """Pull ``deadline_ms`` out of a predict/classify POST body and
+        convert to an absolute policy-clock instant.  Returns
+        (deadline_or_None, body) — the body is returned untouched (the
+        per-attempt rewrite happens at forward time with the budget
+        remaining THEN)."""
+        if method != "POST" or not body or b"deadline_ms" not in body:
+            return None, body
+        try:
+            deadline_ms = json.loads(body).get("deadline_ms")
+            deadline_ms = float(deadline_ms)
+        except (ValueError, TypeError):
+            return None, body
+        if deadline_ms <= 0:
+            return None, body
+        return faults.monotonic() + deadline_ms / 1e3, body
+
+    # -- router health -----------------------------------------------------
+
+    def begin_drain(self) -> None:
+        self._draining.set()
+
+    def is_ready(self) -> bool:
+        return not self._draining.is_set() \
+            and bool(self.registry.routable())
+
+    def draining(self) -> bool:
+        return self._draining.is_set()
+
+
+def _jerr(message: str) -> bytes:
+    return json.dumps({"error": message}).encode()
+
+
+def _copy_headers(headers) -> Dict[str, str]:
+    out = {}
+    for key in ("Content-Type", "Retry-After"):
+        value = headers.get(key)
+        if value is not None:
+            out[key] = value
+    return out
+
+
+def _parse_retry_after(headers: Dict[str, str]) -> Optional[float]:
+    try:
+        return float(headers.get("Retry-After", ""))
+    except (TypeError, ValueError):
+        return None
+
+
+def _rewrite_deadline(body: bytes, remaining_s: float) -> bytes:
+    """Propagate the REMAINING budget to the replica: a retried request
+    must not restart its deadline from scratch, and the replica's own
+    queue sweep needs the true time left."""
+    try:
+        payload = json.loads(body)
+    except ValueError:
+        return body
+    if not isinstance(payload, dict):
+        return body
+    payload["deadline_ms"] = max(1.0, remaining_s * 1e3)
+    return json.dumps(payload).encode()
+
+
+class _Handler(BaseHTTPRequestHandler):
+    router: FleetRouter  # bound by make_router_server
+
+    # Client-side keep-alive (every response carries Content-Length);
+    # the upstream side pools its own persistent connections.
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):
+        log.debug("router: " + fmt, *args)
+
+    def _respond(self, status: int, headers: Dict[str, str],
+                 body: bytes) -> None:
+        self.send_response(status)
+        if "Content-Type" not in headers:
+            headers = dict(headers, **{
+                "Content-Type": "application/json"})
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in headers.items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _drain_body(self) -> None:
+        """Read and discard an un-proxied request's body: with
+        keep-alive an unread body desyncs the client connection."""
+        length = int(self.headers.get("Content-Length", 0))
+        if length:
+            self.rfile.read(length)
+
+    def _dispatch(self, method: str) -> None:
+        router = self.router
+        if self.path in ("/healthz", "/readyz", "/metrics",
+                         "/fleet/endpoints"):
+            self._drain_body()
+        if self.path == "/healthz":
+            self._respond(200, {}, json.dumps(
+                {"status": "ok", "role": "router"}).encode())
+            return
+        if self.path == "/readyz":
+            if router.is_ready():
+                self._respond(200, {}, json.dumps(
+                    {"status": "ready",
+                     "replicas": len(router.registry.routable())}
+                ).encode())
+            else:
+                self._respond(503, {}, json.dumps(
+                    {"status": "draining" if router.draining()
+                     else "no routable replicas"}).encode())
+            return
+        if self.path == "/metrics":
+            data = REGISTRY.render().encode()
+            self._respond(200, {"Content-Type":
+                                "text/plain; version=0.0.4"}, data)
+            return
+        if self.path == "/fleet/endpoints":
+            self._respond(200, {}, json.dumps(
+                router.registry.describe()).encode())
+            return
+        length = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(length) if length else b""
+        try:
+            status, headers, payload = router.handle(
+                method, self.path, body, dict(self.headers.items()))
+        except Exception as e:  # noqa: BLE001 — the proxy must not die
+            log.exception("router handler error")
+            status, headers, payload = 500, {}, _jerr(
+                f"{type(e).__name__}: {e}")
+        self._respond(status, headers, payload)
+
+    def do_GET(self):
+        self._dispatch("GET")
+
+    def do_POST(self):
+        self._dispatch("POST")
+
+
+def make_router_server(
+    router: FleetRouter, port: int = 8080, host: str = "0.0.0.0",
+) -> Tuple[ThreadingHTTPServer, threading.Thread]:
+    """Start the router's HTTP front on a daemon thread; returns
+    (httpd, thread)."""
+    handler = type("BoundRouterHandler", (_Handler,),
+                   {"router": router})
+    httpd = ThreadingHTTPServer((host, port), handler)
+    thread = threading.Thread(target=httpd.serve_forever, daemon=True,
+                              name="fleet-router-http")
+    thread.start()
+    return httpd, thread

@@ -191,6 +191,97 @@ def _generate_serving(component_name: str, **p: Any) -> List[dict]:
     return objs
 
 
+ROUTER_PORT = 8080
+
+
+def _generate_fleet(component_name: str, **p: Any) -> List[dict]:
+    """Fleet router Deployment + Service in front of a tpu-serving
+    component: kube pod discovery by the serving component's labels,
+    power-of-two-choices routing, and (optionally) the metrics-driven
+    autoscaler patching the serving Deployment's replica count
+    (fleet/main.py)."""
+    namespace = p["namespace"]
+    name = component_name
+    labels = {"app": name, "kubeflow-tpu.org/component": "fleet-router"}
+    args = [
+        f"--port={ROUTER_PORT}",
+        f"--kube_namespace={namespace}",
+        f"--kube_selector=app={p['serving_name']}",
+        f"--replica_port={SERVE_PORT}",
+        f"--max_tries={p['max_tries']}",
+        f"--probe_interval_s={p['probe_interval_s']}",
+    ]
+    if p["autoscale"]:
+        args += [
+            f"--autoscale_deployment={p['serving_name']}",
+            f"--autoscale_target_inflight={p['target_inflight']}",
+            f"--min_replicas={p['min_replicas']}",
+            f"--max_replicas={p['max_replicas']}",
+        ]
+    container = {
+        "name": name,
+        "image": p["router_image"],
+        "command": ["python", "-m", "kubeflow_tpu.fleet.main"],
+        "args": args,
+        "ports": [{"containerPort": ROUTER_PORT, "name": "http"}],
+        "readinessProbe": {
+            "httpGet": {"path": "/readyz", "port": ROUTER_PORT}},
+        "livenessProbe": {
+            "httpGet": {"path": "/healthz", "port": ROUTER_PORT}},
+        "resources": {"limits": {"cpu": "2", "memory": "1Gi"},
+                      "requests": {"cpu": "250m", "memory": "256Mi"}},
+    }
+    deploy = base.deployment(
+        name=name, namespace=namespace, labels=labels,
+        replicas=p["replicas"],
+        spec=base.pod_spec([container]),
+    )
+    scrape = {
+        "prometheus.io/scrape": "true",
+        "prometheus.io/port": str(ROUTER_PORT),
+        "prometheus.io/path": "/metrics",
+    }
+    deploy["spec"]["template"]["metadata"]["annotations"] = dict(scrape)
+    svc = base.service(
+        name=name, namespace=namespace, selector=labels,
+        ports=[base.port(ROUTER_PORT, "http")],
+        annotations=dict(scrape), labels=labels,
+    )
+    return [deploy, svc]
+
+
+fleet_prototype = default_registry.register(Prototype(
+    name="tpu-serving-fleet",
+    doc="Fleet control plane for tpu-serving: load-aware router "
+        "(P2C on scraped in-flight, retries, ejection, drain "
+        "awareness) + metrics-driven replica autoscaler",
+    params=[
+        param("namespace", str, "kubeflow", "target namespace"),
+        param("serving_name", str, "tpu-serving",
+              "the tpu-serving component to front (pod label app= "
+              "selector AND the Deployment the autoscaler patches)"),
+        param("router_image", str,
+              "ghcr.io/kubeflow-tpu/model-server:latest",
+              "router container image (same image as the server; the "
+              "entrypoint differs)"),
+        param("replicas", int, 2, "router replicas"),
+        param("max_tries", int, 3,
+              "distinct replicas one request may be offered to"),
+        param("probe_interval_s", float, 1.0,
+              "readiness-probe/load-scrape period"),
+        param("autoscale", bool, True,
+              "run the replica autoscaler inside the router"),
+        param("target_inflight", float, 4.0,
+              "per-replica in-flight target the desired count is "
+              "computed from — float-typed so a bad value fails at "
+              "generation, not as a crash-looping router pod"),
+        param("min_replicas", int, 1, "autoscaler floor"),
+        param("max_replicas", int, 8, "autoscaler ceiling"),
+    ],
+    generate=_generate_fleet,
+))
+
+
 serving_prototype = default_registry.register(Prototype(
     name="tpu-serving",
     doc="TPU model server (heir of kubeflow/tf-serving): versioned "

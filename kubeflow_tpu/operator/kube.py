@@ -49,6 +49,7 @@ class FakeKube:
         self.pods: Dict[Tuple[str, str], ObjectDict] = {}
         self.services: Dict[Tuple[str, str], ObjectDict] = {}
         self.custom: Dict[Tuple[str, str], ObjectDict] = {}
+        self.deployments: Dict[Tuple[str, str], ObjectDict] = {}
         self.events: List[ObjectDict] = []
         self.deleted_pods: List[str] = []
         self.nodes: List[ObjectDict] = []
@@ -115,6 +116,64 @@ class FakeKube:
     def delete_service(self, namespace: str, name: str) -> None:
         with self._lock:
             self.services.pop(_key(namespace, name), None)
+
+    # -- deployments ------------------------------------------------------
+    # apps/v1 slice for the fleet autoscaler: it scales a serving
+    # Deployment by patching spec.replicas (fleet/autoscaler.py), so the
+    # fake cluster needs just create/get/scale — status.replicas tracks
+    # the spec (the fake has no deployment controller; tests flip
+    # readiness themselves where they need a lag).
+
+    def create_deployment(self, dep: ObjectDict) -> ObjectDict:
+        with self._lock:
+            key = _key(dep["metadata"]["namespace"],
+                       dep["metadata"]["name"])
+            if key in self.deployments:
+                raise Conflict(f"deployment {key} exists")
+            dep = copy.deepcopy(dep)
+            replicas = dep.get("spec", {}).get("replicas", 1)
+            dep.setdefault("status", {})["replicas"] = replicas
+            self.deployments[key] = dep
+            return copy.deepcopy(dep)
+
+    def get_deployment(self, namespace: str, name: str) -> ObjectDict:
+        with self._lock:
+            try:
+                return copy.deepcopy(
+                    self.deployments[_key(namespace, name)])
+            except KeyError:
+                raise NotFound(
+                    f"deployment {namespace}/{name}") from None
+
+    def list_deployments(
+            self, namespace: str,
+            labels: Optional[Dict[str, str]] = None) -> List[ObjectDict]:
+        with self._lock:
+            out = []
+            for (ns, _), dep in self.deployments.items():
+                if ns != namespace:
+                    continue
+                dep_labels = dep["metadata"].get("labels", {})
+                if labels and any(dep_labels.get(k) != v
+                                  for k, v in labels.items()):
+                    continue
+                out.append(copy.deepcopy(dep))
+            return out
+
+    def patch_deployment_scale(self, namespace: str, name: str,
+                               replicas: int) -> ObjectDict:
+        """Set spec.replicas — the autoscaler's one write verb.
+        Idempotent (PATCH semantics): re-applying the same count is a
+        no-op, which is what lets the level-triggered reconcile loop
+        repeat itself safely."""
+        with self._lock:
+            key = _key(namespace, name)
+            if key not in self.deployments:
+                raise NotFound(f"deployment {namespace}/{name}")
+            dep = self.deployments[key]
+            dep.setdefault("spec", {})["replicas"] = int(replicas)
+            dep.setdefault("status", {})["replicas"] = int(replicas)
+            return copy.deepcopy(dep)
 
     # -- custom resources -------------------------------------------------
 

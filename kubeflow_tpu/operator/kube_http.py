@@ -36,6 +36,19 @@ from kubeflow_tpu.testing import faults
 SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
 
 
+def _retry_after(headers) -> Optional[float]:
+    """Parse a Retry-After header into seconds (None when absent or in
+    the HTTP-date form — the delta-seconds form is what the serving
+    stack and the apiserver emit)."""
+    value = headers.get("Retry-After") if headers is not None else None
+    if value is None:
+        return None
+    try:
+        return float(value)
+    except (TypeError, ValueError):
+        return None
+
+
 class HttpKube:
     """Reconciler kube backend over the raw Kubernetes REST API.
 
@@ -129,10 +142,17 @@ class HttpKube:
                     raise NotFound(f"{method} {path}: {detail}") from None
                 if e.code == 409:
                     raise Conflict(f"{method} {path}: {detail}") from None
-                if e.code >= 500 and attempt < retries:
-                    self._backoff(attempt)
-                    attempt += 1
-                    continue
+                # 429 is weather too (apiserver flow control), and like
+                # 5xx it may carry the server's own backoff hint — a
+                # Retry-After header overrides the local jittered
+                # schedule (capped): the server knows when it will have
+                # room, the client's exponential guess does not.
+                if e.code in (429,) or e.code >= 500:
+                    if attempt < retries:
+                        self._backoff(attempt,
+                                      hint_s=_retry_after(e.headers))
+                        attempt += 1
+                        continue
                 raise RuntimeError(
                     f"{method} {path} -> {e.code}: {detail}") from None
             except (urllib.error.URLError, ConnectionError,
@@ -146,7 +166,16 @@ class HttpKube:
                     f"{attempt + 1} attempts: {e}") from e
         return json.loads(payload) if payload else {}
 
-    def _backoff(self, attempt: int) -> None:
+    def _backoff(self, attempt: int,
+                 hint_s: Optional[float] = None) -> None:
+        if hint_s is not None:
+            # Server-supplied hint wins over the local schedule; still
+            # capped (a hostile/confused server must not park the
+            # reconciler) and lightly jittered so a herd told the same
+            # number does not return in phase.
+            delay = min(self._retry_backoff_cap_s, max(0.0, hint_s))
+            time.sleep(delay * (1.0 + 0.1 * random.random()))
+            return
         delay = min(self._retry_backoff_cap_s,
                     self._retry_backoff_s * (2 ** attempt))
         # Full jitter: concurrent reconcilers must not retry in phase.
@@ -197,6 +226,37 @@ class HttpKube:
                 f"/api/v1/namespaces/{namespace}/services/{name}")
         except NotFound:
             pass  # FakeKube semantics: service delete is idempotent
+
+    # -- deployments ------------------------------------------------------
+
+    def create_deployment(self, dep: ObjectDict) -> ObjectDict:
+        ns = dep["metadata"]["namespace"]
+        return self._request(
+            "POST", f"/apis/apps/v1/namespaces/{ns}/deployments", dep)
+
+    def get_deployment(self, namespace: str, name: str) -> ObjectDict:
+        return self._request(
+            "GET",
+            f"/apis/apps/v1/namespaces/{namespace}/deployments/{name}")
+
+    def list_deployments(
+            self, namespace: str,
+            labels: Optional[Dict[str, str]] = None) -> List[ObjectDict]:
+        out = self._request(
+            "GET", f"/apis/apps/v1/namespaces/{namespace}/deployments",
+            params=self._selector(labels))
+        return out.get("items", [])
+
+    def patch_deployment_scale(self, namespace: str, name: str,
+                               replicas: int) -> ObjectDict:
+        """The autoscaler's one write verb: merge-patch spec.replicas.
+        PATCH is idempotent, so it rides the transient-retry policy —
+        replaying a lost scale-to-N lands on N either way."""
+        return self._request(
+            "PATCH",
+            f"/apis/apps/v1/namespaces/{namespace}/deployments/{name}",
+            {"spec": {"replicas": int(replicas)}},
+            content_type="application/merge-patch+json")
 
     # -- custom resources -------------------------------------------------
 

@@ -24,6 +24,7 @@ class RealKube:
         except Exception:
             kubernetes.config.load_kube_config(config_file=kubeconfig)
         self._core = kubernetes.client.CoreV1Api()
+        self._apps = kubernetes.client.AppsV1Api()
         self._custom = kubernetes.client.CustomObjectsApi()
         self._api_exc = kubernetes.client.rest.ApiException
 
@@ -73,6 +74,33 @@ class RealKube:
 
     def delete_service(self, namespace: str, name: str) -> None:
         self._wrap(self._core.delete_namespaced_service, name, namespace)
+
+    # -- deployments ------------------------------------------------------
+
+    def create_deployment(self, dep: ObjectDict) -> ObjectDict:
+        return self._wrap(self._apps.create_namespaced_deployment,
+                          dep["metadata"]["namespace"], dep)
+
+    def get_deployment(self, namespace: str, name: str) -> ObjectDict:
+        out = self._wrap(self._apps.read_namespaced_deployment,
+                         name, namespace)
+        return self._core.api_client.sanitize_for_serialization(out)
+
+    def list_deployments(
+            self, namespace: str,
+            labels: Optional[Dict[str, str]] = None) -> List[ObjectDict]:
+        selector = ",".join(f"{k}={v}" for k, v in (labels or {}).items())
+        out = self._wrap(self._apps.list_namespaced_deployment,
+                         namespace, label_selector=selector or None)
+        return [self._core.api_client.sanitize_for_serialization(d)
+                for d in out.items]
+
+    def patch_deployment_scale(self, namespace: str, name: str,
+                               replicas: int) -> ObjectDict:
+        out = self._wrap(self._apps.patch_namespaced_deployment,
+                         name, namespace,
+                         {"spec": {"replicas": int(replicas)}})
+        return self._core.api_client.sanitize_for_serialization(out)
 
     # -- custom resources -------------------------------------------------
 

@@ -17,6 +17,7 @@ Usage:
 
 from __future__ import annotations
 
+import re
 import threading
 from typing import Dict, List, Optional, Tuple
 
@@ -205,6 +206,60 @@ class Registry:
 
 
 REGISTRY = Registry()
+
+_SAMPLE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>[^}]*)\})?\s+(?P<value>\S+)$")
+_LABEL = re.compile(r'(\w+)="((?:[^"\\]|\\.)*)"')
+_UNESCAPE = re.compile(r"\\(.)")
+_ESCAPES = {"n": "\n", "\\": "\\", '"': '"'}
+
+
+def _unescape(value: str) -> str:
+    """Single-pass inverse of _escape: sequential str.replace passes
+    would re-scan their own output (r'\\\\n' — a literal backslash
+    then 'n' — must NOT become backslash+newline)."""
+    return _UNESCAPE.sub(
+        lambda m: _ESCAPES.get(m.group(1), m.group(0)), value)
+
+
+def parse_metrics(text: str) -> Dict[str, List[Tuple[Dict[str, str],
+                                                     float]]]:
+    """Parse exposition-format text back into samples.
+
+    Returns ``{metric_name: [(labels, value), ...]}``.  The inverse of
+    ``Registry.render`` for the three line shapes this module emits —
+    what the fleet autoscaler uses to read ``kft_serving_*`` gauges off
+    replica ``/metrics`` scrapes without a prometheus client dependency.
+    Unparseable lines are skipped (a half-written scrape must degrade,
+    not crash the control loop)."""
+    out: Dict[str, List[Tuple[Dict[str, str], float]]] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        m = _SAMPLE.match(line)
+        if not m:
+            continue
+        try:
+            value = float(m["value"])
+        except ValueError:
+            continue
+        labels = {k: _unescape(v)
+                  for k, v in _LABEL.findall(m["labels"] or "")}
+        out.setdefault(m["name"], []).append((labels, value))
+    return out
+
+
+def sample_value(parsed: Dict[str, List[Tuple[Dict[str, str], float]]],
+                 name: str, **labels: str) -> Optional[float]:
+    """First sample of ``name`` whose labels are a superset of
+    ``labels`` (None when the series is absent)."""
+    for sample_labels, value in parsed.get(name, ()):
+        if all(sample_labels.get(k) == str(v)
+               for k, v in labels.items()):
+            return value
+    return None
 
 
 def serve_metrics(port: int, registry: Optional[Registry] = None,

@@ -42,6 +42,32 @@ def _deadline_from(context: grpc.ServicerContext):
 SERVICE = "kft.serving.PredictionService"
 GRPC_PORT = 9000  # same port the reference's model server bound
 
+# grpc.health.v1 readiness parity (the standard Health service wire
+# contract, hand-rolled like the rest of this module — the image has no
+# grpc_health codegen).  Check mirrors /readyz: SERVING while models
+# are loaded and the server is not draining, NOT_SERVING during a
+# SIGTERM drain — so the fleet router can probe gRPC-only replicas with
+# any stock health checker.  The answer is server-wide (one serving
+# process = one readiness), whatever ``service`` name the request asks
+# about; mirroring /readyz exactly is the point.
+HEALTH_SERVICE = "grpc.health.v1.Health"
+HEALTH_SERVING = 1      # HealthCheckResponse.ServingStatus.SERVING
+HEALTH_NOT_SERVING = 2  # HealthCheckResponse.ServingStatus.NOT_SERVING
+
+
+def _health_response(status: int) -> bytes:
+    """Serialize HealthCheckResponse{status}: field 1 varint (single
+    byte for the two statuses this server emits)."""
+    return bytes([0x08, status])
+
+
+def _health_status(data: bytes) -> int:
+    """Parse HealthCheckResponse bytes back to the status enum (client
+    side of the same hand-rolled contract)."""
+    if len(data) >= 2 and data[0] == 0x08:
+        return data[1]
+    return 0  # UNKNOWN (empty message = all defaults)
+
 
 def tensor_to_numpy(t: pb.Tensor) -> np.ndarray:
     return np.frombuffer(t.data, dtype=np.dtype(t.dtype)).reshape(
@@ -209,6 +235,24 @@ def make_grpc_server(
     server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
     server.add_generic_rpc_handlers(
         (grpc.method_handlers_generic_handler(SERVICE, handlers),))
+    # Standard health face (readiness parity with /readyz): raw-bytes
+    # serializers — the request's optional ``service`` field is
+    # irrelevant to a server-wide answer, so no message parse at all.
+    def health_check(request: bytes, context) -> bytes:
+        return _health_response(
+            HEALTH_SERVING if model_server.is_ready()
+            else HEALTH_NOT_SERVING)
+
+    health_handlers = {
+        "Check": grpc.unary_unary_rpc_method_handler(
+            health_check,
+            request_deserializer=bytes,
+            response_serializer=bytes,
+        ),
+    }
+    server.add_generic_rpc_handlers(
+        (grpc.method_handlers_generic_handler(HEALTH_SERVICE,
+                                              health_handlers),))
     # TF-Serving compat face on the SAME port: reference-era clients
     # address /tensorflow.serving.PredictionService/Predict with TF
     # TensorProto payloads and run unchanged (serving/tf_compat.py).
@@ -232,6 +276,52 @@ def make_grpc_server(
     server.start()
     log.info("gRPC PredictionService on :%d (+ tf-serving compat)", bound)
     return server
+
+
+def retry_call(fn, *, retries: int = 2, backoff_s: float = 0.05,
+               backoff_cap_s: float = 2.0, rng=None,
+               sleep=None):
+    """Bounded client-side retry for idempotent calls to a serving
+    replica (``fn`` is a zero-arg closure over one PredictionClient
+    method call).
+
+    Backoff honors the SERVER's hint first: an ``Overloaded`` carries
+    the Retry-After the server attached (trailing metadata -> the typed
+    ``retry_after_s`` field), and that number — the server's own
+    estimate of when it will have room — overrides the local jittered
+    exponential schedule, capped at ``backoff_cap_s`` so a confused
+    server cannot park the client.  Transport UNAVAILABLE (replica
+    restarting) falls back to the local schedule.  DeadlineExceeded and
+    semantic errors never retry: the deadline is spent, and answers are
+    answers."""
+    import random as _random
+    import time as _time
+
+    rng = rng or _random.Random()
+    sleep = sleep or _time.sleep
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except Overloaded as e:
+            if attempt >= retries:
+                raise
+            hint = getattr(e, "retry_after_s", None)
+            if hint is not None:
+                delay = min(backoff_cap_s, max(0.0, float(hint)))
+                delay *= 1.0 + 0.1 * rng.random()
+            else:
+                delay = min(backoff_cap_s, backoff_s * (2 ** attempt))
+                delay *= 0.5 + 0.5 * rng.random()
+            sleep(delay)
+            attempt += 1
+        except grpc.RpcError as e:
+            code = e.code() if callable(getattr(e, "code", None)) else None
+            if code != grpc.StatusCode.UNAVAILABLE or attempt >= retries:
+                raise
+            delay = min(backoff_cap_s, backoff_s * (2 ** attempt))
+            sleep(delay * (0.5 + 0.5 * rng.random()))
+            attempt += 1
 
 
 class PredictionClient:
@@ -314,5 +404,30 @@ class PredictionClient:
         resp = self._call("GetModelMetadata", req, timeout)
         return json.loads(resp.metadata_json)
 
+    def ready(self, timeout: Optional[float] = 5.0) -> bool:
+        """grpc.health.v1 Check against this channel: True iff the
+        server answers SERVING (mirrors GET /readyz == 200).  Transport
+        errors are False, not raised — a probe's job is a verdict."""
+        method = self._channel.unary_unary(
+            f"/{HEALTH_SERVICE}/Check",
+            request_serializer=bytes,
+            response_deserializer=bytes)
+        try:
+            return _health_status(method(b"", timeout=timeout)) \
+                == HEALTH_SERVING
+        except grpc.RpcError:
+            return False
+
     def close(self) -> None:
         self._channel.close()
+
+
+def check_health(target: str, timeout: Optional[float] = 5.0) -> bool:
+    """One-shot grpc.health.v1 readiness probe of ``target``
+    (host:port) — what the fleet endpoint registry uses for gRPC-only
+    replicas; the REST twin is GET /readyz."""
+    client = PredictionClient(target)
+    try:
+        return client.ready(timeout=timeout)
+    finally:
+        client.close()

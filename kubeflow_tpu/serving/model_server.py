@@ -65,6 +65,16 @@ RELOAD_FAILURES_TOTAL = "kft_serving_reload_failures_total"
 RELOAD_FAILURES_HELP = "model (re)load attempts that raised, by model"
 BREAKER_OPEN = "kft_serving_reload_breaker_open"
 BREAKER_OPEN_HELP = "1 while a model's reload circuit breaker is open"
+# Scrape-refreshed load gauges (refresh_gauges): until these existed,
+# in-flight was only visible through the :stats JSON route — the fleet
+# autoscaler and dashboards scrape ONE endpoint (/metrics) for load.
+INFLIGHT_GAUGE = "kft_serving_inflight"
+INFLIGHT_HELP = ("requests in flight (transport + predict); unlabeled "
+                 "= process total, model= per-model predict calls")
+QUEUE_GAUGE = "kft_serving_queue_depth"
+QUEUE_HELP = "pending entries in a model's batching plane, by model"
+READY_GAUGE = "kft_serving_ready"
+READY_HELP = "1 when /readyz would say ready (models loaded, not draining)"
 
 
 @dataclasses.dataclass
@@ -400,6 +410,29 @@ class ModelServer:
     def exit_request(self) -> None:
         with self._lock:
             self._inflight -= 1
+
+    def refresh_gauges(self) -> None:
+        """Push the live load signals into the prom registry — called at
+        scrape time by the /metrics route (a gauge the autoscaler reads
+        must be current at the instant of the scrape, and in-flight has
+        no natural write site that is not the predict hot path)."""
+        from kubeflow_tpu.runtime.prom import REGISTRY
+
+        with self._lock:
+            total = self._inflight
+            per_model = {n: self._inflight_by_model.get(n, 0)
+                         for n in self._models}
+        inflight = REGISTRY.gauge(INFLIGHT_GAUGE, INFLIGHT_HELP)
+        inflight.set(total)
+        for name, count in per_model.items():
+            inflight.set(count, model=name)
+        queue = REGISTRY.gauge(QUEUE_GAUGE, QUEUE_HELP)
+        for name in per_model:
+            stats = self.batcher_stats(name)
+            queue.set((stats or {}).get("queue_depth", 0) or 0,
+                      model=name)
+        REGISTRY.gauge(READY_GAUGE, READY_HELP).set(
+            1 if self.is_ready() else 0)
 
     def batcher_stats(self, name: str) -> Optional[Dict[str, Any]]:
         """Live stats of the model's batcher/engine (None when the model

@@ -388,6 +388,303 @@ def fault_injection_smoke(namespace: str = "kubeflow-test") -> None:
             server.stop()
 
 
+def fleet_smoke(namespace: str = "kubeflow-test") -> None:
+    """Hermetic fleet control-plane scenario: a load-aware router in
+    front of THREE in-process serving replicas (each a real ModelServer
+    + DecodeEngine + HTTP listener), discovered as label-selected pods
+    through testing/fake_apiserver.py over real sockets.
+
+      1. discovery + routing — kube-discovered endpoints, concurrent
+         mixed traffic through the router, spread across replicas;
+      2. scale-out under open-loop load — the autoscaler reads scraped
+         kft_serving_* load off the registry and patches the serving
+         Deployment's replicas through the SAME fake apiserver;
+      3. replica kill mid-generation -> ejection within one probe
+         interval; every request issued after the kill is retried onto
+         survivors (failed-before-send policy) and succeeds; clock-
+         skewed backoff expiry + restart -> half-open probe recovery;
+      4. drain-aware rolling restart under continuous traffic — the
+         draining replica gets no NEW work, finishes its in-flight,
+         restarts, and ZERO accepted requests are lost end to end;
+      5. router/autoscaler outcomes visible in kft_router_* /
+         kft_autoscaler_* metrics.
+
+    All replicas share one process (and thus one prom registry and one
+    fault injector): per-endpoint /metrics scrapes stay correct because
+    each replica's scrape refreshes its own server's gauges at render
+    time.  Override the chaos scenario via KFT_FAULTS (the default
+    slows engine steps so in-flight load is observable).
+    """
+    import json
+    import os
+    import tempfile
+    import threading
+    import urllib.error
+    import urllib.request
+
+    import jax
+    import numpy as np
+
+    from kubeflow_tpu.fleet.autoscaler import Autoscaler
+    from kubeflow_tpu.fleet.endpoints import (
+        EndpointRegistry,
+        KubeEndpoints,
+    )
+    from kubeflow_tpu.fleet.router import FleetRouter, make_router_server
+    from kubeflow_tpu.models.transformer import Transformer
+    from kubeflow_tpu.operator.kube_http import HttpKube
+    from kubeflow_tpu.serving.export import export
+    from kubeflow_tpu.serving.http import make_http_server
+    from kubeflow_tpu.serving.loaders import _model_config
+    from kubeflow_tpu.serving.main import batcher_factory, wait_for_drain
+    from kubeflow_tpu.serving.model_server import ModelServer
+    from kubeflow_tpu.testing import faults
+    from kubeflow_tpu.testing.fake_apiserver import make_fake_apiserver
+
+    overrides = {
+        "vocab_size": 128, "d_model": 32, "n_layers": 2, "n_heads": 4,
+        "n_kv_heads": 2, "d_ff": 64, "head_dim": 8, "max_seq_len": 64,
+        "dtype": "float32",
+    }
+    max_new = 8
+    scenario = os.environ.get(faults.ENV) or \
+        "seed=20260803;engine.step:sleep=0.02"
+    prompt = list(range(1, 9))
+
+    def make_replica(base, port=0):
+        server = ModelServer()
+        server.add_model("lm", base)
+        server.enable_batching("lm", batcher_factory(
+            micro_batch_size=0, batch_timeout_s=0.005,
+            lm_engine=True, lm_engine_slots=2,
+            lm_engine_prefill_len=16, max_queue_depth=8))
+        httpd, _ = make_http_server(server, port=port,
+                                    host="127.0.0.1")
+        return server, httpd
+
+    def predict_via(port, body, timeout=180):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/model/lm:predict",
+            data=json.dumps(body).encode())
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                return resp.status, json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read())
+
+    model = Transformer(_model_config(overrides))
+    variables = model.init(jax.random.key(0), np.zeros((1, 4), np.int32))
+    replicas = []
+    apiserver = router_httpd = None
+    registry = None
+    with faults.injected(scenario) as inj, \
+            tempfile.TemporaryDirectory() as tmp:
+        export(f"{tmp}/lm", 1, variables,
+               loader="kubeflow_tpu.serving.loaders:lm_generate",
+               config={"model": overrides, "max_new_tokens": max_new,
+                       "temperature": 0.0})
+        try:
+            # -- fleet assembly -------------------------------------------
+            replicas = [list(make_replica(f"{tmp}/lm"))
+                        for _ in range(3)]
+            apiserver, _, store = make_fake_apiserver()
+            api_port = apiserver.server_address[1]
+            kube = HttpKube(base_url=f"http://127.0.0.1:{api_port}")
+            store.create_deployment({
+                "metadata": {"namespace": namespace,
+                             "name": "tpu-serving"},
+                "spec": {"replicas": 1}})
+            for i, (_, httpd) in enumerate(replicas):
+                store.create_pod({
+                    "metadata": {"namespace": namespace,
+                                 "name": f"srv-{i}",
+                                 "labels": {"app": "tpu-serving"}},
+                    "spec": {"containers": [{"ports": [{
+                        "name": "http",
+                        "containerPort": httpd.server_address[1]}]}]},
+                    "status": {"podIP": "127.0.0.1"}})
+                store.set_pod_phase(namespace, f"srv-{i}", "Running")
+            registry = EndpointRegistry(
+                KubeEndpoints(kube, namespace, {"app": "tpu-serving"}),
+                probe_interval_s=0.2, eject_threshold=1,
+                eject_backoff_s=2.0)
+            registry.refresh()
+            assert len(registry.routable()) == 3, registry.describe()
+            router = FleetRouter(registry, max_tries=3,
+                                 try_timeout_s=180.0)
+            router_httpd, _ = make_router_server(router, port=0,
+                                                 host="127.0.0.1")
+            rport = router_httpd.server_address[1]
+            body_full = {"instances": [{"tokens": prompt}]}
+
+            # -- 1. routed traffic spreads and completes ------------------
+            results: dict = {}
+
+            def client(i, body=body_full):
+                results[i] = predict_via(rport, body)
+
+            threads = [threading.Thread(target=client, args=(i,))
+                       for i in range(9)]
+            for t in threads:
+                t.start()
+            # -- 2. scale-out under the open-loop burst -------------------
+            autoscaler = Autoscaler(
+                kube, namespace, "tpu-serving", registry,
+                target_inflight_per_replica=1.0, tolerance=0.1,
+                min_replicas=1, max_replicas=3,
+                scale_up_cooldown_s=0.0, scale_down_cooldown_s=3600.0)
+            deadline = time.time() + 120
+            scaled = None
+            while time.time() < deadline:
+                registry.refresh()
+                if registry.total_load() >= 2:
+                    scaled = autoscaler.reconcile_once()
+                    if scaled["applied"]:
+                        break
+                time.sleep(0.02)
+            assert scaled is not None and scaled["applied"], (
+                "autoscaler never saw the burst's load")
+            dep = kube.get_deployment(namespace, "tpu-serving")
+            assert dep["spec"]["replicas"] >= 2, dep
+            for t in threads:
+                t.join(timeout=180)
+            assert sorted(r[0] for r in results.values()) \
+                == [200] * 9, results
+            for code, payload in results.values():
+                tokens = payload["predictions"][0]["tokens"]
+                assert tokens[:len(prompt)] == prompt
+                assert len(tokens) == len(prompt) + max_new
+            served_by = [i for i, (srv, _) in enumerate(replicas)
+                         if (srv.batcher_stats("lm") or {}).get(
+                             "requests", 0) > 0]
+            assert len(served_by) >= 2, (
+                f"load not spread: replicas {served_by} served")
+
+            # -- 3. kill mid-generation -> eject -> recover ---------------
+            victim_srv, victim_httpd = replicas[0]
+            victim_port = victim_httpd.server_address[1]
+            holder: dict = {}
+            t = threading.Thread(target=lambda: holder.update(
+                {"resp": predict_via(victim_port, body_full,
+                                     timeout=30)}))
+            t.start()
+            deadline = time.time() + 60
+            while victim_srv.inflight() < 1:
+                assert time.time() < deadline, \
+                    "victim request never started"
+                time.sleep(0.01)
+            victim_httpd.shutdown()   # the kill, mid-generation
+            victim_httpd.server_close()
+            t.join(timeout=60)
+            # One probe interval: a single refresh ejects it
+            # (eject_threshold=1).
+            registry.refresh()
+            states = {s.name: s for s in registry.all()}
+            assert states["srv-0"].breaker.open, registry.describe()
+            assert len(registry.routable()) == 2
+            # Everything issued AFTER the kill lands on survivors.
+            results.clear()
+            threads = [threading.Thread(target=client, args=(i,))
+                       for i in range(6)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=180)
+            assert sorted(r[0] for r in results.values()) \
+                == [200] * 6, results
+            # Recovery: backoff expires on the skewed policy clock, the
+            # replica returns on the SAME port (warm engine), and the
+            # half-open probe readmits it.
+            new_httpd = make_http_server(
+                victim_srv, port=victim_port, host="127.0.0.1")[0]
+            replicas[0][1] = new_httpd
+            inj.advance_clock(10)
+            registry.refresh()
+            assert states["srv-0"].routable(), registry.describe()
+
+            # -- 4. drain-aware rolling restart, zero loss ----------------
+            stop_traffic = threading.Event()
+            outcomes: list = []
+
+            def traffic():
+                while not stop_traffic.is_set():
+                    outcomes.append(predict_via(rport, body_full)[0])
+
+            traffic_threads = [threading.Thread(target=traffic)
+                               for _ in range(3)]
+            for t in traffic_threads:
+                t.start()
+            try:
+                roll_srv, roll_httpd = replicas[1]
+                roll_port = roll_httpd.server_address[1]
+                roll_srv.begin_drain()
+                registry.refresh()
+                states = {s.name: s for s in registry.all()}
+                assert not states["srv-1"].routable()
+                assert states["srv-1"].state_label() == "draining"
+                assert wait_for_drain(roll_srv, deadline_s=120), \
+                    "draining replica never quiesced"
+                roll_httpd.shutdown()
+                roll_httpd.server_close()
+                roll_srv.stop()
+                # Restarted process: fresh ModelServer, same address.
+                new_srv, new_httpd = make_replica(f"{tmp}/lm",
+                                                  port=roll_port)
+                replicas[1] = [new_srv, new_httpd]
+                registry.refresh()
+                states = {s.name: s for s in registry.all()}
+                deadline = time.time() + 60
+                while not states["srv-1"].routable():
+                    assert time.time() < deadline, registry.describe()
+                    time.sleep(0.05)
+                    registry.refresh()
+            finally:
+                stop_traffic.set()
+                for t in traffic_threads:
+                    t.join(timeout=180)
+            assert outcomes, "traffic generator produced nothing"
+            bad = [c for c in outcomes if c != 200]
+            assert not bad, (
+                f"rolling restart lost {len(bad)}/{len(outcomes)} "
+                f"accepted requests: {bad[:5]}")
+
+            # -- 5. control-plane outcomes in kft_* metrics ---------------
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{rport}/metrics",
+                    timeout=30) as resp:
+                metrics = resp.read().decode()
+            from kubeflow_tpu.runtime.prom import (
+                parse_metrics,
+                sample_value,
+            )
+
+            parsed = parse_metrics(metrics)
+            assert (sample_value(parsed, "kft_router_ejections_total",
+                                 endpoint="srv-0") or 0) >= 1
+            ok = sum(v for labels, v in
+                     parsed.get("kft_router_requests_total", ())
+                     if labels.get("outcome") == "ok")
+            assert ok >= 15, parsed.get("kft_router_requests_total")
+            assert (sample_value(
+                parsed, "kft_autoscaler_desired_replicas") or 0) >= 2
+            assert sample_value(parsed, "kft_router_endpoints",
+                                state="routable") == 3, parsed.get(
+                                    "kft_router_endpoints")
+        finally:
+            if router_httpd is not None:
+                router_httpd.shutdown()
+            if apiserver is not None:
+                apiserver.shutdown()
+                apiserver.server_close()
+            for srv, httpd in replicas:
+                try:
+                    httpd.shutdown()
+                    httpd.server_close()
+                except Exception:
+                    pass
+                srv.stop()
+
+
 def train_smoke(namespace: str = "kubeflow-test") -> None:
     """A few real SPMD train steps on whatever devices exist."""
     import subprocess
@@ -519,6 +816,7 @@ COMMANDS = {
     "serving": serving_smoke,
     "engine": engine_smoke,
     "faults": fault_injection_smoke,
+    "fleet": fleet_smoke,
     "train": train_smoke,
     "deploy": deploy_real,
     "deploy-crds": deploy_crds,

@@ -32,6 +32,9 @@ _POD = re.compile(r"^/api/v1/namespaces/(?P<ns>[^/]+)/pods(?:/(?P<name>[^/]+))?$
 _SVC = re.compile(
     r"^/api/v1/namespaces/(?P<ns>[^/]+)/services(?:/(?P<name>[^/]+))?$")
 _EVT = re.compile(r"^/api/v1/namespaces/(?P<ns>[^/]+)/events$")
+_DEP = re.compile(
+    r"^/apis/apps/v1/namespaces/(?P<ns>[^/]+)/deployments"
+    r"(?:/(?P<name>[^/]+))?$")
 _NODES = re.compile(r"^/api/v1/nodes$")
 _CR = re.compile(
     rf"^/apis/{re.escape(crd.GROUP)}/{crd.VERSION}"
@@ -64,28 +67,36 @@ class _Handler(BaseHTTPRequestHandler):
         n = int(self.headers.get("Content-Length", 0))
         return json.loads(self.rfile.read(n)) if n else {}
 
-    def _send(self, code: int, payload=None) -> None:
+    def _send(self, code: int, payload=None, headers=None) -> None:
         data = json.dumps(payload if payload is not None else {}).encode()
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(data)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
         self.end_headers()
         self.wfile.write(data)
 
     def _dispatch(self, method: str) -> None:
         # Injected-failure queue (httpd.fail_queue): each entry is an
-        # HTTP status code served verbatim for one request, before any
-        # routing — how the retry layer in operator/kube_http.py is
-        # integration-tested against real 5xx over real sockets.
+        # HTTP status code — or a (code, retry_after_s) pair, served
+        # with a Retry-After header — handed verbatim to one request,
+        # before any routing; how the retry layer in
+        # operator/kube_http.py is integration-tested against real
+        # 5xx/429 weather (and its backoff-hint honoring) over sockets.
         if self.fail_queue:
             try:
                 code = self.fail_queue.pop(0)
             except IndexError:
                 code = None  # raced another handler thread; serve real
             if code is not None:
+                headers = None
+                if isinstance(code, tuple):
+                    code, retry_after = code
+                    headers = {"Retry-After": str(retry_after)}
                 self._send(int(code), {
                     "kind": "Status", "code": int(code),
-                    "message": "injected failure"})
+                    "message": "injected failure"}, headers=headers)
                 return
         path, _, qs = self.path.partition("?")
         try:
@@ -151,6 +162,35 @@ class _Handler(BaseHTTPRequestHandler):
             if method == "DELETE" and name:
                 kube.delete_service(ns, name)
                 self._send(200)
+                return True
+
+        m = _DEP.match(path)
+        if m:
+            ns, name = m["ns"], m["name"]
+            if method == "POST" and not name:
+                self._send(201, kube.create_deployment(self._body()))
+                return True
+            if method == "GET" and name:
+                self._send(200, kube.get_deployment(ns, name))
+                return True
+            if method == "GET":
+                self._send(200, {"items": kube.list_deployments(
+                    ns, _parse_selector(qs))})
+                return True
+            if method == "PATCH" and name:
+                # Scale patches ride the deployment object itself as a
+                # merge-patch {"spec": {"replicas": N}} — same content
+                # type discipline as the CR /status subresource.
+                if self.headers.get("Content-Type") != \
+                        "application/merge-patch+json":
+                    self._send(415, {"message": "merge-patch required"})
+                    return True
+                replicas = self._body().get("spec", {}).get("replicas")
+                if replicas is None:
+                    self._send(422, {"message": "spec.replicas required"})
+                    return True
+                self._send(200, kube.patch_deployment_scale(
+                    ns, name, int(replicas)))
                 return True
 
         m = _CR.match(path)

@@ -18,6 +18,11 @@ Hook sites planted in production code (grep for ``faults.fire``):
                       (raise = corrupt checkpoint directory)
     kube.request      HttpKube transport attempt (raise = apiserver
                       connection failure, before the retry layer)
+    router.forward    fleet router upstream attempt (raise = replica
+                      connection failure, before the socket — the
+                      retry/ejection layer sees it as a refused
+                      connect)
+    fleet.probe       endpoint registry readiness probe attempt
 
 Clock skips: deadline/backoff code reads :func:`monotonic` instead of
 ``time.monotonic`` — a ``skew`` action (or ``advance_clock`` from a

@@ -157,6 +157,29 @@ def cmd_bootstrap(args: argparse.Namespace) -> int:
     return boot.main(argv)
 
 
+def cmd_fleet_status(args: argparse.Namespace) -> int:
+    """Print the fleet router's live endpoint table — the operator's
+    one-glance view of replica health (GET /fleet/endpoints on the
+    router, kubeflow_tpu/fleet/router.py)."""
+    import urllib.request
+
+    url = args.router.rstrip("/") + "/fleet/endpoints"
+    with urllib.request.urlopen(url, timeout=args.timeout) as resp:
+        rows = json.loads(resp.read())
+    if not rows:
+        print("no endpoints discovered")
+        return 0
+    fmt = "{:<20} {:<10} {:>9} {:>12} {:>9}"
+    print(fmt.format("ENDPOINT", "STATE", "INFLIGHT", "QUEUE_DEPTH",
+                     "FAILURES"))
+    for row in rows:
+        print(fmt.format(row["name"], row["state"],
+                         int(row["inflight"]),
+                         int(row["queue_depth"]),
+                         row["breaker_failures"]))
+    return 0
+
+
 def cmd_version(args: argparse.Namespace) -> int:
     from kubeflow_tpu.version import version_info
 
@@ -223,6 +246,17 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--apply", action="store_true")
     p.add_argument("--namespace", default=None)
     p.set_defaults(func=cmd_bootstrap)
+
+    p = sub.add_parser(
+        "fleet",
+        help="inspect the serving fleet control plane (fleet/main.py)")
+    fsub = p.add_subparsers(dest="action", required=True)
+    fstat = fsub.add_parser("status",
+                            help="live replica table from the router")
+    fstat.add_argument("--router", default="http://127.0.0.1:8080",
+                       help="router base URL (default: %(default)s)")
+    fstat.add_argument("--timeout", type=float, default=10.0)
+    fstat.set_defaults(func=cmd_fleet_status)
 
     p = sub.add_parser("version", help="print version info")
     p.set_defaults(func=cmd_version)

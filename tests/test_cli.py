@@ -98,3 +98,42 @@ def test_apply_then_delete_round_trip(app_file, monkeypatch):
     (apply_cmd, applied), (delete_cmd, deleted) = calls
     assert apply_cmd[:2] == ["kubectl", "apply"]
     assert applied == deleted
+
+
+def test_fleet_status_renders_endpoint_table(capsys):
+    """`kubeflow-tpu fleet status` prints the router's live replica
+    table (GET /fleet/endpoints)."""
+    import json
+    import threading
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    rows = [{"name": "srv-0", "url": "http://10.0.0.5:8000",
+             "state": "routable", "inflight": 3.0, "queue_depth": 1.0,
+             "local_inflight": 0, "breaker_failures": 0},
+            {"name": "srv-1", "url": "http://10.0.0.6:8000",
+             "state": "ejected", "inflight": 0.0, "queue_depth": 0.0,
+             "local_inflight": 0, "breaker_failures": 4}]
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            data = json.dumps(rows).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        rc = cli.main([
+            "fleet", "status", "--router",
+            f"http://127.0.0.1:{httpd.server_address[1]}"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "srv-0" in out and "routable" in out
+        assert "srv-1" in out and "ejected" in out
+    finally:
+        httpd.shutdown()

@@ -590,6 +590,85 @@ class TestReloadBreaker:
         assert breaker.allow(3)  # different artifact: try at once
 
 
+class TestReloadBreakerBackoffBounds:
+    """White-box invariants of the breaker's backoff schedule: the
+    jittered window must stay inside [B, 1.25*B] for B = min(cap,
+    base * 2^(n-1)) — a jitter that can exceed the bound turns the cap
+    into a lie, and one that can undershoot re-opens the hot-loop the
+    breaker exists to prevent.  Clock-skew driven: no wall sleeps."""
+
+    def test_backoff_window_within_jitter_bounds_per_failure(self):
+        import random
+
+        base_s, cap_s = 0.5, 8.0
+        with faults.injected("seed=0"):
+            breaker = _ReloadBreaker(base_s=base_s, cap_s=cap_s,
+                                     rng=random.Random(7))
+            for n in range(1, 10):
+                before = faults.monotonic()
+                breaker.record_failure(2)
+                window = breaker.open_until - before
+                expected = min(cap_s, base_s * (2 ** (n - 1)))
+                # record_failure read the clock a hair after `before`,
+                # so `window` can only exceed the nominal bound.
+                assert expected <= window <= expected * 1.25 + 1e-6, (
+                    n, window, expected)
+
+    def test_jitter_sequences_differ_across_default_breakers(self):
+        # OS-seeded default rngs: two replicas watching one model path
+        # must not walk identical backoff schedules (lockstep retry).
+        with faults.injected("seed=0"):
+            windows = []
+            for _ in range(2):
+                b = _ReloadBreaker(base_s=1.0, cap_s=64.0)
+                seq = []
+                for _ in range(6):
+                    before = faults.monotonic()
+                    b.record_failure(2)
+                    seq.append(round(b.open_until - before, 9))
+                windows.append(seq)
+            assert windows[0] != windows[1]
+
+    def test_half_open_single_trial_under_concurrent_clock_skew(self):
+        """After the (skewed-past) backoff expires, exactly ONE caller
+        may claim the trial slot no matter how many race for it; a
+        failed trial re-opens with a doubled window, a successful one
+        closes the breaker for everyone."""
+        with faults.injected("seed=0") as inj:
+            breaker = _ReloadBreaker(base_s=1.0, cap_s=64.0)
+            breaker.record_failure(5)
+            first_window = breaker.open_until - faults.monotonic()
+            inj.advance_clock(2.0)  # backoff spent
+
+            grants = []
+            barrier = threading.Barrier(8)
+
+            def racer():
+                barrier.wait()
+                if breaker.allow(5):
+                    grants.append(threading.get_ident())
+
+            threads = [threading.Thread(target=racer)
+                       for _ in range(8)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert len(grants) == 1, grants
+            # Trial fails -> re-open, doubled (jittered) window; the
+            # skewed clock is the only time source consulted.
+            before = faults.monotonic()
+            breaker.record_failure(5)
+            second_window = breaker.open_until - before
+            assert second_window >= 2.0 > first_window / 1.25
+            assert not breaker.allow(5)
+            inj.advance_clock(second_window + 0.001)
+            assert breaker.allow(5)      # next half-open trial
+            breaker.record_success()
+            # Closed: every caller admitted again, immediately.
+            assert breaker.allow(5) and breaker.allow(5)
+
+
 class TestReadinessAndDrain:
     def test_ready_requires_models_and_not_draining(self):
         srv = ModelServer()

@@ -1,0 +1,629 @@
+"""Fleet control plane: endpoint registry, load-aware router,
+autoscaler — driven against scriptable in-process fake replicas (the
+real serving surface is exercised by the `fleet` e2e scenario in
+kubeflow_tpu/testing/e2e.py; these tests pin the routing/scaling
+POLICIES deterministically)."""
+
+import json
+import random
+import threading
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from kubeflow_tpu.fleet.autoscaler import Autoscaler
+from kubeflow_tpu.fleet.endpoints import (
+    Endpoint,
+    EndpointRegistry,
+    KubeEndpoints,
+    StaticEndpoints,
+)
+from kubeflow_tpu.fleet.router import FleetRouter
+from kubeflow_tpu.operator.kube import FakeKube
+from kubeflow_tpu.testing import faults
+
+
+class _Replica:
+    """Scriptable stand-in for one serving replica: real sockets, fake
+    model — /readyz, /metrics (the gauges the registry scrapes), and a
+    predict route whose status/behavior the test controls."""
+
+    def __init__(self, port=0):
+        self.ready = True
+        self.draining = False
+        self.inflight = 0.0
+        self.queue_depth = 0.0
+        self.predict_status = 200
+        self.retry_after = None
+        self.hang_up = False  # close mid-response without answering
+        self.fail_gets = False  # hang up model GETs (stats/metadata)
+        self.get_attempts = 0
+        self.requests = []
+        self.lock = threading.Lock()
+        replica = self
+
+        class Handler(BaseHTTPRequestHandler):
+            # Keep-alive like the real serving handler, so the
+            # router's connection pool is exercised by these tests.
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def _send(self, code, payload, headers=None):
+                data = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                if self.path == "/readyz":
+                    if replica.ready and not replica.draining:
+                        self._send(200, {"status": "ready"})
+                    else:
+                        self._send(503, {
+                            "status": "draining" if replica.draining
+                            else "no models loaded"})
+                elif self.path == "/metrics":
+                    text = (
+                        f"kft_serving_inflight {replica.inflight}\n"
+                        f'kft_serving_queue_depth{{model="m"}} '
+                        f"{replica.queue_depth}\n")
+                    data = text.encode()
+                    self.send_response(200)
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
+                else:
+                    with replica.lock:
+                        replica.get_attempts += 1
+                    if replica.fail_gets:
+                        self.connection.close()
+                        return
+                    self._send(200, {"route": self.path})
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(n) if n else b""
+                with replica.lock:
+                    replica.requests.append((self.path, body))
+                if replica.hang_up:
+                    # Bytes were received, then the connection dies —
+                    # the non-idempotent-retry case.
+                    self.connection.close()
+                    return
+                headers = {}
+                if replica.retry_after is not None:
+                    headers["Retry-After"] = str(replica.retry_after)
+                self._send(replica.predict_status,
+                           {"predictions": [{"ok": True}]}, headers)
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self.port = self.httpd.server_address[1]
+        self.url = f"http://127.0.0.1:{self.port}"
+        self.thread = threading.Thread(target=self.httpd.serve_forever,
+                                       daemon=True)
+        self.thread.start()
+
+    def kill(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+    def received(self):
+        with self.lock:
+            return list(self.requests)
+
+
+def _registry(replicas, **kw):
+    kw.setdefault("eject_threshold", 2)
+    kw.setdefault("rng", random.Random(0))
+    reg = EndpointRegistry(
+        StaticEndpoints([Endpoint(name=f"r{i}", url=r.url)
+                         for i, r in enumerate(replicas)]), **kw)
+    reg.refresh()
+    return reg
+
+
+def _router(reg, **kw):
+    kw.setdefault("rng", random.Random(0))
+    kw.setdefault("try_timeout_s", 10.0)
+    return FleetRouter(reg, **kw)
+
+
+@pytest.fixture()
+def replicas():
+    reps = [_Replica() for _ in range(3)]
+    yield reps
+    for r in reps:
+        try:
+            r.kill()
+        except Exception:
+            pass
+
+
+def _predict(router, body=None, path="/model/m:predict"):
+    payload = json.dumps(body or {"instances": [[1]]}).encode()
+    return router.handle("POST", path, payload,
+                         {"Content-Type": "application/json"})
+
+
+class TestRegistry:
+    def test_discovery_and_readiness(self, replicas):
+        reg = _registry(replicas)
+        assert len(reg.all()) == 3
+        assert len(reg.routable()) == 3
+        replicas[1].ready = False
+        reg.refresh()
+        routable = {s.name for s in reg.routable()}
+        assert routable == {"r0", "r2"}
+
+    def test_draining_replica_not_routable_but_not_ejected(
+            self, replicas):
+        reg = _registry(replicas)
+        replicas[0].draining = True
+        reg.refresh()
+        states = {s.name: s for s in reg.all()}
+        assert not states["r0"].routable()
+        assert states["r0"].state_label() == "draining"
+        assert not states["r0"].breaker.open
+
+    def test_load_scraped_from_metrics(self, replicas):
+        replicas[2].inflight = 7
+        replicas[2].queue_depth = 3
+        reg = _registry(replicas)
+        states = {s.name: s for s in reg.all()}
+        assert states["r2"].score() == 10.0
+        assert reg.total_load() == 10.0
+
+    def test_dead_replica_ejected_after_threshold_probes(
+            self, replicas):
+        with faults.injected("seed=0"):
+            reg = _registry(replicas, eject_threshold=2,
+                            eject_backoff_s=5.0)
+            replicas[0].kill()
+            reg.refresh()  # failure 1
+            reg.refresh()  # failure 2 -> ejected
+            states = {s.name: s for s in reg.all()}
+            assert states["r0"].breaker.open
+            assert states["r0"].state_label() == "ejected"
+            # While open, further refreshes skip the probe entirely.
+            fired = faults.active().fired("fleet.probe")
+            reg.refresh()
+            assert faults.active().fired("fleet.probe") == fired + 2
+
+    def test_ejected_replica_recovers_via_half_open_probe(self):
+        rep = _Replica()
+        fresh = None
+        try:
+            with faults.injected("seed=0") as inj:
+                reg = _registry([rep], eject_threshold=1,
+                                eject_backoff_s=5.0)
+                port = rep.port
+                rep.kill()
+                reg.refresh()
+                state = reg.all()[0]
+                assert state.breaker.open
+                # Backoff not yet expired: probe stays skipped and the
+                # endpoint stays ejected.
+                reg.refresh()
+                assert state.breaker.open
+                # Replica comes back on the same port; after the
+                # (clock-skewed) backoff the half-open trial probe
+                # runs, succeeds, and closes the breaker.
+                fresh = _Replica(port=port)
+                inj.advance_clock(30)
+                reg.refresh()
+                assert not state.breaker.open
+                assert state.routable()
+        finally:
+            if fresh is not None:
+                fresh.kill()
+
+    def test_describe_renders_all_states_without_deadlock(
+            self, replicas):
+        # Regression: describe() once re-acquired the (non-reentrant)
+        # state lock through state_label() — deadlocking the router's
+        # /fleet/endpoints route for any NON-ejected endpoint.
+        reg = _registry(replicas)
+        replicas[1].draining = True
+        reg.refresh()
+        done = []
+        t = threading.Thread(target=lambda: done.append(reg.describe()))
+        t.start()
+        t.join(timeout=10)
+        assert done, "describe() deadlocked"
+        states = {r["name"]: r["state"] for r in done[0]}
+        assert states["r0"] == "routable"
+        assert states["r1"] == "draining"
+
+    def test_half_open_trial_released_when_probe_answers_not_ready(
+            self):
+        """Regression: an ejected endpoint whose half-open probe finds
+        the replica alive-but-loading (/readyz 503, not draining) must
+        RELEASE the trial slot — it once stayed claimed forever,
+        permanently ejecting a replica that later became healthy."""
+        rep = _Replica()
+        try:
+            with faults.injected("seed=0") as inj:
+                reg = _registry([rep], eject_threshold=1,
+                                eject_backoff_s=2.0)
+                port = rep.port
+                rep.kill()
+                reg.refresh()
+                state = reg.all()[0]
+                assert state.breaker.open
+                # Replica returns but is NOT ready yet (no models).
+                back = _Replica(port=port)
+                back.ready = False
+                inj.advance_clock(10)
+                reg.refresh()  # half-open trial: alive, 503 not-ready
+                assert state.breaker.open  # still ejected...
+                back.ready = True
+                inj.advance_clock(10)  # ...but a LATER window re-probes
+                reg.refresh()
+                assert not state.breaker.open
+                assert state.routable()
+                back.kill()
+        finally:
+            pass
+
+    def test_kube_port_prefers_named_http_over_sidecar(self):
+        kube = FakeKube()
+        kube.create_pod({
+            "metadata": {"namespace": "kf", "name": "srv-0",
+                         "labels": {"app": "srv"}},
+            "spec": {"containers": [
+                {"ports": [{"name": "http", "containerPort": 8000}]},
+                {"ports": [{"containerPort": 9090}]},  # sidecar
+            ]},
+            "status": {"podIP": "10.0.0.5"}})
+        kube.set_pod_phase("kf", "srv-0", "Running")
+        src = KubeEndpoints(kube, "kf", {"app": "srv"})
+        assert src.discover()[0].url == "http://10.0.0.5:8000"
+
+    def test_kube_endpoint_source_reads_running_pods(self):
+        kube = FakeKube()
+        kube.create_pod({
+            "metadata": {"namespace": "kf", "name": "srv-0",
+                         "labels": {"app": "srv"}},
+            "spec": {"containers": [{
+                "ports": [{"name": "http", "containerPort": 8123}]}]},
+            "status": {"podIP": "10.0.0.5"}})
+        kube.set_pod_phase("kf", "srv-0", "Running")
+        kube.create_pod({  # pending pod: no endpoint yet
+            "metadata": {"namespace": "kf", "name": "srv-1",
+                         "labels": {"app": "srv"}},
+            "spec": {"containers": []},
+            "status": {"podIP": "10.0.0.6"}})
+        src = KubeEndpoints(kube, "kf", {"app": "srv"})
+        eps = src.discover()
+        assert [e.name for e in eps] == ["srv-0"]
+        assert eps[0].url == "http://10.0.0.5:8123"
+
+
+class TestRouter:
+    def test_p2c_prefers_lower_load(self, replicas):
+        replicas[0].inflight = 50
+        replicas[1].inflight = 50
+        replicas[2].inflight = 0
+        reg = _registry(replicas)
+        router = _router(reg)
+        # With two candidates compared per pick, the idle replica wins
+        # every draw it appears in; over many requests it must carry
+        # the clear majority.
+        for _ in range(30):
+            status, _, _ = _predict(router)
+            assert status == 200
+        counts = [len(r.received()) for r in replicas]
+        assert counts[2] > counts[0] and counts[2] > counts[1]
+
+    def test_overloaded_replica_retried_on_other(self, replicas):
+        replicas[0].predict_status = 429
+        replicas[0].retry_after = 3
+        replicas[1].predict_status = 429
+        replicas[1].retry_after = 3
+        reg = _registry(replicas)
+        router = _router(reg)
+        for _ in range(5):
+            status, headers, body = _predict(router)
+            assert status == 200, body
+        assert len(replicas[2].received()) >= 5
+        # Shed responses are health, not sickness: nobody ejected.
+        assert not any(s.breaker.open for s in reg.all())
+
+    def test_all_overloaded_propagates_min_retry_after(self, replicas):
+        for r, hint in zip(replicas, (7, 3, 9)):
+            r.predict_status = 429
+            r.retry_after = hint
+        reg = _registry(replicas)
+        router = _router(reg)
+        status, headers, body = _predict(router)
+        assert status == 429
+        assert headers["Retry-After"] == "3"
+
+    def test_dead_replica_request_retried_and_ejected(self, replicas):
+        reg = _registry(replicas, eject_threshold=2)
+        router = _router(reg)
+        replicas[0].kill()
+        # Every request succeeds (connection-refused retries on a
+        # different replica) and the dead one accumulates failures
+        # until ejection takes it out of rotation.
+        for _ in range(10):
+            status, _, body = _predict(router)
+            assert status == 200, body
+        states = {s.name: s for s in reg.all()}
+        assert states["r0"].breaker.open
+
+    def test_post_not_replayed_after_bytes_reached_replica(
+            self, replicas):
+        replicas[0].hang_up = True
+        replicas[1].hang_up = True
+        replicas[2].hang_up = True
+        reg = _registry(replicas)
+        router = _router(reg)
+        status, _, body = _predict(router)
+        assert status == 502
+        # Exactly ONE replica saw the request: a mid-flight failure of
+        # non-idempotent work must not be replayed elsewhere.
+        assert sum(len(r.received()) for r in replicas) == 1
+
+    def test_post_on_reused_conn_death_not_replayed(self):
+        """A pooled keep-alive connection dying before the response is
+        indistinguishable from a replica crashing mid-generation on
+        OUR request — so a POST is NOT replayed (no RFC 7230 §6.3.1
+        close-race carve-out for non-idempotent work)."""
+        rep, other = _Replica(), _Replica()
+        try:
+            reg = _registry([rep, other])
+            router = _router(reg)
+            # Warm the pool: route until BOTH replicas served once.
+            for _ in range(10):
+                status, _, _ = _predict(router)
+                assert status == 200
+                if rep.received() and other.received():
+                    break
+            assert rep.received(), "pool to rep never warmed"
+            before = sum(len(r.received()) for r in (rep, other))
+            rep.hang_up = True
+            other.hang_up = True
+            # Drive until some request hits a REUSED conn that dies:
+            # the response must be 502 and the request must appear on
+            # exactly ONE replica (no replay).
+            status, _, _ = _predict(router)
+            after = sum(len(r.received()) for r in (rep, other))
+            assert status == 502
+            assert after == before + 1, (before, after)
+        finally:
+            rep.kill()
+            other.kill()
+
+    def test_probe_driven_ejection_purges_router_pool(self):
+        """Regression: only ROUTER-observed failures purged the
+        keep-alive pool; a probe-driven ejection left stale pooled
+        connections that greeted the recovered replica's first POST
+        with a non-retryable transport failure."""
+        rep = _Replica()
+        fresh = None
+        try:
+            with faults.injected("seed=0") as inj:
+                reg = _registry([rep], eject_threshold=1,
+                                eject_backoff_s=2.0)
+                router = _router(reg)
+                status, _, _ = _predict(router)
+                assert status == 200  # a conn is now pooled
+                assert router._pool.get(rep.url) is not None
+                # Re-pool it and crash the replica; the PROBE ejects.
+                status, _, _ = _predict(router)
+                port = rep.port
+                rep.kill()
+                reg.refresh()
+                state = reg.all()[0]
+                assert state.breaker.open
+                # Pool purged by the on_eject hook:
+                assert router._pool.get(rep.url) is None
+                # Recovery: replica back on the same port; its first
+                # routed POST must ride a FRESH connection and win.
+                fresh = _Replica(port=port)
+                inj.advance_clock(10)
+                reg.refresh()
+                assert state.routable()
+                status, _, body = _predict(router)
+                assert status == 200, body
+        finally:
+            if fresh is not None:
+                fresh.kill()
+
+    def test_get_is_retried_on_transport_failure(self):
+        # GETs are idempotent: a mid-flight transport failure IS
+        # retried on the other replica (the POST twin of this scenario
+        # answers 502 — see the non-idempotent test above).
+        bad, good = _Replica(), _Replica()
+        bad.fail_gets = True
+        try:
+            reg = _registry([bad, good])
+            # Map scripted replicas to their registry names for the
+            # assertion below (r0 = bad, r1 = good).
+            router = _router(reg, max_tries=3)
+            for _ in range(10):
+                status, _, _ = router.handle(
+                    "GET", "/model/m:stats", b"", {})
+                assert status == 200
+            # The failing replica was offered at least one GET, which
+            # then completed elsewhere: that is a retry.
+            assert bad.get_attempts > 0
+        finally:
+            bad.kill()
+            good.kill()
+
+    def test_expired_deadline_never_reaches_a_replica(self, replicas):
+        reg = _registry(replicas)
+        router = _router(reg)
+        # A ~100ns budget expires between arrival and the pre-forward
+        # re-check (Python overhead alone is microseconds): the router
+        # answers 504 itself without opening any upstream socket.
+        status, _, _ = router.handle(
+            "POST", "/model/m:predict",
+            json.dumps({"instances": [[1]],
+                        "deadline_ms": 0.0001}).encode(), {})
+        assert status == 504
+        assert sum(len(r.received()) for r in replicas) == 0
+
+    def test_deadline_rewritten_to_remaining_budget(self, replicas):
+        reg = _registry(replicas)
+        router = _router(reg)
+        status, _, _ = _predict(
+            router, {"instances": [[1]], "deadline_ms": 60000})
+        assert status == 200
+        path, body = [r for r in replicas if r.received()][0].received()[0]
+        forwarded = json.loads(body)["deadline_ms"]
+        assert 0 < forwarded <= 60000
+
+    def test_retry_budget_bounds_amplification(self, replicas):
+        for r in replicas:
+            r.predict_status = 429
+            r.retry_after = 1
+        reg = _registry(replicas)
+        router = _router(reg, retry_budget_ratio=0.0,
+                         retry_budget_cap=0.0)
+        status, _, _ = _predict(router)
+        assert status == 429
+        # Budget empty: exactly one replica was offered the request.
+        assert sum(len(r.received()) for r in replicas) == 1
+
+    def test_draining_replica_gets_no_new_work(self, replicas):
+        reg = _registry(replicas)
+        router = _router(reg)
+        replicas[0].draining = True
+        reg.refresh()
+        for _ in range(10):
+            status, _, _ = _predict(router)
+            assert status == 200
+        assert len(replicas[0].received()) == 0
+
+    def test_no_routable_replicas_is_503(self, replicas):
+        reg = _registry(replicas)
+        for r in replicas:
+            r.ready = False
+        reg.refresh()
+        router = _router(reg)
+        status, _, body = _predict(router)
+        assert status == 503
+        assert b"no routable" in body
+
+
+class TestAutoscaler:
+    def _deployment(self, kube, replicas=1):
+        kube.create_deployment({
+            "metadata": {"namespace": "kf", "name": "srv"},
+            "spec": {"replicas": replicas}})
+
+    def _scaler(self, kube, reg, **kw):
+        kw.setdefault("target_inflight_per_replica", 4.0)
+        kw.setdefault("min_replicas", 1)
+        kw.setdefault("max_replicas", 8)
+        kw.setdefault("scale_up_cooldown_s", 10.0)
+        kw.setdefault("scale_down_cooldown_s", 60.0)
+        return Autoscaler(kube, "kf", "srv", reg, **kw)
+
+    class _FixedLoad:
+        """Registry stand-in: the autoscaler only reads total_load()
+        and ready_count()."""
+
+        def __init__(self, load, ready=1):
+            self.load = load
+            self.ready = ready
+
+        def total_load(self):
+            return self.load
+
+        def ready_count(self):
+            return self.ready
+
+    def test_scale_up_on_load(self):
+        kube = FakeKube()
+        self._deployment(kube, 1)
+        reg = self._FixedLoad(20.0, ready=1)
+        with faults.injected("seed=0"):
+            out = self._scaler(kube, reg).reconcile_once()
+        assert out["applied"] and out["desired"] == 5
+        assert kube.get_deployment("kf", "srv")["spec"]["replicas"] == 5
+
+    def test_hysteresis_holds_inside_tolerance_band(self):
+        kube = FakeKube()
+        self._deployment(kube, 2)
+        # capacity = 8; load 9 is inside the +20% band (9.6): hold.
+        reg = self._FixedLoad(9.0, ready=2)
+        with faults.injected("seed=0"):
+            out = self._scaler(kube, reg, tolerance=0.2).reconcile_once()
+        assert not out["applied"] and out["desired"] == 2
+
+    def test_scale_up_cooldown_gates_consecutive_ups(self):
+        kube = FakeKube()
+        self._deployment(kube, 1)
+        reg = self._FixedLoad(9.0, ready=1)
+        with faults.injected("seed=0") as inj:
+            scaler = self._scaler(kube, reg)
+            assert scaler.reconcile_once()["applied"]
+            reg.load = 30.0
+            out = scaler.reconcile_once()  # inside cooldown: held
+            assert not out["applied"]
+            inj.advance_clock(11)
+            out = scaler.reconcile_once()
+            assert out["applied"] and out["desired"] == 8  # max bound
+
+    def test_scale_down_waits_longer_cooldown(self):
+        kube = FakeKube()
+        self._deployment(kube, 4)
+        reg = self._FixedLoad(2.0, ready=4)
+        with faults.injected("seed=0") as inj:
+            scaler = self._scaler(kube, reg)
+            scaler._last_scale_t = faults.monotonic()
+            assert not scaler.reconcile_once()["applied"]
+            inj.advance_clock(11)  # past up-cooldown, not down
+            assert not scaler.reconcile_once()["applied"]
+            inj.advance_clock(60)
+            out = scaler.reconcile_once()
+            assert out["applied"] and out["desired"] == 1
+
+    def test_min_bound_holds_at_zero_load(self):
+        kube = FakeKube()
+        self._deployment(kube, 3)
+        reg = self._FixedLoad(0.0, ready=3)
+        with faults.injected("seed=0") as inj:
+            scaler = self._scaler(kube, reg, min_replicas=2)
+            inj.advance_clock(120)
+            out = scaler.reconcile_once()
+        assert out["desired"] == 2
+        assert kube.get_deployment("kf", "srv")["spec"]["replicas"] == 2
+
+    def test_scale_to_zero_supported_when_min_is_zero(self):
+        # Regression: the scale-down band guard degenerated to
+        # 0 >= 0 at current == 1, pinning a min_replicas=0 fleet at
+        # one replica forever.
+        kube = FakeKube()
+        self._deployment(kube, 1)
+        reg = self._FixedLoad(0.0, ready=1)
+        with faults.injected("seed=0") as inj:
+            scaler = self._scaler(kube, reg, min_replicas=0)
+            inj.advance_clock(120)
+            out = scaler.reconcile_once()
+        assert out["applied"] and out["desired"] == 0
+        assert kube.get_deployment("kf", "srv")["spec"]["replicas"] == 0
+
+    def test_scale_patch_is_level_triggered_idempotent(self):
+        kube = FakeKube()
+        self._deployment(kube, 1)
+        reg = self._FixedLoad(20.0, ready=1)
+        with faults.injected("seed=0") as inj:
+            scaler = self._scaler(kube, reg)
+            scaler.reconcile_once()
+            inj.advance_clock(60)
+            out = scaler.reconcile_once()  # same load, same answer
+        assert not out["applied"]
+        assert kube.get_deployment("kf", "srv")["spec"]["replicas"] == 5

@@ -180,6 +180,137 @@ class TestTransientRetry:
                 client.list_pods("ns1")
 
 
+class TestDeployments:
+    """apps/v1 Deployment slice (the fleet autoscaler's scale target)
+    over real sockets."""
+
+    def test_create_get_list_scale(self, served):
+        client, store = served
+        client.create_deployment({
+            "metadata": {"namespace": "ns1", "name": "srv",
+                         "labels": {"app": "srv"}},
+            "spec": {"replicas": 2}})
+        got = client.get_deployment("ns1", "srv")
+        assert got["spec"]["replicas"] == 2
+        assert len(client.list_deployments("ns1")) == 1
+        assert client.list_deployments(
+            "ns1", labels={"app": "other"}) == []
+        client.patch_deployment_scale("ns1", "srv", 5)
+        assert store.deployments[("ns1", "srv")]["spec"]["replicas"] \
+            == 5
+        # Idempotent re-apply (PATCH semantics): same answer, no error.
+        client.patch_deployment_scale("ns1", "srv", 5)
+        assert client.get_deployment("ns1", "srv")["spec"]["replicas"] \
+            == 5
+
+    def test_scale_of_missing_deployment_is_notfound(self, served):
+        client, _ = served
+        with pytest.raises(NotFound):
+            client.patch_deployment_scale("ns1", "ghost", 3)
+
+    def test_scale_patch_rides_transient_retry(self):
+        """PATCH is idempotent, so apiserver weather mid-scale is
+        retried — a lost scale-to-N response replays onto N."""
+        httpd, thread, store = make_fake_apiserver()
+        try:
+            client = HttpKube(
+                base_url=f"http://127.0.0.1:{httpd.server_address[1]}",
+                retries=2, retry_backoff_s=0.002)
+            client.create_deployment({
+                "metadata": {"namespace": "ns1", "name": "srv"},
+                "spec": {"replicas": 1}})
+            httpd.fail_queue.append(503)
+            client.patch_deployment_scale("ns1", "srv", 4)
+            assert store.deployments[
+                ("ns1", "srv")]["spec"]["replicas"] == 4
+            assert httpd.fail_queue == []
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+
+
+class TestRetryAfterHonored:
+    """Satellite: a server-supplied Retry-After/backoff hint overrides
+    the client's own jittered exponential schedule (capped)."""
+
+    @pytest.fixture()
+    def raw(self):
+        httpd, thread, store = make_fake_apiserver()
+        yield httpd, store
+        httpd.shutdown()
+        httpd.server_close()
+
+    def _client(self, httpd, **kw):
+        kw.setdefault("retries", 3)
+        kw.setdefault("retry_backoff_s", 0.002)
+        return HttpKube(
+            base_url=f"http://127.0.0.1:{httpd.server_address[1]}",
+            **kw)
+
+    def _recorded_sleeps(self, monkeypatch):
+        import kubeflow_tpu.operator.kube_http as mod
+
+        sleeps = []
+        real_time = mod.time
+
+        class _Time:
+            @staticmethod
+            def sleep(s):
+                sleeps.append(s)
+
+            def __getattr__(self, name):
+                return getattr(real_time, name)
+
+        monkeypatch.setattr(mod, "time", _Time())
+        return sleeps
+
+    def test_retry_after_header_overrides_local_schedule(
+            self, raw, monkeypatch):
+        httpd, store = raw
+        client = self._client(httpd, retry_backoff_s=0.001,
+                              retry_backoff_cap_s=10.0)
+        sleeps = self._recorded_sleeps(monkeypatch)
+        store.create_pod(_pod("ns1", "p0"))
+        httpd.fail_queue.append((503, "2.5"))
+        pods = client.list_pods("ns1")
+        assert [p["metadata"]["name"] for p in pods] == ["p0"]
+        # One backoff, driven by the server's 2.5s hint (±10% jitter),
+        # not the 1ms local schedule.
+        assert len(sleeps) == 1
+        assert 2.5 <= sleeps[0] <= 2.75 + 1e-9
+
+    def test_retry_after_hint_is_capped(self, raw, monkeypatch):
+        httpd, store = raw
+        client = self._client(httpd, retry_backoff_cap_s=0.05)
+        sleeps = self._recorded_sleeps(monkeypatch)
+        store.create_pod(_pod("ns1", "p0"))
+        httpd.fail_queue.append((503, "3600"))
+        client.list_pods("ns1")
+        # A hostile/confused hint cannot park the reconciler: capped.
+        assert len(sleeps) == 1 and sleeps[0] <= 0.055 + 1e-9
+
+    def test_429_is_retried_weather(self, raw):
+        httpd, store = raw
+        client = self._client(httpd)
+        store.create_pod(_pod("ns1", "p0"))
+        httpd.fail_queue.append((429, "0.001"))
+        pods = client.list_pods("ns1")
+        assert len(pods) == 1
+        assert httpd.fail_queue == []
+
+    def test_5xx_without_hint_keeps_local_jitter(self, raw,
+                                                 monkeypatch):
+        httpd, store = raw
+        client = self._client(httpd, retry_backoff_s=0.004)
+        sleeps = self._recorded_sleeps(monkeypatch)
+        store.create_pod(_pod("ns1", "p0"))
+        httpd.fail_queue.append(503)
+        client.list_pods("ns1")
+        # Full-jitter window of the LOCAL schedule: [0.5, 1.0] * base.
+        assert len(sleeps) == 1
+        assert 0.002 <= sleeps[0] <= 0.004 + 1e-9
+
+
 class TestReconcileOverHTTP:
     def test_full_job_lifecycle_through_real_sockets(self, served):
         """The SAME controller the in-memory tests drive, now with every

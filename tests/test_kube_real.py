@@ -143,11 +143,45 @@ class FakeCustomObjectsApi:
             raise ApiException(404, "nope") from None
 
 
+class FakeAppsV1Api:
+    """apps/v1 slice: deployments (the fleet autoscaler's target)."""
+
+    def __init__(self, state):
+        self.state = state
+
+    def create_namespaced_deployment(self, namespace, body):
+        key = (namespace, body["metadata"]["name"])
+        if key in self.state["deployments"]:
+            raise ApiException(409, "exists")
+        self.state["deployments"][key] = body
+        return body
+
+    def read_namespaced_deployment(self, name, namespace):
+        try:
+            return self.state["deployments"][(namespace, name)]
+        except KeyError:
+            raise ApiException(404, "nope") from None
+
+    def list_namespaced_deployment(self, namespace, label_selector=None):
+        items = [d for (ns, _), d in self.state["deployments"].items()
+                 if ns == namespace]
+        return types.SimpleNamespace(items=items)
+
+    def patch_namespaced_deployment(self, name, namespace, body):
+        try:
+            dep = self.state["deployments"][(namespace, name)]
+        except KeyError:
+            raise ApiException(404, "nope") from None
+        dep.setdefault("spec", {}).update(body.get("spec", {}))
+        return dep
+
+
 @pytest.fixture()
 def fake_kubernetes(monkeypatch):
     """Inject a minimal ``kubernetes`` module into sys.modules."""
     state: Dict[str, Any] = {"pods": {}, "services": {}, "custom": {},
-                             "events": [], "incluster": False}
+                             "deployments": {}, "events": [],
+                             "incluster": False}
 
     mod = types.ModuleType("kubernetes")
     config = types.SimpleNamespace()
@@ -164,6 +198,7 @@ def fake_kubernetes(monkeypatch):
 
     client = types.SimpleNamespace(
         CoreV1Api=lambda: FakeCoreV1Api(state),
+        AppsV1Api=lambda: FakeAppsV1Api(state),
         CustomObjectsApi=lambda: FakeCustomObjectsApi(state),
         rest=types.SimpleNamespace(ApiException=ApiException),
     )
@@ -217,6 +252,25 @@ class TestRealKubePods:
         # No labels -> no selector sent.
         rk.list_pods("kubeflow")
         assert state["last_selector"] is None
+
+
+class TestRealKubeDeployments:
+    def test_deployment_crud_and_scale(self, real_kube):
+        rk, state = real_kube
+        rk.create_deployment({
+            "metadata": {"name": "srv", "namespace": "kubeflow"},
+            "spec": {"replicas": 1}})
+        assert ("kubeflow", "srv") in state["deployments"]
+        assert rk.get_deployment(
+            "kubeflow", "srv")["spec"]["replicas"] == 1
+        assert len(rk.list_deployments("kubeflow")) == 1
+        rk.patch_deployment_scale("kubeflow", "srv", 4)
+        assert state["deployments"][
+            ("kubeflow", "srv")]["spec"]["replicas"] == 4
+        from kubeflow_tpu.operator.kube import NotFound
+
+        with pytest.raises(NotFound):
+            rk.patch_deployment_scale("kubeflow", "ghost", 2)
 
 
 class TestRealKubeServicesAndCustom:

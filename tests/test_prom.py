@@ -5,7 +5,12 @@ import urllib.request
 
 import numpy as np
 
-from kubeflow_tpu.runtime.prom import Registry, serve_metrics
+from kubeflow_tpu.runtime.prom import (
+    Registry,
+    parse_metrics,
+    sample_value,
+    serve_metrics,
+)
 
 
 class TestRegistry:
@@ -62,6 +67,115 @@ class TestRegistry:
         # Declared-idle series survives another label observing.
         assert 'h_count{shard="a"} 0' in text
         assert 'h_count{shard="b"} 1' in text
+
+
+class TestParseMetrics:
+    """parse_metrics is render's inverse for the three line shapes this
+    module emits — the fleet registry/autoscaler scrape path."""
+
+    def test_roundtrip_counter_gauge_histogram(self):
+        reg = Registry()
+        reg.counter("c_total", "c").inc(3, model="m")
+        reg.gauge("g", "g").set(7)
+        reg.gauge("g").set(2, model="m")
+        reg.histogram("h_seconds", "h").observe(0.2)
+        parsed = parse_metrics(reg.render())
+        assert sample_value(parsed, "c_total", model="m") == 3.0
+        assert sample_value(parsed, "g") == 7.0  # unlabeled first
+        assert sample_value(parsed, "g", model="m") == 2.0
+        assert sample_value(parsed, "h_seconds_count") == 1.0
+        assert sample_value(parsed, "missing") is None
+
+    def test_garbage_lines_skipped_not_fatal(self):
+        parsed = parse_metrics(
+            "# HELP x y\nnot a metric line !!\nx 1.5\nx{a=\"b\"} nan?\n")
+        assert parsed == {"x": [({}, 1.5)]}
+
+    def test_escaped_label_values_roundtrip(self):
+        reg = Registry()
+        reg.gauge("g", "").set(1, path='a"b\\c')
+        parsed = parse_metrics(reg.render())
+        labels, value = parsed["g"][0]
+        assert value == 1.0 and labels["path"] == 'a"b\\c'
+
+    def test_backslash_adjacent_escapes_roundtrip(self):
+        # Regression: sequential replace-based unescaping turned the
+        # rendered form of backslash+'n' (r'\\n') into
+        # backslash+newline.  Single-pass unescape must invert render
+        # exactly for every escape-adjacent pairing.
+        for value in ("C:\\new", "tab\\\\n", 'q\\"x', "a\nb\\"):
+            reg = Registry()
+            reg.gauge("g", "").set(1, path=value)
+            parsed = parse_metrics(reg.render())
+            labels, _ = parsed["g"][0]
+            assert labels["path"] == value, (value, labels)
+
+
+class TestServingLoadGauges:
+    """Satellite: in-flight/queue/readiness visible on /metrics (not
+    just the per-model :stats JSON), refreshed at scrape time."""
+
+    def test_refresh_gauges_exports_inflight_and_readiness(self):
+        from kubeflow_tpu.runtime import prom
+        from kubeflow_tpu.serving.model_server import (
+            LoadedModel,
+            ModelServer,
+        )
+
+        srv = ModelServer()
+        srv._models["m"] = {1: LoadedModel(
+            name="m", version=1, predict=lambda i: i, meta={})}
+        srv._inflight_by_model["m"] = 2
+        srv.enter_request()
+        srv.enter_request()
+        try:
+            srv.refresh_gauges()
+            parsed = parse_metrics(prom.REGISTRY.render())
+            assert sample_value(parsed, "kft_serving_inflight") == 2.0
+            assert sample_value(parsed, "kft_serving_inflight",
+                                model="m") == 2.0
+            assert sample_value(parsed, "kft_serving_queue_depth",
+                                model="m") == 0.0
+            assert sample_value(parsed, "kft_serving_ready") == 1.0
+            srv.begin_drain()
+            srv.refresh_gauges()
+            parsed = parse_metrics(prom.REGISTRY.render())
+            assert sample_value(parsed, "kft_serving_ready") == 0.0
+        finally:
+            srv.exit_request()
+            srv.exit_request()
+
+    def test_metrics_route_refreshes_before_render(self):
+        import json
+
+        from kubeflow_tpu.runtime import prom
+        from kubeflow_tpu.serving.http import make_http_server
+        from kubeflow_tpu.serving.model_server import (
+            LoadedModel,
+            ModelServer,
+        )
+
+        srv = ModelServer()
+        srv._models["m"] = {1: LoadedModel(
+            name="m", version=1, predict=lambda i: i, meta={})}
+        httpd, _ = make_http_server(srv, port=0, host="127.0.0.1")
+        try:
+            port = httpd.server_address[1]
+            srv.enter_request()  # a real request mid-parse
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics",
+                    timeout=30) as resp:
+                parsed = parse_metrics(resp.read().decode())
+            srv.exit_request()
+            # The scrape saw the live in-flight request — proof the
+            # refresh ran at render time — and the scrape ITSELF is
+            # not counted (probe routes skip the in-flight bracket, or
+            # every scrape would feed the autoscaler phantom load).
+            assert sample_value(parsed,
+                                "kft_serving_inflight") == 1.0
+            assert sample_value(parsed, "kft_serving_ready") == 1.0
+        finally:
+            httpd.shutdown()
 
 
 class TestServeMetrics:

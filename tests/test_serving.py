@@ -367,6 +367,92 @@ class TestGRPC:
         finally:
             server.stop(0)
 
+    def test_health_check_mirrors_readyz(self, exported):
+        """grpc.health.v1 Check parity with /readyz: SERVING with a
+        model loaded, NOT_SERVING once a drain begins — so the fleet
+        router can probe gRPC-only replicas (satellite of the fleet
+        control plane)."""
+        from kubeflow_tpu.serving.grpc_server import (
+            PredictionClient,
+            check_health,
+            make_grpc_server,
+        )
+
+        base, _, _ = exported
+        srv = ModelServer()
+        srv.add_model("tiny", str(base))
+        server = make_grpc_server(srv, port=0, host="127.0.0.1")
+        try:
+            target = f"127.0.0.1:{server.bound_port}"
+            assert check_health(target) is True
+            client = PredictionClient(target)
+            assert client.ready() is True
+            srv.begin_drain()  # /readyz flips 503 -> Check NOT_SERVING
+            assert client.ready() is False
+            assert check_health(target) is False
+            client.close()
+        finally:
+            server.stop(0)
+            srv._draining.clear()
+
+    def test_health_check_unreachable_is_false_not_raise(self):
+        from kubeflow_tpu.serving.grpc_server import check_health
+
+        # A probe's job is a verdict: no listener -> False.
+        assert check_health("127.0.0.1:1", timeout=0.5) is False
+
+
+class TestRetryCallHonorsServerHint:
+    def test_overloaded_waits_server_retry_after(self):
+        import random
+
+        from kubeflow_tpu.serving.grpc_server import retry_call
+        from kubeflow_tpu.serving.model_server import Overloaded
+
+        sleeps = []
+        calls = []
+
+        def fn():
+            calls.append(1)
+            if len(calls) < 3:
+                raise Overloaded("full", retry_after_s=2.0)
+            return "ok"
+
+        out = retry_call(fn, retries=3, backoff_s=0.001,
+                         backoff_cap_s=10.0, rng=random.Random(0),
+                         sleep=sleeps.append)
+        assert out == "ok" and len(calls) == 3
+        # Both waits came from the server's 2.0s hint (±10% jitter),
+        # not the 1ms local schedule.
+        assert all(2.0 <= s <= 2.2 + 1e-9 for s in sleeps), sleeps
+
+    def test_hint_capped_and_deadline_never_retried(self):
+        import random
+
+        from kubeflow_tpu.serving.errors import DeadlineExceeded
+        from kubeflow_tpu.serving.grpc_server import retry_call
+        from kubeflow_tpu.serving.model_server import Overloaded
+
+        sleeps = []
+
+        def overloaded():
+            raise Overloaded("full", retry_after_s=3600.0)
+
+        with pytest.raises(Overloaded):
+            retry_call(overloaded, retries=1, backoff_cap_s=0.05,
+                       rng=random.Random(0), sleep=sleeps.append)
+        assert sleeps and sleeps[0] <= 0.055 + 1e-9  # capped hint
+
+        calls = []
+
+        def expired():
+            calls.append(1)
+            raise DeadlineExceeded("spent")
+
+        with pytest.raises(DeadlineExceeded):
+            retry_call(expired, retries=5, sleep=sleeps.append)
+        assert len(calls) == 1  # the deadline is spent; no retry
+
 
 class TestLoaderAllowlist:
     """model.json is producer-controlled: loader resolution must not

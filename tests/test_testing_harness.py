@@ -7,6 +7,7 @@ import pytest
 from kubeflow_tpu.testing.e2e import (
     engine_smoke,
     fault_injection_smoke,
+    fleet_smoke,
     serving_smoke,
     tpujob_smoke,
 )
@@ -77,6 +78,14 @@ class TestE2EDrivers:
         # circuit-break with last-good serving, graceful drain, and
         # kft_* metric visibility of every outcome.
         fault_injection_smoke()
+
+    def test_fleet_smoke(self):
+        # The ci/e2e_config.yaml hermetic `fleet` step: router + 3
+        # in-process replicas + fake apiserver — scale-out under
+        # open-loop load, replica kill -> ejection -> recovery, and a
+        # drain-aware rolling restart with zero lost accepted
+        # requests (see kubeflow_tpu/testing/e2e.py fleet_smoke).
+        fleet_smoke()
 
 
 class _FakeKubectl:
