@@ -149,6 +149,12 @@ class EndpointState:
         self.inflight = 0.0
         self.queue_depth = 0.0
         self.local_inflight = 0
+        # Prefix-cache effectiveness scraped off the replica's
+        # kft_serving_cached_token_ratio gauge — not a routing signal
+        # (cache hits don't make a replica "less loaded" in queue
+        # terms), but the per-replica number operators read off
+        # `kubeflow-tpu fleet status` to see cache health fleet-wide.
+        self.cached_token_ratio = 0.0
         self._consecutive_failures = 0
         self._eject_threshold = max(1, int(eject_threshold))
         self.breaker = breaker
@@ -295,6 +301,7 @@ class EndpointRegistry:
         self._rng = rng or random.Random()
         self._lock = threading.Lock()
         self._states: Dict[str, EndpointState] = {}
+        self._ratio_exported: set = set()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         # Ejection hook: the router hangs its connection-pool purge
@@ -440,9 +447,14 @@ class EndpointRegistry:
         inflight = sample_value(parsed, "kft_serving_inflight") or 0.0
         queue = sum(v for _, v in
                     parsed.get("kft_serving_queue_depth", ()))
+        # The unlabeled aggregate sorts first in the rendered series;
+        # replicas without a decode engine simply lack the metric.
+        ratio = sample_value(parsed, "kft_serving_cached_token_ratio")
         with state._lock:
             state.inflight = inflight
             state.queue_depth = queue
+            if ratio is not None:
+                state.cached_token_ratio = ratio
 
     def _export_gauges(self) -> None:
         counts: Dict[str, int] = {}
@@ -453,6 +465,21 @@ class EndpointRegistry:
         for label in ("routable", "draining", "ejected", "down",
                       "not_ready"):
             gauge.set(counts.get(label, 0), state=label)
+        # Per-replica cache effectiveness on the ROUTER's /metrics too:
+        # one scrape of the router shows the whole fleet's hit rates.
+        # Departed replicas' series are zeroed (the engine-close
+        # convention) — the prom registry has no series removal, and a
+        # scaled-down pod's last ratio must not render as live forever.
+        ratio = REGISTRY.gauge(
+            "kft_router_cached_token_ratio",
+            "per-replica engine prefix-cache hit ratio, by endpoint")
+        current = set()
+        for state in self.all():
+            current.add(state.name)
+            ratio.set(state.cached_token_ratio, endpoint=state.name)
+        for name in self._ratio_exported - current:
+            ratio.set(0.0, endpoint=name)
+        self._ratio_exported = current
 
     # -- router/autoscaler surface ----------------------------------------
 
@@ -487,6 +514,7 @@ class EndpointRegistry:
                     "inflight": s.inflight,
                     "queue_depth": s.queue_depth,
                     "local_inflight": s.local_inflight,
+                    "cached_token_ratio": s.cached_token_ratio,
                     "breaker_failures": s.breaker.failures,
                 })
         return out

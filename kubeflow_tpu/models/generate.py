@@ -389,7 +389,7 @@ def generate(
 
 
 # ---------------------------------------------------------------------------
-# Continuous-batching slot engine: two jitted programs over a PERSISTENT
+# Continuous-batching slot engine: jitted programs over a PERSISTENT
 # slot-based KV cache (serving/engine.py drives them).
 #
 # generate() is one program per (batch, bucket) that owns its rows from
@@ -398,17 +398,28 @@ def generate(
 # These entry points split that lifecycle so a serving loop can interleave
 # admission with decode:
 #
-#   prefill_into_slot  one request's prompt -> slot `slot` of the cache
-#   decode_step        ALL live slots advance one token, each at its OWN
-#                      length (per-row rope position, per-row causal
-#                      frontier, per-row cache column scatter)
+#   prefill_chunk_into_slot  EXTEND a slot's KV by a static chunk width
+#                            starting at a traced offset — the serving
+#                            loop splits long prompts into chunks and
+#                            schedules them BETWEEN decode steps, so an
+#                            arriving prompt can never stall in-flight
+#                            decode for longer than one chunk
+#   copy_prefix_into_slot    copy the first k cached columns from a
+#                            donor prefix-pool entry into a slot on
+#                            device (shared-prefix KV reuse) and freeze
+#                            the slot until chunked prefill finishes
+#   decode_step              ALL live slots advance one token, each at
+#                            its OWN length (per-row rope position,
+#                            per-row causal frontier, per-row cache
+#                            column scatter)
 #
-# Static shapes throughout: slot count, prefill width, and max_len are
-# fixed at engine construction, so the whole serving lifetime compiles
-# exactly two programs.  Retirement is a device-side `done` flag (a slot
-# that hits its stop length or EOS stops advancing and drops its cache
-# writes), so freeing + reusing a slot needs no third program — the next
-# prefill_into_slot simply overwrites it.
+# Static shapes throughout: slot count, chunk width, pool geometry, and
+# max_len are fixed at engine construction, so the whole serving
+# lifetime compiles exactly three programs (chunked prefill, prefix
+# copy, step).  Retirement is a device-side `done` flag (a slot that
+# hits its stop length or EOS stops advancing and drops its cache
+# writes), so freeing + reusing a slot needs no extra program — the
+# next admission's copy_prefix_into_slot freezes and overwrites it.
 # ---------------------------------------------------------------------------
 
 
@@ -435,97 +446,6 @@ def init_slot_state(cfg: TransformerConfig, slots: int, max_len: int,
     }
 
 
-def _insert_slot_cache(big, small, row, slot, width):
-    """Copy row `row` of a [L, A, width, ...] prefill cache into slot
-    `slot` of the persistent [L, slots, max_len, ...] cache
-    (QTensor-aware).  An out-of-range slot drops the write — that is
-    how unused admission rows of a partially-filled prefill batch
-    become no-ops."""
-    def ins(b, s):
-        return b.at[:, slot, :width].set(s[:, row].astype(b.dtype),
-                                         mode="drop")
-
-    if isinstance(big, QTensor):
-        return QTensor(ins(big.values, small.values),
-                       ins(big.scale, small.scale), big.axes)
-    return ins(big, small)
-
-
-@partial(jax.jit, static_argnums=(0, 3), donate_argnums=(2,))
-def prefill_into_slot(
-    cfg: TransformerConfig,
-    params,
-    state,
-    decode: DecodeConfig,
-    tokens: jax.Array,
-    prompt_len: jax.Array,
-    new_tokens: jax.Array,
-    slot: jax.Array,
-    seed: jax.Array,
-):
-    """Prefill up to A requests into their slots; returns
-    (state, first sampled token per admission row [A]).
-
-    tokens [A, prefill_width]: each row one prompt RIGHT-padded to the
-    engine's static prefill width — causal attention means pad
-    positions can only influence pad positions, so the real prefix
-    computes exactly as it would alone, and the garbage k/v written
-    beyond prompt_len is masked by every later per-row causal frontier
-    until decode writes overtake it column by column.  Right padding
-    (vs generate()'s left padding) is what lets every decode step run
-    pad-free: position i always sits at cache column i, so a slot's
-    per-step KV frontier is its OWN length, never a bucket's.
-
-    A (the admission width) is static and fixed per engine, so this
-    stays ONE compiled program; a call with fewer than A pending
-    requests pads the rest with out-of-range slots, whose writes every
-    scatter drops.  prompt_len/new_tokens/slot/seed are [A] vectors:
-    real token count, per-REQUEST completion budget (the static batcher
-    bakes max_new_tokens into the program — here it is data), target
-    slot, and per-request sampling seed.  A long prompt on a
-    flash-configured model flash-prefills exactly as generate() does
-    (the temp cache is empty, so the static-prefill gate holds).
-    """
-    a, prefill_width = tokens.shape
-    tmp = init_cache(cfg, a, prefill_width, decode.kv_cache_dtype)
-    logits, (tk, tv) = _forward_with_cache(cfg, params, tokens, tmp, 0)
-    last = jnp.take_along_axis(
-        logits, (prompt_len - 1)[:, None, None], axis=1)[:, 0]  # [A, V]
-    # Old-style uint32[2] keys (what a 32-bit jax.random.PRNGKey
-    # builds), stacked per admission row.
-    useed = seed.astype(jnp.uint32)
-    keys = jnp.stack([jnp.zeros_like(useed), useed], axis=-1)
-    split = jax.vmap(jax.random.split)(keys)
-    keys, subs = split[:, 0], split[:, 1]
-    if decode.temperature <= 0.0:
-        tok = jnp.argmax(last, axis=-1)
-    else:
-        tok = jax.vmap(jax.random.categorical)(
-            subs, _filter_logits(decode, last))
-    tok = tok.astype(jnp.int32)
-    # stop_len = length at which no further sampling is needed: after a
-    # step the slot has emitted (lengths - prompt_len + 1) tokens, so
-    # emitted >= new_tokens  <=>  lengths >= prompt_len + new_tokens - 1.
-    stop = prompt_len + jnp.maximum(new_tokens, 1) - 1
-    done = new_tokens <= 1
-    if decode.eos_token >= 0:
-        done = done | (tok == decode.eos_token)
-    ck, cv = state["cache_k"], state["cache_v"]
-    for row in range(a):  # static unroll: one scatter per admission row
-        ck = _insert_slot_cache(ck, tk, row, slot[row], prefill_width)
-        cv = _insert_slot_cache(cv, tv, row, slot[row], prefill_width)
-    state = dict(state)
-    state["cache_k"], state["cache_v"] = ck, cv
-    state["lengths"] = state["lengths"].at[slot].set(
-        prompt_len, mode="drop")
-    state["stop_len"] = state["stop_len"].at[slot].set(stop, mode="drop")
-    state["last_token"] = state["last_token"].at[slot].set(
-        tok, mode="drop")
-    state["done"] = state["done"].at[slot].set(done, mode="drop")
-    state["keys"] = state["keys"].at[slot].set(keys, mode="drop")
-    return state, tok
-
-
 @partial(jax.jit, static_argnums=(0, 3, 4), donate_argnums=(2,))
 def decode_step(cfg: TransformerConfig, params, state,
                 decode: DecodeConfig, steps: int = 1):
@@ -542,7 +462,7 @@ def decode_step(cfg: TransformerConfig, params, state,
     per-call dispatch and runtime overhead amortize over k tokens at
     the cost of k-token admission granularity (slots finishing mid-call
     freeze via `done` on device, so at most k-1 slot-steps idle).  One
-    engine uses ONE value, so the two-program guarantee holds.
+    engine uses ONE value, so the three-program guarantee holds.
     """
     def one(state, _):
         lengths, done = state["lengths"], state["done"]
@@ -585,3 +505,203 @@ def decode_step(cfg: TransformerConfig, params, state,
         return state, toks[None]
     state, toks = jax.lax.scan(one, state, None, length=steps)
     return state, toks
+
+
+def init_prefix_pool(cfg: TransformerConfig, blocks: int, pool_len: int,
+                     kv_cache_dtype: str = "model"):
+    """Donor KV pool for shared-prefix reuse: ``blocks`` rows of
+    ``pool_len`` cache columns each, same layout and dtype as the slot
+    cache.  A row is filled as a side effect of chunked prefill (the
+    chunk program dual-writes its fresh k/v) and copied into new slots
+    by ``copy_prefix_into_slot``; which row holds which token-prefix is
+    host-side bookkeeping (serving/prefix_cache.py)."""
+    return init_cache(cfg, blocks, pool_len, kv_cache_dtype)
+
+
+def _slot_row(c, slot):
+    """Slice row ``slot`` (traced) of a [L, rows, cols, ...] cache as a
+    [L, 1, cols, ...] batch (QTensor-aware)."""
+    def take(b):
+        return jax.lax.dynamic_slice_in_dim(b, slot, 1, axis=1)
+
+    if isinstance(c, QTensor):
+        return QTensor(take(c.values), take(c.scale), c.axes)
+    return take(c)
+
+
+def _put_slot_row(big, small, slot):
+    """Write a [L, 1, cols, ...] batch back into row ``slot`` (traced)
+    of the big cache (QTensor-aware).  ``slot`` is always in range on
+    this path — the engine never chunk-prefills an out-of-range slot."""
+    def put(b, s):
+        return jax.lax.dynamic_update_slice_in_dim(
+            b, s.astype(b.dtype), slot, axis=1)
+
+    if isinstance(big, QTensor):
+        return QTensor(put(big.values, small.values),
+                       put(big.scale, small.scale), big.axes)
+    return put(big, small)
+
+
+def _masked_prefix_copy(big, pool_c, entry, slot, k):
+    """big[:, slot, col] = pool_c[:, entry, col] for col < k (traced k;
+    k = 0 copies nothing).  ``entry`` may be any value when k = 0 — the
+    gather clamps and the mask discards whatever it read."""
+    def one(b, p):
+        row = jax.lax.dynamic_slice_in_dim(p, entry, 1, axis=1)
+        pool_len = row.shape[2]
+        cur = jax.lax.dynamic_slice(
+            b, (0, slot) + (0,) * (b.ndim - 2),
+            (b.shape[0], 1, pool_len) + b.shape[3:])
+        mask = (jnp.arange(pool_len) < k).reshape(
+            (1, 1, pool_len) + (1,) * (b.ndim - 3))
+        new = jnp.where(mask, row.astype(b.dtype), cur)
+        return jax.lax.dynamic_update_slice(
+            b, new, (0, slot) + (0,) * (b.ndim - 2))
+
+    if isinstance(big, QTensor):
+        return QTensor(one(big.values, pool_c.values),
+                       one(big.scale, pool_c.scale), big.axes)
+    return one(big, pool_c)
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def copy_prefix_into_slot(state, pool, entry, slot, k):
+    """Resume-from-cached-prefix admission, step 1 of 2: copy the first
+    ``k`` cache columns of donor pool row ``entry`` into slot ``slot``
+    and FREEZE the slot (``done`` = True) until chunked prefill
+    completes it.
+
+    The freeze is load-bearing even at k = 0 (no cached prefix): a slot
+    freed by mid-generation deadline expiry still has ``done`` = False
+    on device, so without this write the interleaved decode_step would
+    keep advancing the dead occupant and scatter garbage into columns
+    the chunked prefill is about to own.  The engine therefore
+    dispatches this program for EVERY admission, cached prefix or not —
+    claim, freeze, and copy are one device call.
+
+    Columns in [k, pool_len) of the slot keep whatever they held; they
+    sit beyond the resumed causal frontier, so every later attention
+    masks them until chunk writes overtake them column by column —
+    the same argument that makes right-padded one-shot prefill sound.
+    """
+    state = dict(state)
+    pool_k, pool_v = pool
+    state["cache_k"] = _masked_prefix_copy(
+        state["cache_k"], pool_k, entry, slot, k)
+    state["cache_v"] = _masked_prefix_copy(
+        state["cache_v"], pool_v, entry, slot, k)
+    state["done"] = state["done"].at[slot].set(True)
+    return state
+
+
+@partial(jax.jit, static_argnums=(0, 3), donate_argnums=(2, 4))
+def prefill_chunk_into_slot(
+    cfg: TransformerConfig,
+    params,
+    state,
+    decode: DecodeConfig,
+    pool,
+    tokens: jax.Array,
+    start: jax.Array,
+    prompt_len: jax.Array,
+    new_tokens: jax.Array,
+    slot: jax.Array,
+    pool_row: jax.Array,
+    seed: jax.Array,
+):
+    """Extend slot ``slot``'s KV by one static-width chunk of prompt
+    starting at traced cache offset ``start``; returns
+    (state, pool, first sampled token [1]).
+
+    tokens [1, chunk_w]: the prompt's tokens [start, start + chunk_w),
+    right-padded past ``prompt_len`` on the final chunk.  The chunk's
+    queries attend over the slot's whole cache row under the causal
+    frontier ``start`` (the same ``cache_len``-gated attention path the
+    decode scan uses with a traced offset), so earlier chunks' — or a
+    copied donor prefix's — k/v participate exactly as if the prompt
+    had prefilled in one call, and garbage columns at/after start +
+    chunk_w stay masked.  Chunk width is static and fixed per engine,
+    so every admission, resumed at any offset, reuses ONE compiled
+    program; the serving loop schedules these calls between decode
+    steps under a token budget, which is what bounds how long an
+    arriving prompt can stall in-flight decode.
+
+    On the final chunk (start + chunk_w >= prompt_len, decided on
+    device) the program samples the request's first token from the
+    last real prompt position and arms the slot's scalars (lengths /
+    stop_len / last_token / done / keys — what decode_step needs to
+    advance the slot); intermediate chunks leave the slot frozen
+    (``done`` = True, set by copy_prefix_into_slot at claim and
+    re-asserted here) and park the scalar writes out of range.
+
+    ``pool_row``: donor-capture target — the chunk's fresh k/v are
+    also scattered into that prefix-pool row at the same columns, so
+    building a donor entry costs no extra pass; an out-of-range row
+    (or columns beyond the pool width) drops the write.
+    """
+    slots_n = state["done"].shape[0]
+    w = tokens.shape[1]
+    ck = _slot_row(state["cache_k"], slot)
+    cv = _slot_row(state["cache_v"], slot)
+    logits, (ck, cv) = _forward_with_cache(
+        cfg, params, tokens, (ck, cv), start)
+    # First-token sampling from the last REAL prompt position of this
+    # chunk (only meaningful on the final chunk; clamped otherwise).
+    idx = jnp.clip(prompt_len - 1 - start, 0, w - 1)
+    last = jnp.take_along_axis(
+        logits, jnp.reshape(idx, (1, 1, 1)), axis=1)[:, 0]  # [1, V]
+    useed = jnp.reshape(seed, (1,)).astype(jnp.uint32)
+    keys = jnp.stack([jnp.zeros_like(useed), useed], axis=-1)
+    split = jax.vmap(jax.random.split)(keys)
+    keys, subs = split[:, 0], split[:, 1]
+    if decode.temperature <= 0.0:
+        tok = jnp.argmax(last, axis=-1)
+    else:
+        tok = jax.vmap(jax.random.categorical)(
+            subs, _filter_logits(decode, last))
+    tok = tok.astype(jnp.int32)
+
+    is_last = (start + w) >= prompt_len
+    final_slot = jnp.where(is_last, slot, slots_n)  # OOB mid-prefill
+    stop = prompt_len + jnp.maximum(new_tokens, 1) - 1
+    done_final = new_tokens <= 1
+    if decode.eos_token >= 0:
+        done_final = done_final | (tok[0] == decode.eos_token)
+
+    # Donor capture: scatter this chunk's fresh k/v into the pool row
+    # at the same columns.  mode="drop" makes both "no capture" (row
+    # out of range) and "prefix longer than the pool width" (columns
+    # out of range) silent no-ops.
+    cols = start + jnp.arange(w)
+    pool_k, pool_v = pool
+
+    def capture(pool_c, row_c):
+        def cap(p, s):
+            blk = jnp.take(s[:, 0], cols, axis=1)  # [L, w, ...]
+            return p.at[:, pool_row, cols].set(
+                blk.astype(p.dtype), mode="drop")
+
+        if isinstance(pool_c, QTensor):
+            return QTensor(cap(pool_c.values, row_c.values),
+                           cap(pool_c.scale, row_c.scale), pool_c.axes)
+        return cap(pool_c, row_c)
+
+    pool_k = capture(pool_k, ck)
+    pool_v = capture(pool_v, cv)
+
+    state = dict(state)
+    state["cache_k"] = _put_slot_row(state["cache_k"], ck, slot)
+    state["cache_v"] = _put_slot_row(state["cache_v"], cv, slot)
+    state["done"] = state["done"].at[slot].set(True)
+    state["done"] = state["done"].at[final_slot].set(
+        done_final, mode="drop")
+    state["lengths"] = state["lengths"].at[final_slot].set(
+        prompt_len, mode="drop")
+    state["stop_len"] = state["stop_len"].at[final_slot].set(
+        stop, mode="drop")
+    state["last_token"] = state["last_token"].at[final_slot].set(
+        tok[0], mode="drop")
+    state["keys"] = state["keys"].at[final_slot].set(
+        keys[0], mode="drop")
+    return state, (pool_k, pool_v), tok

@@ -8,21 +8,31 @@ program, and every row pays the batch bucket's padded KV span on every
 decode step (models/generate.py's docstring measures ~6x wasted decode
 compute on wide length distributions).
 
-This engine runs the slot entry points instead (models/generate.py:
-``prefill_into_slot`` / ``decode_step``) over ONE persistent KV cache of
-``slots`` rows:
+This engine runs the slot entry points instead (models/generate.py)
+over ONE persistent KV cache of ``slots`` rows:
 
   - a dedicated step loop advances all live slots one token per
     ``decode_step`` call;
-  - new requests are admitted into free slots BETWEEN steps (prefill
-    interleaved with decode) — admission latency is one step, not one
-    generation;
+  - new requests are admitted into free slots BETWEEN steps, and their
+    prompts prefill in **static-width chunks scheduled between decode
+    steps** under a per-step token budget (``prefill_chunk_tokens``) —
+    a long arriving prompt can never stall in-flight decode for longer
+    than one chunk's compute, where a one-shot full-width prefill
+    stalls every active slot for the whole prompt;
+  - admission first resumes from the **longest cached shared prefix**:
+    a host-side block-hashed index (serving/prefix_cache.py) over a
+    small pinned pool of donor KV rows finds the longest token-block
+    prefix a previous prompt already computed, ``copy_prefix_into_slot``
+    copies those columns on device, and chunked prefill continues from
+    there — TTFT scales with the *uncached suffix* length, not the full
+    prompt (the win for fleets of chat requests sharing a system
+    prompt);
   - finished rows retire immediately (device-side ``done`` flag) and
     their slots are reused — no request ever waits for the batch to
     drain, and per-request ``max_new_tokens`` is data, not a compiled
     constant;
   - every shape is static, so the engine's whole lifetime compiles
-    exactly two programs (prefill, step).
+    exactly three programs (chunked prefill, prefix copy, step).
 
 The host loop reads sampled tokens with a small LAG (``sync_lag``
 steps): step N+lag is dispatched before step N's tokens are
@@ -57,12 +67,30 @@ from kubeflow_tpu.serving.model_server import (
     SHED_TOTAL,
     locked_snapshot,
 )
+from kubeflow_tpu.serving.prefix_cache import PrefixIndex
 from kubeflow_tpu.testing import faults
 
 # Step-duration histogram buckets: decode steps run ~0.1 ms (tiny CPU
 # smoke models) to ~100 ms (big models over a slow tunnel).
 _STEP_BUCKETS = (.0005, .001, .0025, .005, .01, .025, .05, .1, .25, .5,
                  1.0, 2.5)
+
+PREFIX_HITS_TOTAL = "kft_engine_prefix_hits_total"
+PREFIX_HITS_HELP = "admissions resumed from a cached prefix, by engine"
+PREFIX_MISSES_TOTAL = "kft_engine_prefix_misses_total"
+PREFIX_MISSES_HELP = "admissions with no cached prefix, by engine"
+PREFIX_EVICTIONS_TOTAL = "kft_engine_prefix_evictions_total"
+PREFIX_EVICTIONS_HELP = "donor prefix-pool rows evicted (LRU), by engine"
+PREFILL_CHUNKS_TOTAL = "kft_engine_prefill_chunks_total"
+PREFILL_CHUNKS_HELP = "prefill chunk program calls, by engine"
+
+
+def _true_token_len(row: np.ndarray) -> int:
+    """Real prompt length of a 1-D token row: trailing pad ids (token
+    0, the framework-wide pad convention) do not count.  An all-pad row
+    keeps its full width — there is no basis to trim it."""
+    nz = np.flatnonzero(row)
+    return int(nz[-1]) + 1 if nz.size else int(row.shape[0])
 
 
 class DecodeEngine:
@@ -72,7 +100,9 @@ class DecodeEngine:
       cfg/params/decode: the loaded model (loaders.lm_generate exposes
         them as ``predict.engine_spec`` — params already staged to HBM).
       slots: concurrent sequences (the persistent cache's row count).
-      prefill_len: static prompt width; prompts are right-padded to it.
+      prefill_len: static prompt width bound; prompts with more REAL
+        tokens (trailing pad ids don't count) fall back to the direct
+        generate() path.
       max_len: cache columns per slot (default prefill_len +
         decode.max_new_tokens).
       sync_lag: how many step calls the host may run ahead of token
@@ -80,12 +110,26 @@ class DecodeEngine:
       steps_per_call: decode steps fused into one step-program call
         (models/generate.py decode_step's static ``steps``): per-call
         dispatch overhead amortizes over k tokens, admission waits at
-        most k steps.  One engine uses one value, so the two-program
+        most k steps.  One engine uses one value, so the three-program
         guarantee holds either way.
-      admit_width: prefill program admission rows (static) — up to this
-        many queued requests prefill in ONE call; a burst of arrivals
-        amortizes per-call overhead instead of paying one serialized
-        prefill per request.  Unused rows are dropped on device.
+      admit_width: how many admissions may be MID-PREFILL concurrently
+        — further queued requests wait even when slots are free, so a
+        burst of long prompts cannot hoard every slot in a half-filled
+        state.  Chunk scheduling among the admitted set is FIFO (the
+        oldest admission takes the whole budget until it finishes —
+        best TTFT for the head of the line).
+      prefill_chunk_tokens: per-step prefill token budget AND the
+        static chunk program width (clamped to prefill_len): between
+        two decode steps the loop spends at most this many prompt
+        tokens on chunked prefill, which bounds the inter-token latency
+        of in-flight slots regardless of arriving prompt length.
+      prefix_pool_blocks: donor rows in the shared-prefix KV pool
+        (0 disables prefix caching; chunked prefill still applies).
+        Each row holds up to prefill_len cached columns and is filled
+        as a free side effect of a cache-miss admission's chunked
+        prefill, then reused by later admissions sharing the prefix.
+      prefix_block_tokens: prefix hash/publish granularity — prefixes
+        are cached and matched in multiples of this many tokens.
       max_queue_depth: bounded admission — a submit arriving with this
         many requests already waiting for slots fails fast with
         Overloaded (HTTP 429 / gRPC RESOURCE_EXHAUSTED) instead of
@@ -108,11 +152,17 @@ class DecodeEngine:
         sync_lag: int = 2,
         steps_per_call: int = 1,
         admit_width: int = 4,
+        prefill_chunk_tokens: int = 64,
+        prefix_pool_blocks: int = 4,
+        prefix_block_tokens: int = 16,
         max_queue_depth: int = 0,
         overload_retry_after_s: float = 1.0,
         name: str = "engine",
     ):
-        from kubeflow_tpu.models.generate import init_slot_state
+        from kubeflow_tpu.models.generate import (
+            init_prefix_pool,
+            init_slot_state,
+        )
         from kubeflow_tpu.runtime.prom import REGISTRY
 
         if slots < 1:
@@ -142,19 +192,37 @@ class DecodeEngine:
         self.sync_lag = max(0, int(sync_lag))
         self.steps_per_call = max(1, int(steps_per_call))
         self.admit_width = max(1, min(int(admit_width), slots))
+        self.prefill_chunk_tokens = max(1, int(prefill_chunk_tokens))
+        self.chunk_w = min(self.prefill_chunk_tokens, self.prefill_len)
+        self.prefix_pool_blocks = max(0, int(prefix_pool_blocks))
+        self.prefix_block_tokens = max(1, int(prefix_block_tokens))
         self.max_queue_depth = max(0, int(max_queue_depth))
         self.overload_retry_after_s = overload_retry_after_s
         self._eos = decode.eos_token >= 0
         self._state = init_slot_state(cfg, slots, self.max_len,
                                       decode.kv_cache_dtype)
+        # Donor prefix pool: allocated even when caching is off (one
+        # row) so the chunk/copy programs keep one static shape — the
+        # copy program's slot FREEZE is load-bearing for admission
+        # safety regardless of caching (see copy_prefix_into_slot).
+        self._pool_rows = max(1, self.prefix_pool_blocks)
+        self._pool = init_prefix_pool(cfg, self._pool_rows,
+                                      self.prefill_len,
+                                      decode.kv_cache_dtype)
+        self._index = (
+            PrefixIndex(self.prefix_pool_blocks,
+                        self.prefix_block_tokens, self.prefill_len)
+            if self.prefix_pool_blocks > 0 else None)
         # AOT executables, built lazily by the loop thread: the step
-        # loop calls its two programs thousands of times per second,
-        # and the jitted wrapper re-hashes the whole params pytree
+        # loop calls its programs thousands of times per second, and
+        # the jitted wrapper re-hashes the whole params pytree
         # signature per call (~0.4 ms on the smoke config — comparable
         # to the step itself).  lower().compile() once, then call the
-        # executable.  This is also the two-program guarantee made
-        # literal: these two fields ARE the engine's compiled programs.
-        self._prefill_exec = None
+        # executable.  This is also the three-program guarantee made
+        # literal: these three fields ARE the engine's compiled
+        # programs.
+        self._chunk_exec = None
+        self._copy_exec = None
         self._step_exec = None
 
         self._lock = threading.Lock()
@@ -164,6 +232,10 @@ class DecodeEngine:
         self._drain_deadline: Optional[float] = None
         # Host-side slot table: None = free, else the live request entry.
         self._slot_req: List[Optional[dict]] = [None] * slots
+        # Admitted entries whose prompts are still chunk-prefilling
+        # (FIFO — the oldest admission finishes first, best TTFT).
+        # Loop-thread-owned; the admission pop reads only its length.
+        self._prefilling: List[dict] = []
         # (tokens_array, [(slot, entry), ...]) emissions not yet read.
         self._pending: List[tuple] = []
         # Counters (mutated by the loop thread, snapshotted under the
@@ -172,8 +244,14 @@ class DecodeEngine:
             "requests": 0, "tokens": 0, "steps": 0, "prefills": 0,
             "occupancy_sum": 0, "busy_s": 0.0, "in_flight": 0,
             "shed": 0, "expired": 0,
+            "prefix_hits": 0, "prefix_misses": 0, "prefix_evictions": 0,
+            "prefill_chunks": 0, "cached_tokens": 0, "prompt_tokens": 0,
         }
-        self._step_times: List[float] = []   # bounded reservoir
+        self._step_times: List[float] = []   # bounded reservoirs
+        self._chunk_times: List[float] = []
+        self._gap_times: List[float] = []
+        self._ttft_times: List[float] = []
+        self._last_step_end: Optional[float] = None
         self._metric_name = name
         self._occ_gauge = REGISTRY.gauge(
             "kft_engine_active_slots",
@@ -189,6 +267,14 @@ class DecodeEngine:
             "decode engine per-step (= per-token) latency, by engine",
             buckets=_STEP_BUCKETS,
         ).declare(engine=name)
+        self._hits_ctr = REGISTRY.counter(
+            PREFIX_HITS_TOTAL, PREFIX_HITS_HELP)
+        self._misses_ctr = REGISTRY.counter(
+            PREFIX_MISSES_TOTAL, PREFIX_MISSES_HELP)
+        self._evict_ctr = REGISTRY.counter(
+            PREFIX_EVICTIONS_TOTAL, PREFIX_EVICTIONS_HELP)
+        self._chunks_ctr = REGISTRY.counter(
+            PREFILL_CHUNKS_TOTAL, PREFILL_CHUNKS_HELP)
         # Fault-layer series: same names as the static batchers', so
         # shed/expired rates read uniformly across batching planes.
         self._shed_ctr = REGISTRY.counter(SHED_TOTAL, SHED_HELP)
@@ -206,18 +292,35 @@ class DecodeEngine:
     # -- client surface ---------------------------------------------------
 
     def accepts(self, inputs: Dict[str, Any]) -> bool:
-        """ModelServer routing hook: prompts beyond the static prefill
-        width fall back to the direct generate() path."""
+        """ModelServer routing hook: prompts whose REAL token count
+        (an explicit ``prompt_len``, else the width minus trailing pad
+        ids) exceeds the static prefill width fall back to the direct
+        generate() path.  A short prompt arriving right-padded — e.g.
+        from a client that pads to a fixed wire shape — is admitted at
+        its true length, not rejected for its padded width."""
         tokens = np.asarray(inputs.get("tokens", ()))
-        length = tokens.shape[-1] if tokens.ndim else 0
+        if tokens.ndim == 0 or tokens.size == 0:
+            return False
+        row = tokens.reshape(-1)
+        if "prompt_len" in inputs:
+            length = int(np.asarray(inputs["prompt_len"]).reshape(()))
+            if not 0 < length <= row.shape[0]:
+                return False
+        else:
+            length = _true_token_len(row)
         return bool(0 < length <= self.prefill_len)
 
     def submit(self, inputs: Dict[str, Any],
                deadline: Optional[float] = None) -> Dict[str, Any]:
         """One request: tokens [t] or [1, t]; optional per-request
-        ``max_new_tokens`` (<= engine headroom) and sampling ``seed``.
-        Blocks until the completion is ready; returns
-        {"tokens": [1, t + emitted]}.
+        ``max_new_tokens`` (<= engine headroom), sampling ``seed``, and
+        ``prompt_len`` (real token count of a right-padded prompt —
+        without it, trailing pad ids (token 0) are trimmed, so a padded
+        short prompt is neither rejected nor over-prefilled, and never
+        generates with pad tokens in its context).  Blocks until the
+        completion is ready; returns {"tokens": [1, true_len + emitted]}.
+        With ``return_timing`` truthy the result also carries
+        ``ttft_s`` / ``latency_s`` / ``cached_tokens`` (bench surface).
 
         ``deadline`` (absolute faults.monotonic() instant) is enforced
         everywhere the request lives: expired-on-arrival raises here,
@@ -229,15 +332,24 @@ class DecodeEngine:
         tokens = np.asarray(inputs["tokens"], np.int32)
         if tokens.ndim == 1:
             tokens = tokens[None]
-        n, length = tokens.shape
+        n, width = tokens.shape
         if n != 1:
             raise ValueError(
                 f"DecodeEngine.submit takes one prompt per call (got "
                 f"batch dim {n}); submit rows separately")
+        if "prompt_len" in inputs:
+            length = int(np.asarray(inputs["prompt_len"]).reshape(()))
+            if not 0 < length <= width:
+                raise ValueError(
+                    f"prompt_len {length} outside (0, {width}] "
+                    f"(the tokens width)")
+        else:
+            length = _true_token_len(tokens[0])
         if not 0 < length <= self.prefill_len:
             raise ValueError(
-                f"prompt length {length} outside (0, {self.prefill_len}]"
-                f" (engine prefill width)")
+                f"true prompt length {length} outside "
+                f"(0, {self.prefill_len}] (engine prefill width)")
+        tokens = np.ascontiguousarray(tokens[:, :length])
         new = int(np.asarray(inputs.get(
             "max_new_tokens", self.decode.max_new_tokens)).reshape(()))
         if new < 1:
@@ -245,7 +357,7 @@ class DecodeEngine:
         # Same budget contract as every other serving path: the export
         # config's max_new_tokens is the ceiling (a client cannot buy a
         # bigger completion than the model advertises), and the cache
-        # headroom caps it further.
+        # headroom caps it further — both against the TRUE length.
         new = min(new, self.decode.max_new_tokens, self.max_len - length)
         seed = int(np.asarray(inputs.get("seed", 0)).reshape(()))
         if deadline is not None and faults.monotonic() >= deadline:
@@ -258,9 +370,11 @@ class DecodeEngine:
         entry = {
             "tokens": tokens, "new": new, "seed": seed,
             "emitted": [], "scheduled": 0, "slot": None,
+            "prefilling": False, "pos": 0, "cached": 0, "pool_row": None,
             "deadline": deadline,
+            "want_timing": bool(inputs.get("return_timing")),
             "event": threading.Event(), "out": None, "err": None,
-            "t": time.monotonic(),
+            "t": time.monotonic(), "t_first": None,
         }
         with self._lock:
             if self._stopped:
@@ -288,15 +402,18 @@ class DecodeEngine:
 
     def compiled_programs(self) -> Dict[str, int]:
         """How many device programs this engine has compiled — by
-        construction at most one prefill and one step executable (the
-        build sites are None-guarded), so a healthy engine reports
-        {"prefill": 1, "step": 1} for its whole lifetime."""
-        return {"prefill": int(self._prefill_exec is not None),
+        construction at most one chunked-prefill, one prefix-copy, and
+        one step executable (the build sites are None-guarded), so a
+        healthy engine reports {"chunked_prefill": 1, "copy_prefix": 1,
+        "step": 1} for its whole lifetime."""
+        return {"chunked_prefill": int(self._chunk_exec is not None),
+                "copy_prefix": int(self._copy_exec is not None),
                 "step": int(self._step_exec is not None)}
 
     def stats(self) -> Dict[str, Any]:
         """Locked snapshot of the engine counters: occupancy, queue
-        depth, throughput, and per-token (= per-step) latency."""
+        depth, throughput, per-token (= per-step) latency, prefix-cache
+        effectiveness, and prefill-interference bounds."""
         c, extra = locked_snapshot(
             self._lock, self._counters,
             lambda: {
@@ -304,16 +421,22 @@ class DecodeEngine:
                 "active_slots": sum(
                     r is not None for r in self._slot_req),
                 "step_times": list(self._step_times),
+                "chunk_times": list(self._chunk_times),
+                "gap_times": list(self._gap_times),
+                "ttft_times": list(self._ttft_times),
             })
         steps = c["steps"]
-        times = sorted(extra["step_times"])
 
-        def pct(q):
-            if not times:
+        def pct(values, q):
+            if not values:
                 return 0.0
-            return round(times[min(len(times) - 1,
-                                   int(len(times) * q))] * 1e3, 3)
+            values = sorted(values)
+            return round(values[min(len(values) - 1,
+                                    int(len(values) * q))] * 1e3, 3)
 
+        times = extra["step_times"]
+        gaps = extra["gap_times"]
+        prompt_toks = c["prompt_tokens"]
         return {
             "requests": c["requests"],
             "tokens": c["tokens"],
@@ -332,12 +455,41 @@ class DecodeEngine:
             # in-flight) — the chaos scenario's primary assertions.
             "shed": c["shed"],
             "deadline_expired": c["expired"],
+            # Prefix cache: how much prompt compute the donor pool
+            # saved.  cached_token_ratio is the operator's one-glance
+            # effectiveness number (also exported per-replica to the
+            # fleet — see ModelServer.refresh_gauges).
+            "prefix_hits": c["prefix_hits"],
+            "prefix_misses": c["prefix_misses"],
+            "prefix_evictions": c["prefix_evictions"],
+            "cached_prompt_tokens": c["cached_tokens"],
+            "prompt_tokens": prompt_toks,
+            "cached_token_ratio": round(
+                c["cached_tokens"] / prompt_toks, 4)
+            if prompt_toks else 0.0,
+            # Chunked prefill: calls made and their latency — one chunk
+            # is the most an arriving prompt may stall in-flight decode
+            # per scheduling turn.
+            "prefill_chunks": c["prefill_chunks"],
+            "prefill_chunk_p95_ms": pct(extra["chunk_times"], 0.95),
             "mean_occupancy": round(c["occupancy_sum"] / steps, 2)
             if steps else 0.0,
             "tokens_per_sec": round(c["tokens"] / c["busy_s"], 1)
             if c["busy_s"] else 0.0,
-            "token_latency_p50_ms": pct(0.50),
-            "token_latency_p95_ms": pct(0.95),
+            "token_latency_p50_ms": pct(times, 0.50),
+            "token_latency_p95_ms": pct(times, 0.95),
+            "token_latency_p99_ms": pct(times, 0.99),
+            # Wall time between consecutive step-call completions while
+            # slots were live — the client-visible inter-token gap,
+            # INCLUDING whatever admission/prefill work ran in between.
+            # Bounded by the chunk budget; a full-prefill stall would
+            # spike the max.
+            "inter_token_gap_p50_ms": pct(gaps, 0.50),
+            "inter_token_gap_p99_ms": pct(gaps, 0.99),
+            "inter_token_gap_max_ms": round(max(gaps) * 1e3, 3)
+            if gaps else 0.0,
+            "ttft_p50_ms": pct(extra["ttft_times"], 0.50),
+            "ttft_p99_ms": pct(extra["ttft_times"], 0.99),
         }
 
     def close(self, drain_s: float = 10.0) -> None:
@@ -354,6 +506,12 @@ class DecodeEngine:
                 self._drain_deadline = time.monotonic() + max(0.0, drain_s)
                 self._work.notify_all()
         self._thread.join(timeout=max(5.0, drain_s + 5.0))
+        # The prefix index dies with the engine (reload invalidation:
+        # the serving layer rebuilds engine + pool per model version);
+        # clear it here too so a closed-but-referenced engine can never
+        # serve a stale prefix.
+        if self._index is not None:
+            self._index.invalidate()
         # A closed engine exports no live slots or queue: hot-swap
         # retires the metric series at zero instead of freezing a
         # stale occupancy in /metrics forever.
@@ -370,11 +528,12 @@ class DecodeEngine:
         live slot table (caller fails them outside the lock).
 
         In-flight expiry rides the deterministic-retirement path: the
-        slot is freed NOW — the next admission prefills over it, which
-        is the device-side abort — and the request's lagged emissions
-        still in _pending are dropped by _drain_one's event-set check,
-        exactly like a normally-retired slot's.  No other slot's state
-        is touched, so co-resident generations are unaffected."""
+        slot is freed NOW — the next admission's prefix-copy program
+        freezes it on device, which is the device-side abort — and the
+        request's lagged emissions still in _pending are dropped by
+        _drain_one's event-set check, exactly like a normally-retired
+        slot's.  No other slot's state is touched, so co-resident
+        generations are unaffected."""
         pnow = faults.monotonic()
         expired: List[dict] = []
         live = []
@@ -431,6 +590,14 @@ class DecodeEngine:
                     f"(engine {self._metric_name!r})")
                 entry["event"].set()
 
+    def _release_capture(self, entry: dict) -> None:
+        """Abandon an entry's donor capture (expired mid-prefill): the
+        pool row's partial writes are unreachable and the row unpins."""
+        row = entry.get("pool_row")
+        entry["pool_row"] = None
+        if row is not None and self._index is not None:
+            self._index.abort_capture(row)
+
     def _set_queue_gauge(self, depth: int) -> None:
         if depth != self._queue_last:
             self._queue_last = depth
@@ -441,48 +608,128 @@ class DecodeEngine:
             self._occ_last = active
             self._occ_gauge.set(active, engine=self._metric_name)
 
-    def _admit(self, batch: List[tuple]) -> None:
-        """Prefill up to admit_width requests into their slots in ONE
-        program call (dispatch only — the first sampled tokens join the
-        lagged pending stream).  Unused admission rows point at an
-        out-of-range slot; the device drops their writes."""
-        from kubeflow_tpu.models.generate import prefill_into_slot
+    def _begin_prefill(self, entry: dict, slot: int) -> None:
+        """Admission, host side: find the longest cached prefix, copy
+        it into (and FREEZE) the slot in one device call, claim a donor
+        row for capture on a miss, and queue the entry for chunked
+        prefill.  The copy program runs for EVERY admission — at k = 0
+        it is the claim-time freeze that makes reusing a deadline-
+        expired slot safe (see copy_prefix_into_slot)."""
+        from kubeflow_tpu.models.generate import copy_prefix_into_slot
 
-        a = self.admit_width
-        tokens = np.zeros((a, self.prefill_len), np.int32)
-        plen = np.ones((a,), np.int32)
-        new = np.ones((a,), np.int32)
-        slots = np.full((a,), self.slots, np.int32)  # OOB = dropped
-        seeds = np.zeros((a,), np.int32)
-        snapshot = []
-        for row, (entry, slot) in enumerate(batch):
-            t = entry["tokens"]
-            tokens[row, :t.shape[1]] = t[0]
-            plen[row] = t.shape[1]
-            new[row] = entry["new"]
-            slots[row] = slot
-            seeds[row] = entry["seed"]
-            entry["scheduled"] = 1  # slot claimed at queue pop, locked
-            snapshot.append((row, entry))
+        prompt = entry["tokens"][0]
+        true_len = int(prompt.shape[0])
+        row, cached = (None, 0)
+        if self._index is not None:
+            row, cached = self._index.lookup(prompt, true_len - 1)
         # Chaos hook: sleep = slow admission; raise = device death at
-        # prefill (propagates to _abort, every waiter resolved).
+        # admission (propagates to _abort, every waiter resolved).
         faults.fire("engine.admit")
-        if self._prefill_exec is None:
-            self._prefill_exec = prefill_into_slot.lower(
-                self.cfg, self.params, self._state, self.decode, tokens,
-                plen, new, slots, seeds).compile()
+        if self._copy_exec is None:
+            self._copy_exec = copy_prefix_into_slot.lower(
+                self._state, self._pool, np.int32(0), np.int32(0),
+                np.int32(0)).compile()
         t0 = time.perf_counter()
-        self._state, first = self._prefill_exec(
-            self.params, self._state, tokens, plen, new, slots, seeds)
+        self._state = self._copy_exec(
+            self._state, self._pool, np.int32(row or 0), np.int32(slot),
+            np.int32(cached))
         dt = time.perf_counter() - t0
-        self._pending.append((first, snapshot))
+        evicted = False
+        if (self._index is not None and cached == 0
+                and true_len >= self.prefix_block_tokens):
+            # Full miss with at least one publishable block: capture
+            # this prompt's prefix as a new donor while prefilling it.
+            # Partial hits don't extend the donor (a donor must be
+            # self-contained from column 0); the pool stays small, so
+            # the common shared-system-prompt case — one miss, then
+            # hits — is the one that matters.
+            pool_row, evicted = self._index.begin_capture()
+            entry["pool_row"] = pool_row
+        entry["pos"] = cached
+        entry["cached"] = cached
+        entry["prefilling"] = True
+        self._prefilling.append(entry)
         with self._lock:
-            self._counters["prefills"] += len(batch)
-            # Prefill emits each request's first token, so its compute
-            # belongs in busy_s — tokens_per_sec must not count tokens
-            # whose cost was never measured (short-completion workloads
-            # would otherwise read up to ~2x the real rate).
+            self._counters["prompt_tokens"] += true_len
             self._counters["busy_s"] += dt
+            if self._index is not None:
+                # Hit/miss accounting only when caching is ON — with
+                # --prefix_pool_blocks 0 a climbing miss counter would
+                # read as "cache enabled and failing" on dashboards.
+                if cached:
+                    self._counters["prefix_hits"] += 1
+                    self._counters["cached_tokens"] += cached
+                else:
+                    self._counters["prefix_misses"] += 1
+                if evicted:
+                    self._counters["prefix_evictions"] += 1
+        if self._index is not None:
+            (self._hits_ctr if cached else self._misses_ctr).inc(
+                engine=self._metric_name)
+            if evicted:
+                self._evict_ctr.inc(engine=self._metric_name)
+
+    def _prefill_chunk(self, entry: dict) -> None:
+        """One static-width chunk of one entry's prompt into its slot
+        (dispatch only — the final chunk's first sampled token joins
+        the lagged pending stream)."""
+        from kubeflow_tpu.models.generate import prefill_chunk_into_slot
+
+        w = self.chunk_w
+        prompt = entry["tokens"][0]
+        true_len = int(prompt.shape[0])
+        # The final chunk's [start, start+w) write window must fit the
+        # slot's max_len columns — XLA's dynamic_update_slice CLAMPS an
+        # out-of-bounds start (it does not drop), which would shift the
+        # whole chunk onto earlier valid columns.  Pulling start back
+        # recomputes a few already-written columns instead: same
+        # tokens, same positions, same prefix KV => identical k/v, so
+        # the overlap is a no-op rewrite.  Only the final chunk can
+        # overflow (intermediate chunks end before prompt_len <=
+        # prefill_len < max_len), so this never slows steady prefill.
+        start = min(entry["pos"], self.max_len - w)
+        chunk = np.zeros((1, w), np.int32)
+        seg = prompt[start:start + w]
+        chunk[0, :seg.shape[0]] = seg
+        pool_row = entry["pool_row"]
+        if pool_row is None:
+            pool_row = self._pool_rows  # OOB = capture writes dropped
+        if self._chunk_exec is None:
+            self._chunk_exec = prefill_chunk_into_slot.lower(
+                self.cfg, self.params, self._state, self.decode,
+                self._pool, chunk, np.int32(0), np.int32(1),
+                np.int32(1), np.int32(0), np.int32(0),
+                np.int32(0)).compile()
+        t0 = time.perf_counter()
+        self._state, self._pool, tok = self._chunk_exec(
+            self.params, self._state, self._pool, chunk,
+            np.int32(start), np.int32(true_len), np.int32(entry["new"]),
+            np.int32(entry["slot"]), np.int32(pool_row),
+            np.int32(entry["seed"]))
+        dt = time.perf_counter() - t0
+        entry["pos"] = start + w
+        finished = entry["pos"] >= true_len
+        if finished:
+            entry["prefilling"] = False
+            entry["scheduled"] = 1
+            self._pending.append((tok, [(0, entry)]))
+            if entry["pool_row"] is not None and self._index is not None:
+                self._index.commit_capture(
+                    entry["pool_row"], prompt, true_len)
+                entry["pool_row"] = None
+        with self._lock:
+            self._counters["prefill_chunks"] += 1
+            # Prefill compute belongs in busy_s — tokens_per_sec must
+            # not count tokens whose cost was never measured (short-
+            # completion workloads would otherwise read up to ~2x the
+            # real rate).
+            self._counters["busy_s"] += dt
+            self._chunk_times.append(dt)
+            if len(self._chunk_times) > 4096:
+                del self._chunk_times[:2048]
+            if finished:
+                self._counters["prefills"] += 1
+        self._chunks_ctr.inc(engine=self._metric_name)
 
     def _finish(self, entry: dict) -> None:
         """Resolve a completed request: prompt + emitted tokens."""
@@ -490,6 +737,12 @@ class DecodeEngine:
             [entry["tokens"],
              np.asarray(entry["emitted"], np.int32)[None]], axis=1)
         entry["out"] = {"tokens": out}
+        if entry["want_timing"]:
+            now = time.monotonic()
+            entry["out"]["ttft_s"] = (
+                (entry["t_first"] or now) - entry["t"])
+            entry["out"]["latency_s"] = now - entry["t"]
+            entry["out"]["cached_tokens"] = entry["cached"]
         entry["event"].set()
 
     def _drain_one(self) -> None:
@@ -499,16 +752,19 @@ class DecodeEngine:
         not per token."""
         arr, snapshot = self._pending.pop(0)
         host = np.asarray(arr)
-        if host.ndim < 2:   # prefill emission: [A] first tokens, the
-            host = host[None]   # snapshot's cols are admission rows
+        if host.ndim < 2:   # prefill emission: [1] first token, the
+            host = host[None]   # snapshot's col is 0
         emitted = 0
         finished = 0
+        ttfts: List[float] = []
         for row in host:           # fused calls carry [steps, slots]
             for col, entry in snapshot:
                 if entry["event"].is_set() or len(entry["emitted"]) >= \
                         entry["new"]:
                     continue
                 tok = int(row[col])
+                if entry["t_first"] is None:
+                    entry["t_first"] = time.monotonic()
                 entry["emitted"].append(tok)
                 emitted += 1
                 complete = len(entry["emitted"]) >= entry["new"] or (
@@ -520,11 +776,15 @@ class DecodeEngine:
                     if self._slot_req[entry["slot"]] is entry:
                         self._slot_req[entry["slot"]] = None
                     self._finish(entry)
+                    ttfts.append(entry["t_first"] - entry["t"])
                     finished += 1
         with self._lock:
             self._counters["tokens"] += emitted
             self._counters["requests"] += finished
             self._counters["in_flight"] -= finished
+            self._ttft_times.extend(ttfts)
+            if len(self._ttft_times) > 4096:
+                del self._ttft_times[:2048]
         if emitted:
             self._tok_counter.inc(emitted, engine=self._metric_name)
 
@@ -550,7 +810,9 @@ class DecodeEngine:
                     admissions = []
                     if not stopping:
                         free = self._free_slots_locked()
-                        while free and self._queue:
+                        while (free and self._queue
+                               and len(self._prefilling)
+                               + len(admissions) < self.admit_width):
                             entry = self._queue.pop(0)
                             slot = free.pop(0)
                             # Claim the slot and bump in_flight in the
@@ -567,6 +829,17 @@ class DecodeEngine:
                             admissions.append((entry, slot))
                         self._set_queue_gauge(len(self._queue))
                 self._fail_expired(expired)
+                if expired and self._prefilling:
+                    # Mid-prefill expiries leave the chunk schedule and
+                    # release their donor captures; their frozen slots
+                    # are safe to reclaim (claim-time freeze).
+                    keep = []
+                    for p in self._prefilling:
+                        if any(p is e for e in expired):
+                            self._release_capture(p)
+                        else:
+                            keep.append(p)
+                    self._prefilling = keep
                 if past_drain:
                     self._abort(RuntimeError(
                         f"engine {self._metric_name!r} drain deadline "
@@ -577,11 +850,27 @@ class DecodeEngine:
                     # to drain in-flight slots.
                     self._fail_queue(BatcherClosed(
                         f"engine {self._metric_name!r} is closed"))
-                for i in range(0, len(admissions), self.admit_width):
-                    self._admit(admissions[i:i + self.admit_width])
-                active = sum(r is not None for r in self._slot_req)
-                self._set_occ_gauge(active)
-                if active:
+                for entry, slot in admissions:
+                    self._begin_prefill(entry, slot)
+                # Chunked prefill BETWEEN decode steps, under the
+                # per-step token budget: the head admission (FIFO —
+                # oldest finishes first, best TTFT) gets chunks until
+                # the budget is spent, then the loop returns to
+                # decoding.  In-flight slots therefore stall at most
+                # ~budget prompt-tokens of prefill per step, no matter
+                # how long the arriving prompts are.
+                budget = self.prefill_chunk_tokens
+                while budget > 0 and self._prefilling:
+                    entry = self._prefilling[0]
+                    self._prefill_chunk(entry)
+                    budget -= self.chunk_w
+                    if not entry["prefilling"]:
+                        self._prefilling.pop(0)
+                self._set_occ_gauge(
+                    sum(r is not None for r in self._slot_req))
+                live = sum(1 for r in self._slot_req
+                           if r is not None and not r["prefilling"])
+                if live:
                     k = self.steps_per_call
                     # Build (one-time) OUTSIDE the timed window: the
                     # first per-token latency sample must not carry
@@ -602,7 +891,7 @@ class DecodeEngine:
                         self.params, self._state)
                     self._pending.append((sampled, [
                         (i, r) for i, r in enumerate(self._slot_req)
-                        if r is not None]))
+                        if r is not None and not r["prefilling"]]))
                     # Deterministic retirement: with no EOS in play a
                     # request's completion step is known at dispatch —
                     # free the slot NOW so the next admission overlaps
@@ -610,7 +899,7 @@ class DecodeEngine:
                     # request stays visible in in_flight until its
                     # lagged emission is delivered.
                     for i, r in enumerate(self._slot_req):
-                        if r is None:
+                        if r is None or r["prefilling"]:
                             continue
                         r["scheduled"] = min(r["new"],
                                              r["scheduled"] + k)
@@ -618,20 +907,34 @@ class DecodeEngine:
                             self._slot_req[i] = None
                     while len(self._pending) > self.sync_lag:
                         self._drain_one()
-                    dt = time.perf_counter() - t0
+                    end = time.perf_counter()
+                    dt = end - t0
                     per_step = dt / k
+                    gap = (end - self._last_step_end
+                           if self._last_step_end is not None else None)
+                    self._last_step_end = end
                     with self._lock:
                         self._counters["steps"] += k
-                        self._counters["occupancy_sum"] += active * k
+                        self._counters["occupancy_sum"] += live * k
                         self._counters["busy_s"] += dt
                         self._step_times.append(per_step)
                         if len(self._step_times) > 4096:
                             del self._step_times[:2048]
+                        if gap is not None:
+                            # Per-call gap normalized by fused steps:
+                            # what a client streaming tokens would see
+                            # between tokens, including interleaved
+                            # admission/prefill work.
+                            self._gap_times.append(gap / k)
+                            if len(self._gap_times) > 4096:
+                                del self._gap_times[:2048]
                     self._step_hist.observe(per_step,
                                             engine=self._metric_name)
                 else:
-                    while self._pending:
-                        self._drain_one()
+                    self._last_step_end = None
+                    if not self._prefilling:
+                        while self._pending:
+                            self._drain_one()
                 self._set_occ_gauge(
                     sum(r is not None for r in self._slot_req))
         except BaseException as exc:  # noqa: BLE001 — fail loudly to waiters
@@ -669,4 +972,5 @@ class DecodeEngine:
                     entry["err"] = err
                     entry["event"].set()
         self._pending.clear()
+        self._prefilling.clear()
         self._set_occ_gauge(0)

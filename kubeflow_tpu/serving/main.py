@@ -33,6 +33,9 @@ def batcher_factory(*, micro_batch_size: int, batch_timeout_s: float,
                     lm_engine_sync_lag: int = 2,
                     lm_engine_steps_per_call: int = 1,
                     lm_engine_admit_width: int = 4,
+                    prefill_chunk_tokens: int = 64,
+                    prefix_pool_blocks: int = 4,
+                    prefix_block_tokens: int = 16,
                     max_queue_depth: int = 0,
                     overload_retry_after_s: float = 1.0):
     """ModelServer.enable_batching factory: picks the batcher per model.
@@ -64,7 +67,7 @@ def batcher_factory(*, micro_batch_size: int, batch_timeout_s: float,
             # Prefill width: explicit flag > largest bucket > a capped
             # share of whatever prompt room the model's max_seq_len
             # leaves after the configured completion budget.  The width
-            # is a STATIC program shape (the two-program guarantee), so
+            # is a STATIC program shape (the three-program guarantee), so
             # every admission prefills at this width no matter how
             # short the prompt, and the persistent cache is sized
             # slots x (width + budget) — hence the flagless cap: a
@@ -92,6 +95,9 @@ def batcher_factory(*, micro_batch_size: int, batch_timeout_s: float,
                     sync_lag=lm_engine_sync_lag,
                     steps_per_call=lm_engine_steps_per_call,
                     admit_width=lm_engine_admit_width,
+                    prefill_chunk_tokens=prefill_chunk_tokens,
+                    prefix_pool_blocks=prefix_pool_blocks,
+                    prefix_block_tokens=prefix_block_tokens,
                     max_queue_depth=max_queue_depth,
                     overload_retry_after_s=overload_retry_after_s,
                     name=f"{model.name}-v{model.version}")
@@ -180,9 +186,28 @@ def main(argv=None) -> int:
                          "program call: amortizes per-dispatch overhead "
                          "k-fold at k-step admission granularity")
     ap.add_argument("--lm_engine_admit_width", type=int, default=4,
-                    help="DecodeEngine prefill admission rows per call: "
-                         "bursts of arrivals prefill together instead "
-                         "of one serialized prefill per request")
+                    help="DecodeEngine concurrent mid-prefill "
+                         "admissions: further queued requests wait "
+                         "even when slots are free, so a burst of long "
+                         "prompts cannot hoard every slot half-filled")
+    ap.add_argument("--prefill_chunk_tokens", type=int, default=64,
+                    help="DecodeEngine per-step prefill token budget "
+                         "(and the static chunk width): arriving "
+                         "prompts prefill in chunks scheduled between "
+                         "decode steps, so in-flight inter-token "
+                         "latency is bounded by one chunk regardless "
+                         "of prompt length")
+    ap.add_argument("--prefix_pool_blocks", type=int, default=4,
+                    help="DecodeEngine shared-prefix KV pool: donor "
+                         "rows cached for prefix reuse across "
+                         "requests (each holds up to the prefill "
+                         "width; 0 disables prefix caching).  Size to "
+                         "the number of DISTINCT hot system prompts; "
+                         "invalidated on every model (re)load")
+    ap.add_argument("--prefix_block_tokens", type=int, default=16,
+                    help="prefix cache hash/match granularity in "
+                         "tokens — prefixes are cached and matched in "
+                         "multiples of this")
     ap.add_argument("--max_queue_depth", type=int, default=256,
                     help="bounded admission: submissions beyond this "
                          "many pending requests per model fail fast "
@@ -242,6 +267,9 @@ def main(argv=None) -> int:
                 lm_engine_sync_lag=args.lm_engine_sync_lag,
                 lm_engine_steps_per_call=args.lm_engine_steps_per_call,
                 lm_engine_admit_width=args.lm_engine_admit_width,
+                prefill_chunk_tokens=args.prefill_chunk_tokens,
+                prefix_pool_blocks=args.prefix_pool_blocks,
+                prefix_block_tokens=args.prefix_block_tokens,
                 max_queue_depth=args.max_queue_depth,
                 overload_retry_after_s=args.overload_retry_after_s,
             ),

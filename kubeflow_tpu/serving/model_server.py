@@ -75,6 +75,10 @@ QUEUE_GAUGE = "kft_serving_queue_depth"
 QUEUE_HELP = "pending entries in a model's batching plane, by model"
 READY_GAUGE = "kft_serving_ready"
 READY_HELP = "1 when /readyz would say ready (models loaded, not draining)"
+CACHED_RATIO_GAUGE = "kft_serving_cached_token_ratio"
+CACHED_RATIO_HELP = ("fraction of prompt tokens served from the engine "
+                     "prefix cache; unlabeled = process aggregate, "
+                     "model= per-model")
 
 
 @dataclasses.dataclass
@@ -427,10 +431,28 @@ class ModelServer:
         for name, count in per_model.items():
             inflight.set(count, model=name)
         queue = REGISTRY.gauge(QUEUE_GAUGE, QUEUE_HELP)
+        ratio = REGISTRY.gauge(CACHED_RATIO_GAUGE, CACHED_RATIO_HELP)
+        cached_total = prompt_total = 0
+        any_engine = False
         for name in per_model:
-            stats = self.batcher_stats(name)
-            queue.set((stats or {}).get("queue_depth", 0) or 0,
-                      model=name)
+            stats = self.batcher_stats(name) or {}
+            queue.set(stats.get("queue_depth", 0) or 0, model=name)
+            if "cached_token_ratio" in stats:
+                # Prefix-cache effectiveness (DecodeEngine models): the
+                # fleet registry scrapes this per replica so operators
+                # see cache hit rates across the whole fleet.
+                any_engine = True
+                ratio.set(stats["cached_token_ratio"], model=name)
+                cached_total += stats.get("cached_prompt_tokens", 0)
+                prompt_total += stats.get("prompt_tokens", 0)
+        if any_engine:
+            # The unlabeled aggregate must RESET with its engines: a
+            # hot-reload rebuilds the engine with an empty cache, and
+            # the fleet scrape reads this (first-sorted) series — a
+            # stale pre-reload ratio would report a warm cache the
+            # replica no longer has.
+            ratio.set(round(cached_total / prompt_total, 4)
+                      if prompt_total else 0.0)
         REGISTRY.gauge(READY_GAUGE, READY_HELP).set(
             1 if self.is_ready() else 0)
 

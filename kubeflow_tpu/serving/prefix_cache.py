@@ -1,0 +1,186 @@
+"""Host-side block-hashed prefix index over the donor KV pool.
+
+The DecodeEngine pays full prefill for every admission even when
+thousands of chat requests share an identical system-prompt prefix.
+This module is the bookkeeping half of shared-prefix KV reuse: the
+device half (models/generate.py ``copy_prefix_into_slot`` /
+``prefill_chunk_into_slot``) copies and fills donor rows of a small
+pinned KV pool; this index remembers which pool row holds which
+token prefix, at BLOCK granularity.
+
+Design, in the radix-tree-lite shape vLLM/SGLang use:
+
+  - prompts are hashed in fixed-size token blocks, each block's digest
+    chained over its predecessor's (``h_i = H(h_{i-1} || block_i)``),
+    so a digest identifies an exact token PREFIX, not a bag of blocks;
+  - a committed pool row publishes one digest per full block it holds;
+    lookup walks the querying prompt's chain from the longest candidate
+    down and returns the deepest published match — the longest cached
+    prefix, in O(blocks) with no tree structure to rebalance;
+  - eviction is LRU over committed rows, and a row pinned by an active
+    slot (a capture in flight — the chunked prefill currently writing
+    it) is NEVER evicted: a donor must not be reallocated under the
+    program that is filling it;
+  - the index holds tokens and row numbers only — no device memory —
+    and dies with its engine, which is what makes model-reload
+    invalidation automatic (the serving layer rebuilds the engine, and
+    with it this index, around every hot-swapped version).
+
+Single-writer by design: the engine's loop thread is the only caller
+of the mutating surface, so the class needs no lock of its own (the
+engine snapshots counters under its own lock for stats()).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+_SEED_DIGEST = b"\x00" * 16
+
+
+def _block_digests(tokens: np.ndarray, block: int,
+                   n_blocks: int) -> List[bytes]:
+    """Chained digests of the first ``n_blocks`` full ``block``-token
+    blocks of ``tokens`` — digest i commits to tokens[0 : (i+1)*block]."""
+    out: List[bytes] = []
+    h = _SEED_DIGEST
+    flat = np.asarray(tokens, np.int32).reshape(-1)
+    for i in range(n_blocks):
+        h = hashlib.blake2b(
+            h + flat[i * block:(i + 1) * block].tobytes(),
+            digest_size=16).digest()
+        out.append(h)
+    return out
+
+
+class PrefixIndex:
+    """Block-hashed prefix -> donor pool row map with LRU + pin
+    eviction.
+
+    Args:
+      rows: donor pool entries (device rows; ``--prefix_pool_blocks``).
+      block_tokens: hash/publish granularity — a prefix is cacheable
+        in multiples of this many tokens.
+      pool_len: cache columns per pool row; caps how much prefix one
+        donor can hold.
+    """
+
+    def __init__(self, rows: int, block_tokens: int, pool_len: int):
+        if rows < 1:
+            raise ValueError(f"rows must be >= 1, got {rows}")
+        if block_tokens < 1:
+            raise ValueError(
+                f"block_tokens must be >= 1, got {block_tokens}")
+        self.rows = int(rows)
+        self.block = int(block_tokens)
+        self.pool_len = int(pool_len)
+        self._free: List[int] = list(range(self.rows))
+        # digest -> (row, cached columns); committed rows only.
+        self._chains: Dict[bytes, Tuple[int, int]] = {}
+        # row -> its published digests, in insertion order = LRU order
+        # (move-to-end on hit).
+        self._lru: Dict[int, List[bytes]] = {}
+        self._pinned: set = set()
+        self.evictions = 0
+
+    # -- lookup ------------------------------------------------------------
+
+    def lookup(self, tokens: np.ndarray,
+               limit: int) -> Tuple[Optional[int], int]:
+        """Longest published block-prefix of ``tokens`` covering at
+        most ``limit`` columns; returns (pool row, cached columns) or
+        (None, 0).  Callers pass ``limit = prompt_len - 1`` so at least
+        one prompt token is always recomputed — the KV pool caches
+        keys/values, not the logits the first sampled token needs."""
+        n_blocks = min(int(limit), self.pool_len) // self.block
+        if n_blocks <= 0 or not self._chains:
+            return None, 0
+        digests = _block_digests(tokens, self.block, n_blocks)
+        for i in range(n_blocks, 0, -1):
+            hit = self._chains.get(digests[i - 1])
+            if hit is not None:
+                row, _ = hit
+                self._lru[row] = self._lru.pop(row)  # move to end
+                return row, i * self.block
+        return None, 0
+
+    # -- capture lifecycle -------------------------------------------------
+
+    def begin_capture(self) -> Tuple[Optional[int], bool]:
+        """Claim (and pin) a pool row for a new donor capture; returns
+        (row, evicted_flag).  Evicts the least-recently-used committed
+        row when no free row exists; (None, False) when every row is
+        pinned by an active capture."""
+        evicted = False
+        if self._free:
+            row = self._free.pop()
+        else:
+            row = next((r for r in self._lru if r not in self._pinned),
+                       None)
+            if row is None:
+                return None, False
+            self._drop_row(row)
+            self.evictions += 1
+            evicted = True
+        self._pinned.add(row)
+        return row, evicted
+
+    def commit_capture(self, row: int, tokens: np.ndarray,
+                       true_len: int) -> int:
+        """Publish a filled capture: register one digest per FULL block
+        of real prompt the row now holds (partial trailing blocks carry
+        right-pad garbage and are never published).  Returns published
+        columns; a capture too short to publish is released instead."""
+        n_blocks = min(int(true_len), self.pool_len) // self.block
+        if n_blocks <= 0:
+            self.abort_capture(row)
+            return 0
+        digests = _block_digests(tokens, self.block, n_blocks)
+        for i, d in enumerate(digests):
+            # FIRST-writer-wins on digest collisions between rows
+            # holding the same prefix (two misses racing to capture one
+            # hot prompt): the established row keeps serving the
+            # digest, so evicting the duplicate later cannot orphan it
+            # — eviction removes only digests still pointing at the
+            # evicted row.
+            self._chains.setdefault(d, (row, (i + 1) * self.block))
+        self._lru[row] = digests
+        self._pinned.discard(row)
+        return n_blocks * self.block
+
+    def abort_capture(self, row: int) -> None:
+        """Release a claimed row without publishing (expired or failed
+        admission): its partial writes are unreachable garbage and the
+        row returns to the free list."""
+        self._pinned.discard(row)
+        if row not in self._lru and row not in self._free:
+            self._free.append(row)
+
+    # -- maintenance -------------------------------------------------------
+
+    def _drop_row(self, row: int) -> None:
+        for d in self._lru.pop(row, ()):  # only digests still ours
+            if self._chains.get(d, (None,))[0] == row:
+                del self._chains[d]
+
+    def invalidate(self) -> None:
+        """Forget every cached prefix (model reload: the new version's
+        KV is numerically unrelated — serving stale prefixes would be
+        silent corruption, so the serving layer rebuilds engine + index
+        per version and close() calls this as a belt-and-braces)."""
+        self._chains.clear()
+        self._lru.clear()
+        self._pinned.clear()
+        self._free = list(range(self.rows))
+
+    def stats(self) -> Dict[str, int]:
+        return {
+            "rows": self.rows,
+            "committed_rows": len(self._lru),
+            "pinned_rows": len(self._pinned),
+            "published_blocks": len(self._chains),
+            "evictions": self.evictions,
+        }

@@ -94,7 +94,12 @@ def engine_smoke(namespace: str = "kubeflow-test") -> None:
     """Admit mixed-length LM requests through the HTTP surface against
     the in-process continuous-batching DecodeEngine: all must complete
     (in-flight admission + slot reuse, 3 requests through 2 slots) and
-    the engine must report zero occupancy and an empty queue after."""
+    the engine must report zero occupancy and an empty queue after.
+    Then a shared-prefix burst (concurrent clients, one common system
+    prompt) must register prefix-cache hits in
+    ``kft_engine_prefix_hits_total`` and keep the max inter-token gap
+    of in-flight slots under the chunk-budget bound (no full-prefill
+    stall spike)."""
     import json
     import tempfile
     import threading
@@ -128,7 +133,8 @@ def engine_smoke(namespace: str = "kubeflow-test") -> None:
         server.enable_batching("lm", batcher_factory(
             micro_batch_size=0, batch_timeout_s=0.005,
             lm_engine=True, lm_engine_slots=2,
-            lm_engine_prefill_len=16))
+            lm_engine_prefill_len=16, prefill_chunk_tokens=8,
+            prefix_pool_blocks=2, prefix_block_tokens=4))
         httpd, _ = make_http_server(server, port=0, host="127.0.0.1")
         try:
             port = httpd.server_address[1]
@@ -172,6 +178,59 @@ def engine_smoke(namespace: str = "kubeflow-test") -> None:
                     f"engine never drained: {stats}")
                 time.sleep(0.05)
             assert stats["requests"] == len(prompts)
+
+            # --- shared-prefix burst: 4 concurrent clients, one
+            # common 8-token system prompt + unique suffixes.  The
+            # first admission captures the prefix into the donor pool;
+            # later ones resume from it.
+            shared = rng.randint(1, 128, size=(8,)).tolist()
+            burst = [shared + rng.randint(1, 128, size=(4,)).tolist()
+                     for _ in range(4)]
+            outs.clear()
+            threads = [threading.Thread(target=client, args=(i, p))
+                       for i, p in enumerate(burst)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            for i, prompt in enumerate(burst):
+                tokens = outs[i]["predictions"][0]["tokens"]
+                assert tokens[:len(prompt)] == prompt
+                assert len(tokens) == len(prompt) + max_new
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/model/lm:stats",
+                    timeout=30) as resp:
+                stats = json.loads(resp.read())["batcher"]
+            assert stats["prefix_hits"] > 0, (
+                f"shared-prefix burst produced no cache hits: {stats}")
+            assert stats["cached_token_ratio"] > 0
+            # Concurrent admission must not have stalled in-flight
+            # decode beyond the chunk budget: the worst observed
+            # inter-token gap stays within a (generous, CI-noise-proof)
+            # multiple of one scheduling turn — one chunk call plus one
+            # step — where an unchunked full-prefill storm would spike
+            # it by the whole admission wave's prompt length.
+            turn_ms = (stats["token_latency_p95_ms"]
+                       + stats["prefill_chunk_p95_ms"])
+            bound_ms = 500.0 + 25.0 * max(turn_ms, 1.0)
+            assert stats["inter_token_gap_max_ms"] <= bound_ms, (
+                f"inter-token gap {stats['inter_token_gap_max_ms']} ms "
+                f"exceeded the chunk-budget bound {bound_ms:.0f} ms")
+            # The prefix-cache counters are on /metrics for operators.
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics",
+                    timeout=30) as resp:
+                metrics = resp.read().decode()
+            from kubeflow_tpu.runtime.prom import (
+                parse_metrics,
+                sample_value,
+            )
+            parsed = parse_metrics(metrics)
+            hits = sample_value(
+                parsed, "kft_engine_prefix_hits_total") or 0
+            assert hits > 0, "kft_engine_prefix_hits_total not exported"
+            assert sample_value(
+                parsed, "kft_serving_cached_token_ratio") is not None
         finally:
             httpd.shutdown()
             server.stop()

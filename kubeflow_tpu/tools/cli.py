@@ -169,13 +169,18 @@ def cmd_fleet_status(args: argparse.Namespace) -> int:
     if not rows:
         print("no endpoints discovered")
         return 0
-    fmt = "{:<20} {:<10} {:>9} {:>12} {:>9}"
+    fmt = "{:<20} {:<10} {:>9} {:>12} {:>7} {:>9}"
     print(fmt.format("ENDPOINT", "STATE", "INFLIGHT", "QUEUE_DEPTH",
-                     "FAILURES"))
+                     "CACHE%", "FAILURES"))
     for row in rows:
+        # Prefix-cache effectiveness per replica (engine models only;
+        # replicas that predate the metric report "-").
+        ratio = row.get("cached_token_ratio")
         print(fmt.format(row["name"], row["state"],
                          int(row["inflight"]),
                          int(row["queue_depth"]),
+                         f"{ratio * 100:.0f}%" if ratio is not None
+                         else "-",
                          row["breaker_failures"]))
     return 0
 

@@ -35,6 +35,7 @@ class _Replica:
         self.draining = False
         self.inflight = 0.0
         self.queue_depth = 0.0
+        self.cached_ratio = 0.0
         self.predict_status = 200
         self.retry_after = None
         self.hang_up = False  # close mid-response without answering
@@ -74,7 +75,9 @@ class _Replica:
                     text = (
                         f"kft_serving_inflight {replica.inflight}\n"
                         f'kft_serving_queue_depth{{model="m"}} '
-                        f"{replica.queue_depth}\n")
+                        f"{replica.queue_depth}\n"
+                        f"kft_serving_cached_token_ratio "
+                        f"{replica.cached_ratio}\n")
                     data = text.encode()
                     self.send_response(200)
                     self.send_header("Content-Length", str(len(data)))
@@ -176,10 +179,24 @@ class TestRegistry:
     def test_load_scraped_from_metrics(self, replicas):
         replicas[2].inflight = 7
         replicas[2].queue_depth = 3
+        replicas[2].cached_ratio = 0.42
         reg = _registry(replicas)
         states = {s.name: s for s in reg.all()}
         assert states["r2"].score() == 10.0
         assert reg.total_load() == 10.0
+        # Prefix-cache effectiveness rides the same scrape and surfaces
+        # per replica (fleet status CACHE% column / router gauge) —
+        # but never enters the P2C load score.
+        assert states["r2"].cached_token_ratio == 0.42
+        assert states["r0"].cached_token_ratio == 0.0
+        rows = {r["name"]: r for r in reg.describe()}
+        assert rows["r2"]["cached_token_ratio"] == 0.42
+        from kubeflow_tpu.runtime.prom import REGISTRY, parse_metrics
+        from kubeflow_tpu.runtime.prom import sample_value
+
+        parsed = parse_metrics(REGISTRY.render())
+        assert sample_value(parsed, "kft_router_cached_token_ratio",
+                            endpoint="r2") == 0.42
 
     def test_dead_replica_ejected_after_threshold_probes(
             self, replicas):

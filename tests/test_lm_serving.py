@@ -143,9 +143,9 @@ class TestDecodeEngine:
     """Continuous-batching engine (serving/engine.py): generations must
     be token-identical to single-request generate(), across mixed
     prompt lengths, per-request budgets, and slot reuse — while
-    compiling exactly two device programs for the whole workload."""
+    compiling exactly three device programs for the whole workload."""
 
-    def test_matches_generate_mixed_lengths_slot_reuse_two_programs(
+    def test_matches_generate_mixed_lengths_slot_reuse_three_programs(
             self, engine_model, monkeypatch):
         import threading
 
@@ -154,8 +154,8 @@ class TestDecodeEngine:
 
         # Count .lower() calls (each is exactly one XLA compilation in
         # the engine: it AOT-compiles and then only invokes the
-        # executables) on the two slot entry points.
-        compiles = {"prefill": 0, "step": 0}
+        # executables) on the three slot entry points.
+        compiles = {"chunked_prefill": 0, "copy_prefix": 0, "step": 0}
 
         def counting(fn, key):
             class _Proxy:
@@ -169,8 +169,12 @@ class TestDecodeEngine:
             return _Proxy()
 
         monkeypatch.setattr(
-            gen_mod, "prefill_into_slot",
-            counting(gen_mod.prefill_into_slot, "prefill"))
+            gen_mod, "prefill_chunk_into_slot",
+            counting(gen_mod.prefill_chunk_into_slot,
+                     "chunked_prefill"))
+        monkeypatch.setattr(
+            gen_mod, "copy_prefix_into_slot",
+            counting(gen_mod.copy_prefix_into_slot, "copy_prefix"))
         monkeypatch.setattr(
             gen_mod, "decode_step",
             counting(gen_mod.decode_step, "step"))
@@ -181,13 +185,18 @@ class TestDecodeEngine:
         # twice mid-run by later requests; lengths span 2..prefill_len
         # and budgets span 3..NEW_TOKENS.  (4 distinct lengths: each
         # distinct length costs one reference generate() compile.)
+        # chunk width 8 < the longest prompts, so multi-chunk prefill
+        # resumption is exercised; the prefix pool is ON with a small
+        # block so repeated short prefixes can hit.
         lens = [3, 9, 16, 2, 9, 16, 3, 16, 2]
         news = [12, 6, 3, 8, 12, 4, 10, 5, 12]
         prompts = [rng.randint(1, VOCAB, size=(n,)).tolist()
                    for n in lens]
         engine = DecodeEngine(spec["cfg"], spec["params"],
                               spec["decode"], slots=3, prefill_len=16,
-                              admit_width=2, name="test-equiv")
+                              admit_width=2, prefill_chunk_tokens=8,
+                              prefix_pool_blocks=2,
+                              prefix_block_tokens=4, name="test-equiv")
         try:
             outs = [None] * len(prompts)
 
@@ -216,10 +225,12 @@ class TestDecodeEngine:
             assert stats["tokens"] == sum(news)
         finally:
             engine.close()
-        # The whole mixed workload — three admission waves, slot reuse,
-        # varying budgets — compiled exactly two programs.
-        assert compiles == {"prefill": 1, "step": 1}
-        assert engine.compiled_programs() == {"prefill": 1, "step": 1}
+        # The whole mixed workload — admission waves, slot reuse,
+        # varying budgets, multi-chunk prefills, prefix-pool copies —
+        # compiled exactly three programs.
+        three = {"chunked_prefill": 1, "copy_prefix": 1, "step": 1}
+        assert compiles == three
+        assert engine.compiled_programs() == three
 
     def test_eos_retirement_matches_generate(self, engine_model):
         """With EOS configured, a slot frozen by the device `done` flag
@@ -312,6 +323,178 @@ class TestDecodeEngine:
             "a client hung after the engine loop died")
         assert len(outs) == 2  # every waiter resolved (result or error)
         engine.close()
+
+    def test_prefix_cache_identity_on_off_with_eviction(
+            self, engine_model):
+        """Shared-prefix KV reuse must be invisible in the tokens:
+        engine output with the prefix cache ON equals single-request
+        generate() equals cache OFF — including a donor eviction forced
+        MID-STREAM (pool of one row, a second prefix family arriving
+        while the first family's requests are still in flight) and slot
+        reuse after retirement (8 requests through 2 slots)."""
+        import threading
+
+        from kubeflow_tpu.serving.engine import DecodeEngine
+
+        spec, _ = engine_model
+        rng = np.random.RandomState(SEED + 7)
+        prefix_a = rng.randint(1, VOCAB, size=(8,)).tolist()
+        prefix_b = rng.randint(1, VOCAB, size=(8,)).tolist()
+        prompts = []
+        for fam in (prefix_a, prefix_a, prefix_b, prefix_a,
+                    prefix_b, prefix_a, prefix_b, prefix_a):
+            prompts.append(
+                fam + rng.randint(1, VOCAB, size=(5,)).tolist())
+        news = [6, 9, 5, 12, 8, 4, 10, 7]
+        want = _reference_rows(spec, prompts, news)
+
+        def run(pool_blocks):
+            engine = DecodeEngine(
+                spec["cfg"], spec["params"], spec["decode"], slots=2,
+                prefill_len=16, prefill_chunk_tokens=4,
+                prefix_pool_blocks=pool_blocks, prefix_block_tokens=4,
+                name=f"test-prefix-{pool_blocks}")
+            try:
+                outs = [None] * len(prompts)
+
+                def client(i):
+                    outs[i] = engine.submit({
+                        "tokens": np.asarray(prompts[i], np.int32),
+                        "max_new_tokens": news[i]})
+
+                threads = [threading.Thread(target=client, args=(i,))
+                           for i in range(len(prompts))]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join()
+                return outs, engine.stats()
+            finally:
+                engine.close()
+
+        on_outs, on_stats = run(pool_blocks=1)
+        off_outs, off_stats = run(pool_blocks=0)
+        for i in range(len(prompts)):
+            got_on = np.asarray(on_outs[i]["tokens"])[0].tolist()
+            got_off = np.asarray(off_outs[i]["tokens"])[0].tolist()
+            assert got_on == want[i], f"cache ON drifted on request {i}"
+            assert got_off == want[i], f"cache OFF drifted on request {i}"
+        # The single donor row really was contended: both families
+        # admitted, so at least one eviction happened, and at least one
+        # later same-family request still hit.
+        assert on_stats["prefix_hits"] >= 1
+        assert on_stats["prefix_evictions"] >= 1
+        assert on_stats["cached_prompt_tokens"] >= 8
+        assert 0 < on_stats["cached_token_ratio"] < 1
+        assert off_stats["prefix_hits"] == 0
+        assert off_stats["cached_token_ratio"] == 0.0
+
+    def test_prefix_cache_invalidated_on_model_reload(self,
+                                                      engine_model):
+        """The prefix index must die with the model version: rebuilding
+        the batching plane (what ModelServer does around every
+        hot-swapped version) yields an engine with an EMPTY cache —
+        no stale-prefix KV can leak across versions — and identical
+        tokens before and after."""
+        from kubeflow_tpu.serving.main import batcher_factory
+
+        spec, server = engine_model
+        factory = batcher_factory(
+            micro_batch_size=0, batch_timeout_s=0.005, lm_engine=True,
+            lm_engine_slots=2, lm_engine_prefill_len=16,
+            prefill_chunk_tokens=8, prefix_pool_blocks=2,
+            prefix_block_tokens=4)
+        prompt = _prompt()
+        want = _reference_rows(spec, [prompt], [NEW_TOKENS])[0]
+        try:
+            server.enable_batching("lm", factory)
+            for _ in range(2):  # second submit hits the cached prefix
+                out = server.predict(
+                    "lm", {"tokens": np.asarray(prompt, np.int32)[None]})
+                assert np.asarray(out["tokens"])[0].tolist() == want
+            stats = server.batcher_stats("lm")
+            assert stats["prefix_hits"] >= 1
+            # Rebuild = the reload path's batcher swap: fresh engine,
+            # fresh pool, fresh index.
+            server.enable_batching("lm", factory)
+            stats = server.batcher_stats("lm")
+            assert stats["prefix_hits"] == 0
+            assert stats["cached_prompt_tokens"] == 0
+            out = server.predict(
+                "lm", {"tokens": np.asarray(prompt, np.int32)[None]})
+            assert np.asarray(out["tokens"])[0].tolist() == want
+            stats = server.batcher_stats("lm")
+            assert stats["prefix_hits"] == 0  # cold cache: a miss
+            assert stats["prefix_misses"] >= 1
+        finally:
+            server.enable_batching("lm", lambda model: None)
+
+    def test_padded_prompt_counts_true_tokens(self, engine_model):
+        """accepts()/submit() must validate the REAL token count, not
+        the padded width: a 5-token prompt right-padded to 24 (beyond
+        the 16-wide prefill window) is admitted, prefilled at its true
+        length (no pad ids in its context), and generates exactly what
+        generate() produces for the unpadded prompt."""
+        from kubeflow_tpu.serving.engine import DecodeEngine
+
+        spec, _ = engine_model
+        rng = np.random.RandomState(SEED + 9)
+        real = rng.randint(1, VOCAB, size=(5,)).tolist()
+        padded = np.zeros((24,), np.int32)
+        padded[:5] = real
+        want = _reference_rows(spec, [real], [6])[0]
+        engine = DecodeEngine(spec["cfg"], spec["params"],
+                              spec["decode"], slots=1, prefill_len=16,
+                              name="test-padded")
+        try:
+            assert engine.accepts({"tokens": padded})
+            out = engine.submit({"tokens": padded, "max_new_tokens": 6})
+            assert np.asarray(out["tokens"])[0].tolist() == want
+            # Explicit prompt_len wins over the trailing-pad heuristic
+            # (a prompt whose real tail IS token 0 stays intact).
+            assert engine.accepts(
+                {"tokens": padded, "prompt_len": np.int32(5)})
+            out = engine.submit({"tokens": padded, "prompt_len": 5,
+                                 "max_new_tokens": 6})
+            assert np.asarray(out["tokens"])[0].tolist() == want
+            # A prompt whose REAL length exceeds the window still falls
+            # back (accepts() False), padded or not.
+            wide = np.arange(1, 25, dtype=np.int32)
+            assert not engine.accepts({"tokens": wide})
+        finally:
+            engine.close()
+
+    def test_final_chunk_near_cache_end_stays_in_bounds(
+            self, engine_model):
+        """A cached-prefix resume whose final chunk window would run
+        past the slot's max_len must not corrupt the cache: XLA's
+        dynamic_update_slice CLAMPS an out-of-bounds start (shifting
+        the whole chunk onto earlier valid columns), so the engine
+        pulls the final chunk's start back and recomputes the overlap
+        instead.  Geometry: prefill_len=16, max_len=18, chunk 8, a
+        12-column cached prefix -> naive window [12, 20) > 18."""
+        from kubeflow_tpu.serving.engine import DecodeEngine
+
+        spec, _ = engine_model
+        rng = np.random.RandomState(SEED + 11)
+        prompt = rng.randint(1, VOCAB, size=(15,)).tolist()
+        want = _reference_rows(spec, [prompt, prompt], [3, 3])
+        engine = DecodeEngine(
+            spec["cfg"], spec["params"], spec["decode"], slots=1,
+            prefill_len=16, max_len=18, prefill_chunk_tokens=8,
+            prefix_pool_blocks=1, prefix_block_tokens=4,
+            name="test-chunk-bounds")
+        try:
+            for i in range(2):  # second run resumes from 12 cached cols
+                out = engine.submit({
+                    "tokens": np.asarray(prompt, np.int32),
+                    "max_new_tokens": 3})
+                assert np.asarray(out["tokens"])[0].tolist() == want[i]
+            stats = engine.stats()
+            assert stats["prefix_hits"] == 1
+            assert stats["cached_prompt_tokens"] == 12
+        finally:
+            engine.close()
 
     def test_budget_clamped_to_config(self, engine_model):
         """A request asking for more than the export config's
@@ -428,7 +611,8 @@ class TestDecodeEngine:
         record = bench.bench_lm_engine(None, devices, len(devices),
                                        on_tpu=False)
         detail = record["detail"]
-        assert detail["compiled_programs"] == {"prefill": 1, "step": 1}
+        assert detail["compiled_programs"] == {
+            "chunked_prefill": 1, "copy_prefix": 1, "step": 1}
         assert detail["engine_vs_batcher"] > 1.0, (
             f"engine {detail['engine_tokens_per_sec']} tok/s did not "
             f"beat static batcher {detail['batcher_tokens_per_sec']} "

@@ -1,0 +1,114 @@
+"""Host-side prefix index (serving/prefix_cache.py): block-hashed
+longest-prefix lookup, LRU + refcount eviction, and the invariants the
+DecodeEngine's shared-prefix reuse leans on."""
+
+import numpy as np
+import pytest
+
+from kubeflow_tpu.serving.prefix_cache import PrefixIndex
+
+
+def toks(*vals):
+    return np.asarray(vals, np.int32)
+
+
+class TestPrefixIndex:
+    def test_longest_block_prefix_match(self):
+        idx = PrefixIndex(rows=2, block_tokens=2, pool_len=8)
+        row, evicted = idx.begin_capture()
+        assert (row, evicted) == (0 if row == 0 else row, False)
+        published = idx.commit_capture(row, toks(1, 2, 3, 4, 5, 6), 6)
+        assert published == 6  # three full blocks
+        # Full three-block match, capped by limit.
+        assert idx.lookup(toks(1, 2, 3, 4, 5, 6, 7), limit=6) == (row, 6)
+        # limit forces at least one recomputed token: only 2 blocks fit.
+        assert idx.lookup(toks(1, 2, 3, 4, 5, 6), limit=5) == (row, 4)
+        # Divergence after one block matches one block.
+        assert idx.lookup(toks(1, 2, 9, 9, 9, 9), limit=6) == (row, 2)
+        # Different first block: no match (chained digests — a shared
+        # MIDDLE block must not match).
+        assert idx.lookup(toks(9, 2, 3, 4), limit=4) == (None, 0)
+        # Sub-block prefixes can't match.
+        assert idx.lookup(toks(1, 2), limit=1) == (None, 0)
+
+    def test_partial_trailing_block_never_published(self):
+        idx = PrefixIndex(rows=1, block_tokens=4, pool_len=16)
+        row, _ = idx.begin_capture()
+        assert idx.commit_capture(row, toks(*range(1, 7)), 6) == 4
+        assert idx.lookup(toks(*range(1, 9)), limit=7) == (row, 4)
+
+    def test_lru_eviction_prefers_least_recently_used(self):
+        idx = PrefixIndex(rows=2, block_tokens=2, pool_len=4)
+        a, _ = idx.begin_capture()
+        idx.commit_capture(a, toks(1, 1), 2)
+        b, _ = idx.begin_capture()
+        idx.commit_capture(b, toks(2, 2), 2)
+        # Touch A so B becomes LRU.
+        assert idx.lookup(toks(1, 1, 3), limit=2) == (a, 2)
+        c, evicted = idx.begin_capture()
+        assert evicted and c == b
+        idx.commit_capture(c, toks(3, 3), 2)
+        assert idx.evictions == 1
+        assert idx.lookup(toks(2, 2, 9), limit=2) == (None, 0)  # gone
+        assert idx.lookup(toks(1, 1, 9), limit=2) == (a, 2)     # kept
+
+    def test_pinned_rows_never_evicted(self):
+        idx = PrefixIndex(rows=1, block_tokens=2, pool_len=4)
+        row, _ = idx.begin_capture()
+        # Mid-capture (pinned, uncommitted): the only row is pinned, so
+        # a second capture must be refused, not steal it.
+        assert idx.begin_capture() == (None, False)
+        idx.commit_capture(row, toks(5, 5), 2)
+        # Committed rows are unpinned and evictable again.
+        row2, evicted = idx.begin_capture()
+        assert row2 == row and evicted
+
+    def test_abort_returns_row_without_publishing(self):
+        idx = PrefixIndex(rows=1, block_tokens=2, pool_len=4)
+        row, _ = idx.begin_capture()
+        idx.abort_capture(row)
+        assert idx.lookup(toks(1, 1, 1), limit=2) == (None, 0)
+        row2, evicted = idx.begin_capture()
+        assert row2 == row and not evicted  # free again, no eviction
+
+    def test_too_short_commit_is_released(self):
+        idx = PrefixIndex(rows=1, block_tokens=4, pool_len=8)
+        row, _ = idx.begin_capture()
+        assert idx.commit_capture(row, toks(1, 2, 3), 3) == 0
+        row2, evicted = idx.begin_capture()
+        assert row2 == row and not evicted
+
+    def test_invalidate_forgets_everything(self):
+        idx = PrefixIndex(rows=2, block_tokens=2, pool_len=4)
+        row, _ = idx.begin_capture()
+        idx.commit_capture(row, toks(1, 2, 3, 4), 4)
+        assert idx.lookup(toks(1, 2, 3, 4, 5), limit=4)[1] == 4
+        idx.invalidate()
+        assert idx.lookup(toks(1, 2, 3, 4, 5), limit=4) == (None, 0)
+        assert idx.stats()["committed_rows"] == 0
+        # All rows are allocatable again.
+        assert idx.begin_capture()[0] is not None
+        assert idx.begin_capture()[0] is not None
+
+    def test_digest_collision_first_writer_wins(self):
+        """Two rows committing the SAME prefix (racing captures of one
+        hot prompt): the established row keeps serving its digests, so
+        evicting the duplicate later cannot orphan the prefix."""
+        idx = PrefixIndex(rows=2, block_tokens=2, pool_len=4)
+        a, _ = idx.begin_capture()
+        idx.commit_capture(a, toks(1, 2, 3, 4), 4)
+        b, _ = idx.begin_capture()
+        idx.commit_capture(b, toks(1, 2, 3, 4), 4)  # duplicate chain
+        assert idx.lookup(toks(1, 2, 3, 4, 5), limit=4) == (a, 4)
+        # Evict b (a was just touched, so b is LRU) — the prefix must
+        # survive because b never owned its digests.
+        c, evicted = idx.begin_capture()
+        assert evicted and c == b
+        idx.commit_capture(c, toks(7, 8), 2)
+        assert idx.lookup(toks(1, 2, 3, 4, 5), limit=4) == (a, 4)
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            PrefixIndex(rows=0, block_tokens=2, pool_len=4)
+        with pytest.raises(ValueError):
+            PrefixIndex(rows=1, block_tokens=0, pool_len=4)
