@@ -587,8 +587,14 @@ def bench_serving(args, devices, n_chips, on_tpu):
                 pbs = _glob.glob(
                     f"{tmp}/xprof/**/*.xplane.pb", recursive=True)
                 if pbs:
+                    # Newest by mtime, NOT lexicographic max: the
+                    # profiler can emit several xplane files (multi-
+                    # host) and a leftover trace in the same dir would
+                    # silently mis-measure the device ceiling.
+                    import os as _os
+
                     device_ms_per_batch = device_busy_ms(
-                        max(pbs)) / probe_reps
+                        max(pbs, key=_os.path.getmtime)) / probe_reps
             except Exception as e:
                 print(f"device xprof probe unavailable: {e}",
                       file=sys.stderr)

@@ -43,15 +43,22 @@ def main(argv=None) -> int:
     ap.add_argument("--fake-kube", action="store_true",
                     help="run against the in-memory cluster (demo/tests)")
     ap.add_argument("--metrics-port", type=int, default=0,
-                    help="serve Prometheus /metrics on this port (0=off)")
+                    help="serve Prometheus /metrics on this port (0=off); "
+                         "also serves the scheduler queue at /queue")
+    ap.add_argument("--no-scheduler", action="store_true",
+                    help="disable the multi-tenant policy layer and "
+                         "admit jobs gang-FIFO (the pre-scheduler "
+                         "behavior)")
     args = ap.parse_args(argv)
 
     logging.basicConfig(level=logging.INFO, stream=sys.stderr)
     from kubeflow_tpu.operator.gang import GangScheduler
     from kubeflow_tpu.operator.kube import FakeKube
     from kubeflow_tpu.operator.reconciler import TPUJobController
+    from kubeflow_tpu.scheduler import ClusterScheduler, SchedulerConfig
 
     inventory = parse_inventory(args.inventory)
+    scheduler_config = SchedulerConfig()
     if args.controller_config_file:
         import json
 
@@ -59,6 +66,9 @@ def main(argv=None) -> int:
             config = json.load(f)
         if "inventory" in config:
             inventory = {k: int(v) for k, v in config["inventory"].items()}
+        if "scheduler" in config:
+            scheduler_config = SchedulerConfig.from_dict(
+                config["scheduler"])
 
     if args.fake_kube:
         kube = FakeKube()
@@ -87,13 +97,25 @@ def main(argv=None) -> int:
                 "no cluster access (%s); use --fake-kube for local runs",
                 err)
             return 1
-    controller = TPUJobController(kube, GangScheduler(inventory))
+    gang = GangScheduler(inventory)
+    # The multi-tenant policy layer is on by default: with an empty
+    # config (no quotas, one priority class in play) it behaves like
+    # weighted-fair FIFO plus provably-safe backfill, and quota/
+    # priority/preemption policy arrives via the controller ConfigMap
+    # without a redeploy of the binary.
+    cluster = None if args.no_scheduler else ClusterScheduler(
+        gang, scheduler_config)
+    controller = TPUJobController(kube, gang, cluster)
     if args.metrics_port:
         from kubeflow_tpu.runtime.prom import serve_metrics
 
-        serve_metrics(args.metrics_port)
+        routes = {}
+        if cluster is not None:
+            routes["/queue"] = cluster.status
+        serve_metrics(args.metrics_port, json_routes=routes)
         logging.info("metrics on :%d/metrics", args.metrics_port)
-    logging.info("operator up; inventory=%s", inventory)
+    logging.info("operator up; inventory=%s scheduler=%s", inventory,
+                 "off" if cluster is None else "on")
     controller.run(poll_interval_s=args.poll_interval_s,
                    max_iterations=args.max_iterations)
     return 0

@@ -19,6 +19,19 @@ Failure semantics fix the reference's two warts (SURVEY.md §5):
 
 Level-triggered: ``reconcile_once`` is idempotent and polls, like
 controller-runtime; no watch plumbing to mock in tests.
+
+Multi-tenant mode: when a
+:class:`~kubeflow_tpu.scheduler.queue.ClusterScheduler` is attached,
+``reconcile_all`` consults it for an admission :class:`Plan` instead
+of offering CRs to the gang in listing order — quotas, weighted-fair
+ordering, priority classes, backfill, and preemption all live in that
+policy layer (kubeflow_tpu/scheduler/).  A ``preempt`` verdict drives
+the ``Preempting`` phase here: the victim keeps its pods and claim
+for a checkpoint grace window (policy clock, skewable), then the gang
+is torn down through the same machinery a worker failure uses and the
+job re-queues flagged ``resumable`` — on re-admission the trainer's
+``CheckpointManager.restore_or_init`` continues from the latest saved
+step instead of step 0.
 """
 
 from __future__ import annotations
@@ -39,6 +52,7 @@ from kubeflow_tpu.operator.kube import (
     NotFound,
 )
 from kubeflow_tpu.runtime import bootstrap
+from kubeflow_tpu.testing import faults
 
 log = logging.getLogger(__name__)
 
@@ -49,6 +63,7 @@ LABEL_INDEX = "kubeflow-tpu.org/worker-index"
 QUEUED = "Queued"
 STARTING = "Starting"
 JOB_RUNNING = "Running"
+JOB_PREEMPTING = "Preempting"
 JOB_SUCCEEDED = "Succeeded"
 JOB_FAILED = "Failed"
 TERMINAL = (JOB_SUCCEEDED, JOB_FAILED)
@@ -141,12 +156,19 @@ def build_worker_pod(job: crd.TPUJobSpec, index: int) -> dict:
 
 
 class TPUJobController:
-    def __init__(self, kube: FakeKube, scheduler: GangScheduler):
+    def __init__(self, kube: FakeKube, scheduler: GangScheduler,
+                 cluster=None):
         self.kube = kube
         self.scheduler = scheduler
+        # Optional policy layer (scheduler.ClusterScheduler): when set,
+        # admission order/quotas/priorities/preemption come from its
+        # per-pass Plan instead of gang FIFO.
+        self.cluster = cluster
         # Transient per-job bookkeeping (admission timestamps for the
         # gang-schedule-to-running metric; restart counts live in status).
         self._admitted_at: Dict[str, float] = {}
+        # Preemption grace deadlines on the policy clock, keyed by job.
+        self._preempt_deadline: Dict[str, float] = {}
         self.metrics: List[dict] = []
 
     # -- main loop --------------------------------------------------------
@@ -163,12 +185,41 @@ class TPUJobController:
     def reconcile_all(self) -> None:
         from kubeflow_tpu.runtime.prom import REGISTRY
 
-        phases: dict = {}
-        for cr_obj in self.kube.list_custom():
-            if cr_obj.get("kind") != crd.KIND:
-                continue
+        crs = [cr for cr in self.kube.list_custom()
+               if cr.get("kind") == crd.KIND]
+        decisions: dict = {}
+        order: Dict[str, int] = {}
+        if self.cluster is not None:
             try:
-                phase = self.reconcile_once(cr_obj)
+                plan = self.cluster.plan(crs)
+                decisions = plan.decisions
+                order = {key: i for i, key in enumerate(plan.order)}
+            except Exception:
+                # A wedged policy pass (scheduler.admit fault, config
+                # bug) must not stop already-admitted gangs from being
+                # reconciled: fall back to no-decision, which keeps
+                # running jobs running and pending jobs Queued.
+                log.exception("scheduler plan failed; holding queue")
+                REGISTRY.counter(
+                    "kft_scheduler_plan_errors_total",
+                    "admission-plan passes that raised",
+                ).inc()
+
+        def cr_key(cr_obj: dict) -> str:
+            meta = cr_obj.get("metadata", {})
+            return (f"{meta.get('namespace', 'kubeflow')}/"
+                    f"{meta.get('name', '')}")
+
+        # Plan order first (admissions land exactly as simulated),
+        # then everything the plan didn't rank, in listing order.
+        if order:
+            crs.sort(key=lambda cr: order.get(cr_key(cr), len(order)))
+
+        phases: dict = {}
+        for cr_obj in crs:
+            try:
+                phase = self.reconcile_once(
+                    cr_obj, decision=decisions.get(cr_key(cr_obj)))
                 phases[phase] = phases.get(phase, 0) + 1
             except ValueError as e:  # SpecError + topology parse errors
                 self._set_phase(cr_obj, JOB_FAILED, reason="InvalidSpec",
@@ -188,14 +239,21 @@ class TPUJobController:
         ).inc()
         gauge = REGISTRY.gauge(
             "kft_operator_jobs", "TPUJobs by phase at last sweep")
-        for phase in (QUEUED, STARTING, JOB_RUNNING, JOB_SUCCEEDED,
-                      JOB_FAILED):
+        for phase in (QUEUED, STARTING, JOB_RUNNING, JOB_PREEMPTING,
+                      JOB_SUCCEEDED, JOB_FAILED):
             gauge.set(phases.get(phase, 0), phase=phase)
 
     # -- single-job reconcile --------------------------------------------
 
-    def reconcile_once(self, cr_obj: dict) -> str:
-        """Reconcile one CR dict; returns the resulting phase."""
+    def reconcile_once(self, cr_obj: dict, decision=None) -> str:
+        """Reconcile one CR dict; returns the resulting phase.
+
+        ``decision`` is this job's verdict from the cluster
+        scheduler's plan (None when no policy layer is attached, or
+        for CRs the plan could not parse): ``admit`` gates the gang
+        offer, ``wait``/``unsatisfiable`` replace the FIFO queue
+        semantics, ``preempt`` drives the grace-window eviction.
+        """
         job = crd.TPUJobSpec.from_custom_resource(cr_obj)
         status = cr_obj.get("status", {}) or {}
         phase = status.get("phase", "")
@@ -203,13 +261,159 @@ class TPUJobController:
 
         if phase in TERMINAL:
             self.scheduler.release(key)
+            self._preempt_deadline.pop(key, None)
+            if self.cluster is not None:
+                self.cluster.forget(key)
             return phase
 
+        # 0. Preemption: a higher-priority job needs this gang's
+        # slices.  Grace window first (checkpoint-on-SIGTERM
+        # contract), teardown + resumable re-queue after.  A gang
+        # that FINISHES during the grace is a completion, not an
+        # eviction — without this check the preempt branch would
+        # return before pod observation every pass, then tear down
+        # and pointlessly re-run an already-succeeded job.
+        if decision is not None and decision.action == "preempt" \
+                and self.scheduler.admitted(key):
+            pods = self.kube.list_pods(job.namespace,
+                                       labels={LABEL_JOB: job.name})
+            done = [(p.get("status") or {}).get("phase", PENDING)
+                    for p in pods]
+            if len(pods) == job.num_workers and all(
+                    ph == SUCCEEDED for ph in done):
+                self._set_phase(cr_obj, JOB_SUCCEEDED,
+                                reason="AllWorkersDone",
+                                message="gang completed during "
+                                        "preemption grace")
+                self.scheduler.release(key)
+                self._admitted_at.pop(key, None)
+                self._preempt_deadline.pop(key, None)
+                if self.cluster is not None:
+                    self.cluster.forget(key)
+                return JOB_SUCCEEDED
+            if any(ph == FAILED for ph in done):
+                # The gang DIED during the grace: nothing is
+                # checkpointing, so the window buys nobody anything —
+                # cut it short, count the failure against the restart
+                # budget exactly like a WorkerFailed restart would,
+                # and hand the slices over now.
+                restarts = int(status.get("restarts", 0))
+                self._preempt_deadline.pop(key, None)
+                self._teardown_pods(job)
+                self.scheduler.release(key)
+                self._admitted_at.pop(key, None)
+                if restarts + 1 > job.restart.max_restarts:
+                    self._set_phase(
+                        cr_obj, JOB_FAILED,
+                        reason="MaxRestartsExceeded",
+                        message=(f"{done.count(FAILED)} worker(s) "
+                                 f"failed during preemption grace; "
+                                 f"restarts={restarts}"),
+                        extra={"restarts": restarts})
+                    if self.cluster is not None:
+                        self.cluster.forget(key)
+                    return JOB_FAILED
+                if self.cluster is not None:
+                    self.cluster.note_preempted(key)
+                self.kube.record_event(
+                    job.namespace, f"TPUJob/{job.name}",
+                    "WorkerFailed",
+                    f"{done.count(FAILED)} worker(s) failed during "
+                    f"preemption grace; grace cut short, gang restart "
+                    f"{restarts + 1}/{job.restart.max_restarts} on "
+                    f"re-admission", type_="Warning")
+                self._set_phase(
+                    cr_obj, QUEUED, reason="PreemptedRequeued",
+                    message="gang failed during preemption grace; "
+                            "resumes from latest checkpoint",
+                    extra={"resumable": True,
+                           "restarts": restarts + 1})
+                return QUEUED
+            return self._preempt(cr_obj, job, status, decision)
+
         # 1. Gang admission (all slices or nothing).
-        admitted = self.scheduler.offer(
-            key, job.slice_type, job.num_slices, queue=job.queue or "default"
-        )
+        if decision is None and self.cluster is not None:
+            # Policy mode, but the plan had no verdict for this job
+            # (plan pass failed, or the CR appeared mid-pass).  Never
+            # fall through to the gang FIFO — that would bypass every
+            # quota/priority rule.  Admitted jobs keep running; the
+            # rest hold for the next plan.
+            if not self.scheduler.admitted(key):
+                if phase != QUEUED:
+                    self._set_phase(
+                        cr_obj, QUEUED, reason="WaitingForScheduler",
+                        message="no admission verdict this pass")
+                return QUEUED
+            if phase == JOB_PREEMPTING:
+                # Mid-grace victim with no verdict this pass: hold the
+                # eviction state; the next healthy plan re-issues the
+                # preempt decision and the grace deadline persists.
+                return JOB_PREEMPTING
+            admitted = True
+        elif decision is None:
+            admitted = self.scheduler.offer(
+                key, job.slice_type, job.num_slices,
+                queue=job.queue or "default"
+            )
+        elif self.scheduler.admitted(key):
+            if phase == JOB_PREEMPTING and \
+                    self._preempt_deadline.pop(key, None) is not None:
+                # The plan withdrew the eviction (shortage resolved
+                # mid-grace): the gang was never torn down, so it just
+                # keeps running; a future eviction starts a new grace.
+                # Revert the eviction stamps — the job was never
+                # actually preempted, so neither the resumable flag
+                # nor the preemption count may survive (the next
+                # _set_phase below persists the corrected status).
+                status = dict(status)
+                status["resumable"] = False
+                status["preemptions"] = max(
+                    0, int(status.get("preemptions", 1)) - 1)
+                cr_obj["status"] = status
+                self.kube.record_event(
+                    job.namespace, f"TPUJob/{job.name}",
+                    "PreemptionCancelled", decision.message)
+            admitted = True
+        elif decision.action == "admit":
+            # The plan validated capacity against the same gang
+            # snapshot in this reconcile pass, so the offer admits
+            # immediately — the gang's own FIFO queue stays empty in
+            # policy mode.
+            admitted = self.scheduler.offer(
+                key, job.slice_type, job.num_slices,
+                queue=job.queue or "default")
+            if admitted and self.cluster is not None:
+                self.cluster.note_admitted(
+                    key, backfilled=decision.backfilled,
+                    resumed=bool(status.get("resumable")))
+                if status.get("resumable"):
+                    # The flag is CONSUMED by this resume admission:
+                    # a later ordinary gang restart must not count as
+                    # another resume.  `preemptions` stays — that one
+                    # is history.  Persisted by the _set_phase the
+                    # materialize step below is guaranteed to make
+                    # (phase was Queued).
+                    status = dict(status)
+                    status["resumable"] = False
+                    cr_obj["status"] = status
+        elif decision.action == "unsatisfiable":
+            self._set_phase(cr_obj, JOB_FAILED,
+                            reason=decision.reason or
+                            "UnsatisfiableResources",
+                            message=decision.message)
+            self.scheduler.release(key)
+            if self.cluster is not None:
+                self.cluster.forget(key)
+            return JOB_FAILED
+        else:
+            admitted = False
         if not admitted:
+            if decision is not None:
+                reason = decision.reason or "WaitingForSlices"
+                if phase != QUEUED or status.get("reason") != reason:
+                    self._set_phase(cr_obj, QUEUED, reason=reason,
+                                    message=decision.message)
+                return QUEUED
             if self.scheduler.unsatisfiable(key):
                 # Demand exceeds total inventory: it can NEVER run.  Fail
                 # fast with a clear message and release the queue slot so
@@ -309,6 +513,57 @@ class TPUJobController:
         return STARTING
 
     # -- helpers ----------------------------------------------------------
+
+    def _preempt(self, cr_obj: dict, job: crd.TPUJobSpec,
+                 status: dict, decision) -> str:
+        """Drive one job through eviction: grace window, then teardown
+        and a ``resumable`` re-queue.
+
+        The grace deadline lives on the skewable policy clock
+        (``faults.monotonic``) in controller memory, not CR status —
+        it is an operator-process promise (like ``_admitted_at``), and
+        an operator restart simply restarts the window, which only
+        ever gives the victim MORE time to checkpoint."""
+        key = f"{job.namespace}/{job.name}"
+        now = faults.monotonic()
+        grace = (self.cluster.config.preemption.grace_period_s
+                 if self.cluster is not None else 0.0)
+        deadline = self._preempt_deadline.get(key)
+        preemptions = int(status.get("preemptions", 0))
+        if deadline is None:
+            self._preempt_deadline[key] = now + grace
+            self.kube.record_event(
+                job.namespace, f"TPUJob/{job.name}", "Preempted",
+                f"{decision.message}; checkpoint grace {grace:g}s",
+                type_="Warning")
+            self._set_phase(
+                cr_obj, JOB_PREEMPTING, reason="Preempted",
+                message=(f"{decision.message}; "
+                         f"checkpoint grace {grace:g}s"),
+                extra={"resumable": True,
+                       "preemptions": preemptions + 1})
+            return JOB_PREEMPTING
+        if now < deadline:
+            return JOB_PREEMPTING
+        # Grace spent: tear the gang down through the same machinery a
+        # worker failure uses and hand the slices back.  The job
+        # re-queues resumable — its next admission restarts the gang,
+        # and the trainer's restore_or_init picks up the latest
+        # checkpoint (no step-0 retraining).
+        self._teardown_pods(job)
+        self.scheduler.release(key)
+        self._admitted_at.pop(key, None)
+        self._preempt_deadline.pop(key, None)
+        if self.cluster is not None:
+            self.cluster.note_preempted(key)
+        self.metrics.append({"event": "gang_preempted", "job": key,
+                             "preemptor": decision.preemptor})
+        self._set_phase(
+            cr_obj, QUEUED, reason="PreemptedRequeued",
+            message="awaiting re-admission; resumes from latest "
+                    "checkpoint",
+            extra={"resumable": True})
+        return QUEUED
 
     def _gang_restart(self, cr_obj: dict, job: crd.TPUJobSpec,
                       restarts: int, reason: str, message: str) -> str:

@@ -125,6 +125,20 @@ def initialize(
     if platforms:
         import jax
 
+        if _backends_already_initialized():
+            # The override below is a no-op once backends exist (e.g.
+            # a tool touched jax.devices() before initialize()) — the
+            # CPU fake-slice run this defends against would silently
+            # land on the real chip.  Loud, because the symptom at
+            # train time (wrong device kind) is far from the cause.
+            log.warning(
+                "JAX backends were already initialized before "
+                "bootstrap.initialize(); JAX_PLATFORMS=%r cannot take "
+                "effect — set it before the first jax.devices()/jit "
+                "call (platform now: %s)",
+                platforms,
+                ",".join(sorted({d.platform for d in jax.devices()})),
+            )
         jax.config.update("jax_platforms", platforms)
     if not env.is_distributed:
         log.info("single-process job; skipping jax.distributed")
@@ -143,6 +157,23 @@ def initialize(
         env.process_id, env.num_processes, jax.device_count(),
     )
     return env
+
+
+def _backends_already_initialized() -> bool:
+    """True when JAX has materialized its backends (after which
+    ``jax_platforms`` updates are silently ignored).  Best-effort
+    across jax versions: the check lives in a private module, so an
+    API move degrades to 'unknown' (False) rather than breaking
+    initialize()."""
+    try:
+        from jax._src import xla_bridge
+
+        probe = getattr(xla_bridge, "backends_are_initialized", None)
+        if probe is not None:
+            return bool(probe())
+        return bool(getattr(xla_bridge, "_backends", None))
+    except Exception:
+        return False
 
 
 def _wait_dns(host: str, timeout_s: float, poll_s: float = 2.0) -> None:

@@ -79,6 +79,14 @@ class Gauge(_Metric):
         with self._lock:
             self._values[_label_key(labels)] = float(value)
 
+    def labelsets(self) -> List[Dict[str, str]]:
+        """Every label combination this gauge has ever been set with —
+        what a control loop zeroes before re-exporting a sparse
+        snapshot (a drained queue bucket must scrape as 0, not hold
+        its last value)."""
+        with self._lock:
+            return [dict(key) for key in self._values]
+
     def _render(self) -> List[str]:
         with self._lock:
             return [
@@ -263,20 +271,41 @@ def sample_value(parsed: Dict[str, List[Tuple[Dict[str, str], float]]],
 
 
 def serve_metrics(port: int, registry: Optional[Registry] = None,
-                  host: str = "0.0.0.0"):
+                  host: str = "0.0.0.0", json_routes=None):
     """Start a daemon-thread HTTP server exposing /metrics.
 
     Returns (httpd, thread); pass port from the daemon's --metrics-port.
+    ``json_routes`` maps extra paths to zero-arg callables whose return
+    value is served as JSON — how the operator exposes its scheduler
+    queue (``/queue``) on the same port the scrape already hits.
     """
+    import json as _json
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
     reg = registry or REGISTRY
+    routes = dict(json_routes or {})
 
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, fmt, *args):
             pass
 
         def do_GET(self):
+            if self.path in routes:
+                try:
+                    # dumps inside the try: a non-serializable payload
+                    # must also degrade to the 500 body, not kill the
+                    # connection mid-handler.
+                    data = _json.dumps(routes[self.path]()).encode()
+                except Exception as exc:  # surface, don't kill the server
+                    data = _json.dumps({"error": str(exc)}).encode()
+                    self.send_response(500)
+                else:
+                    self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+                return
             if self.path != "/metrics":
                 self.send_response(404)
                 self.end_headers()

@@ -1,0 +1,38 @@
+"""Multi-tenant cluster scheduling control plane.
+
+The policy layer BETWEEN submitted ``TPUJob`` CRs and the gang placer
+(``operator/gang.py``).  The gang scheduler answers "does this job's
+full slice demand fit right now"; this package answers "which job
+should be offered next, whose claim should be revoked, and why" —
+per-tenant quotas, weighted-fair ordering, strict priority classes,
+conservative backfill, and preemption-with-resume.  Both *Gavel*
+(heterogeneity-aware cluster scheduling) and the speculative-container
+scheduling line of work locate the win exactly here: a policy core
+above the placer, not a smarter placer.
+
+Layout:
+    policy.py   SchedulerConfig + the admission-plan engine
+    queue.py    persistent pending-queue bookkeeping + ClusterScheduler
+                (the facade the reconciler consults)
+    preempt.py  victim selection + the preemption rate limiter
+"""
+
+from kubeflow_tpu.scheduler.policy import (  # noqa: F401
+    DEFAULT_PRIORITY_CLASSES,
+    LABEL_PRIORITY,
+    LABEL_TENANT,
+    Decision,
+    JobView,
+    Plan,
+    SchedulerConfig,
+    SchedulingPolicy,
+)
+from kubeflow_tpu.scheduler.preempt import (  # noqa: F401
+    PreemptionConfig,
+    PreemptionRateLimiter,
+    pick_victims,
+)
+from kubeflow_tpu.scheduler.queue import (  # noqa: F401
+    ClusterScheduler,
+    SchedulerQueue,
+)

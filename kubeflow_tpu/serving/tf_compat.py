@@ -62,8 +62,13 @@ def tensorproto_to_numpy(t: pb.TensorProto):
     if np_dtype is None:
         raise ValueError(f"unsupported TensorProto dtype {t.dtype}")
     if t.tensor_content:
+        # frombuffer views the (immutable) protobuf bytes read-only; a
+        # consumer normalizing/padding the input dict in place would
+        # hit 'assignment destination is read-only' only on THIS
+        # encoding, a payload-dependent failure.  Inputs are request-
+        # sized: the copy is cheap next to the decode.
         arr = np.frombuffer(t.tensor_content, dtype=np_dtype)
-        return arr.reshape(shape)
+        return arr.reshape(shape).copy()
     vals = np.asarray(
         list(getattr(t, _VAL_FIELD[t.dtype])))
     if t.dtype == 19:  # half_val carries raw uint16 bit patterns
@@ -71,7 +76,8 @@ def tensorproto_to_numpy(t: pb.TensorProto):
     vals = vals.astype(np_dtype)
     n = int(np.prod(shape)) if shape else vals.size
     if vals.size == 1 and n > 1:
-        vals = np.broadcast_to(vals, (n,))
+        # broadcast_to also yields a read-only view; same contract.
+        vals = np.broadcast_to(vals, (n,)).copy()
     return vals.reshape(shape)
 
 

@@ -744,6 +744,214 @@ def fleet_smoke(namespace: str = "kubeflow-test") -> None:
                 srv.stop()
 
 
+def scheduler_smoke(namespace: str = "kubeflow-test") -> None:
+    """Hermetic multi-tenant scheduler scenario: two tenants' TPUJobs
+    through the fake apiserver (real sockets, HttpKube) against the
+    policy layer (kubeflow_tpu/scheduler/) + gang + reconciler:
+
+      1. quota — a greedy tenant's third job holds at QuotaExceeded
+         while a politer tenant admitted later runs;
+      2. backfill — a small low-priority job provably jumps a blocked
+         large high-priority job (disjoint slice pools) and the large
+         job's admission is not delayed;
+      3. preemption with resume — a high-priority arrival evicts the
+         lowest-priority gang through the Preempting grace window
+         (clock-skewed, no wall sleeping); the victim re-queues
+         ``resumable`` and, after the preemptor finishes, restarts and
+         resumes from its latest CheckpointManager step (> 0, no
+         step-0 retraining);
+      4. every outcome is scrapeable in kft_scheduler_* metrics.
+    """
+    import numpy as np
+
+    from kubeflow_tpu.operator import crd
+    from kubeflow_tpu.operator.gang import GangScheduler
+    from kubeflow_tpu.operator.kube_http import HttpKube
+    from kubeflow_tpu.operator.reconciler import (
+        JOB_PREEMPTING,
+        JOB_SUCCEEDED,
+        QUEUED,
+        STARTING,
+        TPUJobController,
+    )
+    from kubeflow_tpu.runtime.checkpoint import CheckpointManager
+    from kubeflow_tpu.runtime.prom import (
+        REGISTRY,
+        parse_metrics,
+        sample_value,
+    )
+    from kubeflow_tpu.scheduler import (
+        LABEL_PRIORITY,
+        LABEL_TENANT,
+        ClusterScheduler,
+        PreemptionConfig,
+        SchedulerConfig,
+    )
+    from kubeflow_tpu.testing import faults
+    from kubeflow_tpu.testing.fake_apiserver import make_fake_apiserver
+
+    def make_cr(name, tenant, priority, slice_type="v5e-8", n=1):
+        job = crd.TPUJobSpec(name=name, namespace=namespace,
+                             slice_type=slice_type, num_slices=n)
+        cr = job.to_custom_resource()
+        cr["metadata"]["labels"] = {LABEL_TENANT: tenant,
+                                    LABEL_PRIORITY: priority}
+        return cr
+
+    import tempfile
+
+    apiserver = None
+    with faults.injected("seed=20260804") as inj, \
+            tempfile.TemporaryDirectory() as tmp:
+        try:
+            apiserver, _, store = make_fake_apiserver()
+            kube = HttpKube(
+                base_url=f"http://127.0.0.1:"
+                         f"{apiserver.server_address[1]}")
+            gang = GangScheduler({"v5e-8": 4, "v5p-32": 1})
+            cluster = ClusterScheduler(gang, SchedulerConfig(
+                quotas={"greedy": {"v5e-8": 16}},
+                preemption=PreemptionConfig(grace_period_s=30.0)))
+            ctl = TPUJobController(kube, gang, cluster)
+
+            def statuses():
+                return {c["metadata"]["name"]: (c.get("status") or {})
+                        for c in kube.list_custom(namespace)}
+
+            def run_pods(job_name):
+                for p in kube.list_pods(
+                        namespace,
+                        labels={"kubeflow-tpu.org/job-name": job_name}):
+                    store.set_pod_phase(namespace,
+                                        p["metadata"]["name"],
+                                        "Running")
+
+            # -- 1. quota-capped greedy tenant ------------------------
+            for i in range(3):
+                kube.create_custom(
+                    make_cr(f"greedy-{i}", "greedy", "normal"))
+            kube.create_custom(make_cr("polite", "polite", "normal"))
+            ctl.reconcile_all()
+            st = statuses()
+            admitted = sorted(n for n in st
+                              if st[n].get("phase") == STARTING)
+            assert admitted == ["greedy-0", "greedy-1", "polite"], st
+            assert st["greedy-2"]["phase"] == QUEUED
+            assert st["greedy-2"]["reason"] == "QuotaExceeded", st
+
+            # -- 2. backfill past a blocked large job -----------------
+            kube.create_custom(
+                make_cr("vp-run", "research", "high",
+                        slice_type="v5p-32"))
+            ctl.reconcile_all()
+            kube.create_custom(
+                make_cr("vp-blocked", "research", "high",
+                        slice_type="v5p-32"))
+            kube.create_custom(make_cr("small-low", "batch", "low"))
+            ctl.reconcile_all()
+            st = statuses()
+            assert st["vp-blocked"]["reason"] == "WaitingForSlices", st
+            assert st["small-low"]["phase"] == STARTING, st
+            assert cluster.status()["counters"]["backfilled"] >= 1
+            # ETA unchanged: vp-run ends, vp-blocked starts at once
+            # with the backfilled job still holding its v5e slice.
+            run_pods("vp-run")
+            ctl.reconcile_all()
+            for p in kube.list_pods(
+                    namespace,
+                    labels={"kubeflow-tpu.org/job-name": "vp-run"}):
+                store.set_pod_phase(namespace, p["metadata"]["name"],
+                                    "Succeeded")
+            ctl.reconcile_all()
+            ctl.reconcile_all()
+            st = statuses()
+            assert st["vp-run"]["phase"] == JOB_SUCCEEDED
+            assert st["vp-blocked"]["phase"] == STARTING, st
+            assert st["small-low"]["phase"] == STARTING, st
+
+            # -- 3. preemption -> checkpoint grace -> resume ----------
+            # The victim gang's trainer has checkpointed through step
+            # 4 (what restore_or_init will find on re-admission).
+            base = np.arange(8, dtype=np.float32)
+            with CheckpointManager(f"{tmp}/victim-ckpt",
+                                   save_interval_steps=1) as mgr:
+                for step in range(5):
+                    mgr.save(step,
+                             {"step": np.full((), step, np.int32),
+                              "w": base + step})
+            kube.create_custom(make_cr("vip", "prod", "high"))
+            ctl.reconcile_all()
+            st = statuses()
+            # v5e-8 was full; the lowest-priority gang is evicted.
+            assert st["small-low"]["phase"] == JOB_PREEMPTING, st
+            assert st["small-low"]["resumable"] is True
+            assert kube.list_pods(
+                namespace,
+                labels={"kubeflow-tpu.org/job-name": "small-low"}), \
+                "pods must survive the checkpoint grace window"
+            ctl.reconcile_all()
+            assert statuses()["small-low"]["phase"] == JOB_PREEMPTING
+            inj.advance_clock(31)   # grace elapses, zero wall waiting
+            ctl.reconcile_all()
+            st = statuses()
+            assert st["small-low"]["phase"] == QUEUED
+            assert st["small-low"]["reason"] == "PreemptedRequeued", st
+            ctl.reconcile_all()
+            st = statuses()
+            assert st["vip"]["phase"] == STARTING, st
+            run_pods("vip")
+            ctl.reconcile_all()
+            for p in kube.list_pods(
+                    namespace,
+                    labels={"kubeflow-tpu.org/job-name": "vip"}):
+                store.set_pod_phase(namespace, p["metadata"]["name"],
+                                    "Succeeded")
+            ctl.reconcile_all()
+            ctl.reconcile_all()
+            st = statuses()
+            assert st["vip"]["phase"] == JOB_SUCCEEDED
+            assert st["small-low"]["phase"] == STARTING, st
+            # resumable was consumed by the resume admission; the
+            # preemption count survives as history.
+            assert st["small-low"]["resumable"] is False
+            assert int(st["small-low"]["preemptions"]) == 1
+            assert int(st["small-low"].get("restarts", 0)) == 0, \
+                "preemption must not consume the restart budget"
+            # Trainer side of the resume contract: the re-admitted
+            # gang restores step 4 and continues at 5 — never step 0.
+            fresh = {"step": np.zeros((), np.int32),
+                     "w": np.zeros(8, np.float32)}
+            with CheckpointManager(f"{tmp}/victim-ckpt") as mgr2:
+                restored, start = mgr2.restore_or_init(fresh)
+            assert start == 5, f"resume restarted at {start}"
+            np.testing.assert_allclose(restored["w"], base + 4)
+
+            # -- 4. outcomes in kft_scheduler_* metrics ---------------
+            parsed = parse_metrics(REGISTRY.render())
+            assert (sample_value(parsed,
+                                 "kft_scheduler_preemptions_total",
+                                 tenant="batch") or 0) >= 1, parsed.get(
+                "kft_scheduler_preemptions_total")
+            assert (sample_value(parsed,
+                                 "kft_scheduler_backfills_total",
+                                 tenant="batch") or 0) >= 1
+            assert (sample_value(parsed,
+                                 "kft_scheduler_resumes_total",
+                                 tenant="batch") or 0) >= 1
+            assert sample_value(parsed, "kft_scheduler_quota_chips",
+                                tenant="greedy",
+                                slice_type="v5e-8") == 16
+            assert sample_value(parsed, "kft_scheduler_queue_depth",
+                                tenant="greedy",
+                                priority="normal") is not None
+            assert "kft_scheduler_queue_wait_seconds" in parsed or \
+                "kft_scheduler_queue_wait_seconds_count" in parsed
+        finally:
+            if apiserver is not None:
+                apiserver.shutdown()
+                apiserver.server_close()
+
+
 def train_smoke(namespace: str = "kubeflow-test") -> None:
     """A few real SPMD train steps on whatever devices exist."""
     import subprocess
@@ -876,6 +1084,7 @@ COMMANDS = {
     "engine": engine_smoke,
     "faults": fault_injection_smoke,
     "fleet": fleet_smoke,
+    "scheduler": scheduler_smoke,
     "train": train_smoke,
     "deploy": deploy_real,
     "deploy-crds": deploy_crds,

@@ -23,6 +23,12 @@ Hook sites planted in production code (grep for ``faults.fire``):
                       retry/ejection layer sees it as a refused
                       connect)
     fleet.probe       endpoint registry readiness probe attempt
+    scheduler.admit   cluster scheduler admission-plan pass (skew =
+                      age the queue / expire preemption windows,
+                      raise = wedged policy pass — the reconcile
+                      error path must contain it)
+    scheduler.preempt each eviction wave the policy commits (before
+                      victims are marked)
 
 Clock skips: deadline/backoff code reads :func:`monotonic` instead of
 ``time.monotonic`` — a ``skew`` action (or ``advance_clock`` from a

@@ -133,8 +133,8 @@ def default_e2e(name: str = "e2e", namespace: str = "kubeflow-test",
 # Per-platform default step lists (ci/e2e_config.yaml's `steps:` values
 # resolve to kubeflow_tpu.testing.e2e subcommands).
 PLATFORM_STEPS = {
-    "hermetic": ["tpujob", "serving", "engine", "faults", "fleet",
-                 "train"],
+    "hermetic": ["tpujob", "scheduler", "serving", "engine", "faults",
+                 "fleet", "train"],
     "kind": ["deploy-crds", "tpujob-real"],
     "gke": ["deploy", "tpujob-real"],
 }

@@ -185,6 +185,48 @@ def cmd_fleet_status(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_queue_status(args: argparse.Namespace) -> int:
+    """Print the training scheduler's live queue — the operator's
+    one-glance view of multi-tenant admission (GET /queue on the
+    operator's metrics port, kubeflow_tpu/scheduler/queue.py), the way
+    ``fleet status`` renders serving replicas."""
+    import urllib.request
+
+    url = args.operator.rstrip("/") + "/queue"
+    with urllib.request.urlopen(url, timeout=args.timeout) as resp:
+        payload = json.loads(resp.read())
+    jobs = payload.get("jobs", [])
+    if not jobs:
+        print("queue empty: no live TPUJobs")
+    else:
+        fmt = "{:<28} {:<12} {:<8} {:>10} {:>6} {:<20} {:>8}"
+        print(fmt.format("JOB", "TENANT", "PRIORITY", "SLICES",
+                         "CHIPS", "STATE", "WAIT_S"))
+        for row in jobs:
+            wait = row.get("wait_s")
+            state = row["state"]
+            if row.get("resumable") and state not in ("Admitted",
+                                                      "Preempting"):
+                state += "*"  # resumable: restarts from checkpoint
+            print(fmt.format(row["job"], row["tenant"], row["priority"],
+                             row["slices"], int(row["chips"]), state,
+                             f"{wait:.1f}" if wait is not None else "-"))
+    for q in payload.get("quotas", []):
+        print(f"quota {q['tenant']}/{q['slice_type']}: "
+              f"{q['used_chips']}/{q['quota_chips']} chips")
+    waits = payload.get("queue_wait", {})
+    counters = payload.get("counters", {})
+    p50, p99 = waits.get("p50"), waits.get("p99")
+    print(f"queue wait p50/p99: "
+          f"{'-' if p50 is None else '%.1fs' % p50}/"
+          f"{'-' if p99 is None else '%.1fs' % p99}  "
+          f"admitted={counters.get('admitted', 0)} "
+          f"backfilled={counters.get('backfilled', 0)} "
+          f"preempted={counters.get('preempted', 0)} "
+          f"resumed={counters.get('resumed', 0)}")
+    return 0
+
+
 def cmd_version(args: argparse.Namespace) -> int:
     from kubeflow_tpu.version import version_info
 
@@ -262,6 +304,19 @@ def build_parser() -> argparse.ArgumentParser:
                        help="router base URL (default: %(default)s)")
     fstat.add_argument("--timeout", type=float, default=10.0)
     fstat.set_defaults(func=cmd_fleet_status)
+
+    p = sub.add_parser(
+        "queue",
+        help="inspect the multi-tenant training scheduler "
+             "(kubeflow_tpu/scheduler/)")
+    qsub = p.add_subparsers(dest="action", required=True)
+    qstat = qsub.add_parser(
+        "status", help="live queue/quota table from the operator")
+    qstat.add_argument("--operator", default="http://127.0.0.1:9090",
+                       help="operator metrics base URL "
+                            "(default: %(default)s)")
+    qstat.add_argument("--timeout", type=float, default=10.0)
+    qstat.set_defaults(func=cmd_queue_status)
 
     p = sub.add_parser("version", help="print version info")
     p.set_defaults(func=cmd_version)

@@ -252,3 +252,25 @@ def test_two_process_two_device_sharded_training():
     loss1 = lines[1].split("loss=")[1].split()[0]
     assert loss0 == loss1, lines
     assert "step=3" in lines[0], lines
+
+
+def test_late_jax_platforms_override_warns(monkeypatch, caplog):
+    """ADVICE r5: once JAX backends are materialized, the
+    `jax_platforms` update in initialize() is silently a no-op — the
+    CPU fake-slice run it defends against would land on the real chip
+    with zero signal.  initialize() must detect the already-built
+    backends and warn loudly."""
+    import logging
+
+    import jax
+
+    from kubeflow_tpu.runtime import bootstrap
+
+    jax.devices()  # materialize backends before initialize() runs
+    assert bootstrap._backends_already_initialized()
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    with caplog.at_level(logging.WARNING,
+                         logger="kubeflow_tpu.runtime.bootstrap"):
+        bootstrap.initialize(bootstrap.worker_env({}))
+    assert any("cannot take effect" in r.getMessage()
+               for r in caplog.records), caplog.records

@@ -100,6 +100,64 @@ def test_apply_then_delete_round_trip(app_file, monkeypatch):
     assert applied == deleted
 
 
+def test_queue_status_renders_scheduler_table(capsys):
+    """`kubeflow-tpu queue status` prints the operator scheduler's
+    live queue/quota view (GET /queue on the metrics port)."""
+    import json
+    import threading
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    payload = {
+        "jobs": [
+            {"job": "kubeflow/train-a", "tenant": "prod",
+             "priority": "high", "slices": "2xv5e-8", "chips": 16,
+             "state": "Admitted", "detail": "", "position": None,
+             "wait_s": None, "resumable": False, "preemptions": 0},
+            {"job": "kubeflow/batch-7", "tenant": "batch",
+             "priority": "low", "slices": "1xv5e-8", "chips": 8,
+             "state": "QuotaExceeded",
+             "detail": "tenant 'batch' at 16/16 chips of v5e-8",
+             "position": 0, "wait_s": 12.5, "resumable": True,
+             "preemptions": 1},
+        ],
+        "quotas": [{"tenant": "batch", "slice_type": "v5e-8",
+                    "used_chips": 16, "quota_chips": 16}],
+        "queue_wait": {"p50": 3.2, "p99": 41.0},
+        "counters": {"admitted": 9, "backfilled": 2, "preempted": 1,
+                     "resumed": 1},
+        "preemptions_in_window": 1,
+    }
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            assert self.path == "/queue"
+            data = json.dumps(payload).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        rc = cli.main([
+            "queue", "status", "--operator",
+            f"http://127.0.0.1:{httpd.server_address[1]}"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "kubeflow/train-a" in out and "Admitted" in out
+        # The resumable queued job is marked: it restarts from its
+        # checkpoint, not step 0.
+        assert "QuotaExceeded*" in out
+        assert "quota batch/v5e-8: 16/16 chips" in out
+        assert "preempted=1" in out and "backfilled=2" in out
+    finally:
+        httpd.shutdown()
+
+
 def test_fleet_status_renders_endpoint_table(capsys):
     """`kubeflow-tpu fleet status` prints the router's live replica
     table (GET /fleet/endpoints)."""

@@ -1,0 +1,619 @@
+"""Multi-tenant scheduler tests: quotas, weighted-fair ordering,
+priority classes, provable backfill, preemption-with-resume, and the
+anti-livelock rate limit.
+
+Policy decisions are exercised two ways: directly against
+``SchedulingPolicy.plan`` (a pure function of its snapshot — the unit
+surface), and through the full ``TPUJobController`` + FakeKube loop
+(the phases and status a user actually sees).
+"""
+
+import pytest
+
+from kubeflow_tpu.operator import crd
+from kubeflow_tpu.operator.gang import GangScheduler
+from kubeflow_tpu.operator.kube import SUCCEEDED, FakeKube
+from kubeflow_tpu.operator.reconciler import (
+    JOB_FAILED,
+    JOB_PREEMPTING,
+    JOB_RUNNING,
+    QUEUED,
+    STARTING,
+    TPUJobController,
+)
+from kubeflow_tpu.scheduler import (
+    LABEL_PRIORITY,
+    LABEL_TENANT,
+    ClusterScheduler,
+    JobView,
+    PreemptionConfig,
+    PreemptionRateLimiter,
+    SchedulerConfig,
+    SchedulingPolicy,
+    pick_victims,
+)
+from kubeflow_tpu.testing import faults
+
+
+def view(key, tenant="default", priority="normal", slice_type="v5e-8",
+         count=1, enqueued_at=0.0, phase="", prio_value=None):
+    cfg = SchedulerConfig()
+    chips_per = {"v5e-8": 8, "v5e-16": 16, "v5p-32": 16}[slice_type]
+    return JobView(
+        key=key, tenant=tenant, priority=priority,
+        priority_value=(prio_value if prio_value is not None
+                        else cfg.priority_value(priority)),
+        slice_type=slice_type, count=count, chips=chips_per * count,
+        phase=phase, enqueued_at=enqueued_at)
+
+
+def make_cr(name, tenant="default", priority="normal",
+            slice_type="v5e-8", num_slices=1):
+    job = crd.TPUJobSpec(name=name, slice_type=slice_type,
+                         num_slices=num_slices)
+    cr = job.to_custom_resource()
+    cr["metadata"]["labels"] = {LABEL_TENANT: tenant,
+                                LABEL_PRIORITY: priority}
+    return cr
+
+
+@pytest.fixture()
+def cluster():
+    kube = FakeKube()
+    gang = GangScheduler({"v5e-8": 4, "v5p-32": 1})
+    config = SchedulerConfig(
+        quotas={"greedy": {"v5e-8": 16}},
+        preemption=PreemptionConfig(grace_period_s=5.0))
+    sched = ClusterScheduler(gang, config)
+    return kube, gang, sched, TPUJobController(kube, gang, sched)
+
+
+def phases_by_name(kube):
+    return {c["metadata"]["name"]: (c.get("status") or {})
+            for c in kube.list_custom()}
+
+
+class TestQuota:
+    def test_quota_caps_concurrent_chips_per_tenant(self, cluster):
+        kube, gang, sched, ctl = cluster
+        # greedy: quota 16 chips of v5e-8 = 2 jobs of 8 chips.
+        for i in range(3):
+            kube.create_custom(make_cr(f"g{i}", tenant="greedy"))
+        ctl.reconcile_all()
+        st = phases_by_name(kube)
+        starting = [n for n in st if st[n]["phase"] == STARTING]
+        assert sorted(starting) == ["g0", "g1"]
+        assert st["g2"]["phase"] == QUEUED
+        assert st["g2"]["reason"] == "QuotaExceeded"
+        assert "16" in st["g2"]["message"]
+
+    def test_quota_blocked_job_does_not_wedge_other_tenants(self,
+                                                            cluster):
+        kube, gang, sched, ctl = cluster
+        for i in range(3):
+            kube.create_custom(make_cr(f"g{i}", tenant="greedy"))
+        # Arrives AFTER the over-quota job; must still be admitted.
+        kube.create_custom(make_cr("polite", tenant="polite"))
+        ctl.reconcile_all()
+        st = phases_by_name(kube)
+        assert st["g2"]["reason"] == "QuotaExceeded"
+        assert st["polite"]["phase"] == STARTING
+
+    def test_quota_frees_on_completion(self, cluster):
+        kube, gang, sched, ctl = cluster
+        for i in range(3):
+            kube.create_custom(make_cr(f"g{i}", tenant="greedy"))
+        ctl.reconcile_all()
+        for p in kube.list_pods(
+                "kubeflow", labels={"kubeflow-tpu.org/job-name": "g0"}):
+            kube.set_pod_phase("kubeflow", p["metadata"]["name"],
+                               SUCCEEDED)
+        ctl.reconcile_all()   # g0 Succeeded, claim released
+        ctl.reconcile_all()   # g2 admitted inside the freed quota
+        st = phases_by_name(kube)
+        assert st["g0"]["phase"] == "Succeeded"
+        assert st["g2"]["phase"] == STARTING
+
+    def test_unlimited_without_config(self):
+        policy = SchedulingPolicy(SchedulerConfig())
+        pending = [view(f"ns/j{i}") for i in range(4)]
+        plan = policy.plan(pending, [], {"v5e-8": 4}, {"v5e-8": 4})
+        assert all(plan.decisions[j.key].action == "admit"
+                   for j in pending)
+
+
+class TestWeightedFair:
+    def test_weights_interleave_tenants(self):
+        """Tenant b (weight 3) gets ~3x tenant a (weight 1) of a
+        contended pool, regardless of submission order."""
+        config = SchedulerConfig(weights={"a": 1.0, "b": 3.0})
+        policy = SchedulingPolicy(config)
+        pending = (
+            [view(f"ns/a{i}", tenant="a", enqueued_at=i)
+             for i in range(3)] +
+            [view(f"ns/b{i}", tenant="b", enqueued_at=10 + i)
+             for i in range(3)])
+        plan = policy.plan(pending, [], {"v5e-8": 4}, {"v5e-8": 4})
+        admitted = [k for k in plan.order
+                    if plan.decisions[k].action == "admit"]
+        assert len(admitted) == 4
+        by_tenant = {"a": 0, "b": 0}
+        for key in admitted:
+            by_tenant[key.split("/")[1][0]] += 1
+        assert by_tenant == {"a": 1, "b": 3}
+
+    def test_fifo_within_tenant_at_equal_priority(self):
+        policy = SchedulingPolicy(SchedulerConfig())
+        pending = [view(f"ns/j{i}", enqueued_at=float(i))
+                   for i in (2, 0, 1)]
+        plan = policy.plan(pending, [], {"v5e-8": 4}, {"v5e-8": 4})
+        assert plan.order == ["ns/j0", "ns/j1", "ns/j2"]
+
+    def test_strict_priority_across_fairness(self):
+        """A high job is considered before normals even when its
+        tenant is far above its fair share."""
+        config = SchedulerConfig(weights={"hog": 1.0, "meek": 1.0})
+        policy = SchedulingPolicy(config)
+        running = [view(f"ns/r{i}", tenant="hog") for i in range(3)]
+        pending = [view("ns/meek-normal", tenant="meek",
+                        enqueued_at=0.0),
+                   view("ns/hog-high", tenant="hog", priority="high",
+                        enqueued_at=1.0)]
+        plan = policy.plan(pending, running, {"v5e-8": 1},
+                           {"v5e-8": 4})
+        assert plan.order[0] == "ns/hog-high"
+        assert plan.decisions["ns/hog-high"].action == "admit"
+
+    def test_unknown_priority_class_degrades_to_default(self):
+        config = SchedulerConfig()
+        assert config.priority_value("no-such-class") == \
+            config.priority_classes["normal"]
+
+
+class TestBackfill:
+    def test_cross_type_backfill_past_blocked_head(self, cluster):
+        """FIFO would wedge the small v5e job behind the blocked v5p
+        head; the policy layer lets it jump — disjoint pools, provably
+        zero ETA impact."""
+        kube, gang, sched, ctl = cluster
+        kube.create_custom(make_cr("vp-run", priority="high",
+                                   slice_type="v5p-32"))
+        ctl.reconcile_all()
+        kube.create_custom(make_cr("vp-blocked", priority="high",
+                                   slice_type="v5p-32"))
+        kube.create_custom(make_cr("small", priority="low"))
+        ctl.reconcile_all()
+        st = phases_by_name(kube)
+        assert st["vp-blocked"]["phase"] == QUEUED
+        assert st["vp-blocked"]["reason"] == "WaitingForSlices"
+        assert st["small"]["phase"] == STARTING
+        assert sched.status()["counters"]["backfilled"] >= 1
+
+    def test_same_type_backfill_denied_when_blocked_on_capacity(self):
+        """A same-type jump would add the jumper's claim to the set
+        the blocked job waits on — not provably harmless, so denied.
+        (Preemption off so the blocked high job stays a pure waiter.)"""
+        policy = SchedulingPolicy(SchedulerConfig(
+            preemption=PreemptionConfig(enable=False)))
+        running = [view("ns/r0", count=2)]
+        pending = [view("ns/big", priority="high", count=3,
+                        enqueued_at=0.0),
+                   view("ns/small", priority="low", count=1,
+                        enqueued_at=1.0)]
+        plan = policy.plan(pending, running, {"v5e-8": 2},
+                           {"v5e-8": 4})
+        assert plan.decisions["ns/big"].reason == "WaitingForSlices"
+        assert plan.decisions["ns/small"].action == "wait"
+        assert plan.decisions["ns/small"].reason == "BackfillDenied"
+
+    def test_backfill_never_delays_blocked_jobs_eta(self, cluster):
+        """The blocked head starts the moment its own capacity frees,
+        with the backfilled job still running — ETA unchanged."""
+        kube, gang, sched, ctl = cluster
+        kube.create_custom(make_cr("vp-run", priority="high",
+                                   slice_type="v5p-32"))
+        ctl.reconcile_all()
+        kube.create_custom(make_cr("vp-blocked", priority="high",
+                                   slice_type="v5p-32"))
+        kube.create_custom(make_cr("small", priority="low"))
+        ctl.reconcile_all()
+        # vp-run finishes; the backfilled small job keeps running.
+        for p in kube.list_pods(
+                "kubeflow",
+                labels={"kubeflow-tpu.org/job-name": "vp-run"}):
+            kube.set_pod_phase("kubeflow", p["metadata"]["name"],
+                               SUCCEEDED)
+        ctl.reconcile_all()
+        ctl.reconcile_all()
+        st = phases_by_name(kube)
+        assert st["vp-blocked"]["phase"] == STARTING
+        assert st["small"]["phase"] == STARTING
+
+    def test_cross_type_backfill_marked(self):
+        policy = SchedulingPolicy(SchedulerConfig(enable_backfill=True))
+        pending = [view("ns/big", priority="high",
+                        slice_type="v5p-32", enqueued_at=0.0),
+                   view("ns/small", enqueued_at=1.0)]
+        plan = policy.plan(pending, [view("ns/r", slice_type="v5p-32",
+                                          phase="Running")],
+                           {"v5p-32": 0, "v5e-8": 1},
+                           {"v5p-32": 1, "v5e-8": 1})
+        assert plan.decisions["ns/small"].action == "admit"
+        assert plan.decisions["ns/small"].backfilled
+
+    def test_backfill_disabled_by_config(self):
+        """enableBackfill:false restores head-of-line: a fitting job
+        behind any blocked head waits, even cross-type."""
+        policy = SchedulingPolicy(SchedulerConfig(
+            enable_backfill=False,
+            preemption=PreemptionConfig(enable=False)))
+        pending = [view("ns/big", priority="high",
+                        slice_type="v5p-32", enqueued_at=0.0),
+                   view("ns/small", enqueued_at=1.0)]
+        plan = policy.plan(pending, [view("ns/r", slice_type="v5p-32",
+                                          phase="Running")],
+                           {"v5p-32": 0, "v5e-8": 1},
+                           {"v5p-32": 1, "v5e-8": 1})
+        assert plan.decisions["ns/small"].action == "wait"
+        assert plan.decisions["ns/small"].reason == "BackfillDenied"
+
+    def test_quota_impossible_demand_is_unsatisfiable(self):
+        """A job whose demand exceeds its tenant's quota outright can
+        NEVER run under this config — terminal, like the capacity
+        path, not a permanent queue squatter."""
+        policy = SchedulingPolicy(SchedulerConfig(
+            quotas={"t": {"v5e-8": 16}}))
+        pending = [view("ns/too-big", tenant="t", count=3)]  # 24 chips
+        plan = policy.plan(pending, [], {"v5e-8": 4}, {"v5e-8": 4})
+        decision = plan.decisions["ns/too-big"]
+        assert decision.action == "unsatisfiable"
+        assert decision.reason == "QuotaUnsatisfiable"
+
+
+class TestPreemptionPolicy:
+    def test_victim_selection_lowest_priority_then_fewest_chips(self):
+        running = [view("ns/norm", priority="normal", count=1),
+                   view("ns/low-big", priority="low", count=2),
+                   view("ns/low-small", priority="low", count=1)]
+        preemptor = view("ns/vip", priority="high", count=2)
+        victims = pick_victims(running, preemptor, free=0)
+        assert [v.key for v in victims] == ["ns/low-small",
+                                            "ns/low-big"]
+
+    def test_no_partial_eviction_when_insufficient(self):
+        """Lower-priority victims that cannot free enough capacity are
+        left alone — evicting them would burn checkpoints without
+        unblocking the preemptor."""
+        running = [view("ns/low", priority="low", count=1)]
+        preemptor = view("ns/vip", priority="high", count=4)
+        assert pick_victims(running, preemptor, free=0) == []
+
+    def test_equal_priority_never_evicted(self):
+        running = [view("ns/peer", priority="high", count=4)]
+        preemptor = view("ns/vip", priority="high", count=4)
+        assert pick_victims(running, preemptor, free=0) == []
+
+    def test_rate_limiter_window(self):
+        with faults.injected("seed=1") as inj:
+            limiter = PreemptionRateLimiter(max_preemptions=2,
+                                            window_s=60.0)
+            assert limiter.allow()
+            limiter.record()
+            limiter.record()
+            assert not limiter.allow()
+            inj.advance_clock(61)
+            assert limiter.allow()
+
+    def test_rate_limited_plan_defers_eviction(self):
+        config = SchedulerConfig(preemption=PreemptionConfig(
+            max_preemptions=1, window_s=300.0))
+        policy = SchedulingPolicy(config)
+        running = [view("ns/low-a", priority="low"),
+                   view("ns/low-b", priority="low")]
+        pending = [view("ns/hi-a", priority="high", enqueued_at=0.0),
+                   view("ns/hi-b", priority="high", enqueued_at=1.0)]
+        with faults.injected("seed=1"):
+            plan = policy.plan(pending, running, {"v5e-8": 0},
+                               {"v5e-8": 2})
+        assert len(plan.preemptions) == 1
+        reasons = sorted(plan.decisions[k].reason
+                         for k in ("ns/hi-a", "ns/hi-b"))
+        assert reasons == ["PreemptionRateLimited",
+                           "WaitingForPreemption"]
+
+    def test_in_progress_eviction_absorbs_demand(self):
+        """A blocked job covered by an eviction already in flight must
+        wait for it, not trigger a second wave."""
+        policy = SchedulingPolicy(SchedulerConfig())
+        running = [view("ns/dying", priority="low",
+                        phase="Preempting"),
+                   view("ns/low2", priority="low")]
+        pending = [view("ns/vip", priority="high")]
+        plan = policy.plan(pending, running, {"v5e-8": 0},
+                           {"v5e-8": 1})
+        assert plan.preemptions == []
+        assert plan.decisions["ns/vip"].reason == \
+            "WaitingForPreemption"
+
+
+class TestPreemptionLifecycle:
+    def _fill_and_contest(self, kube, ctl):
+        """4 low jobs fill v5e-8; a high job arrives."""
+        for i in range(4):
+            kube.create_custom(make_cr(f"low{i}", priority="low"))
+        ctl.reconcile_all()
+        kube.create_custom(make_cr("vip", priority="high",
+                                   num_slices=1))
+        ctl.reconcile_all()
+
+    def test_grace_window_then_resumable_requeue(self, cluster):
+        kube, gang, sched, ctl = cluster
+        with faults.injected("seed=1") as inj:
+            self._fill_and_contest(kube, ctl)
+            st = phases_by_name(kube)
+            victims = [n for n in st
+                       if st[n]["phase"] == JOB_PREEMPTING]
+            assert len(victims) == 1
+            victim = victims[0]
+            assert st[victim]["resumable"] is True
+            assert st[victim]["preemptions"] == 1
+            # Pods survive the grace window (checkpoint-on-SIGTERM).
+            assert kube.list_pods(
+                "kubeflow",
+                labels={"kubeflow-tpu.org/job-name": victim})
+            ctl.reconcile_all()
+            assert phases_by_name(kube)[victim]["phase"] == \
+                JOB_PREEMPTING
+            inj.advance_clock(10)   # grace elapses on the policy clock
+            ctl.reconcile_all()
+            st = phases_by_name(kube)
+            assert st[victim]["phase"] == QUEUED
+            assert st[victim]["reason"] == "PreemptedRequeued"
+            assert not kube.list_pods(
+                "kubeflow",
+                labels={"kubeflow-tpu.org/job-name": victim})
+            ctl.reconcile_all()
+            st = phases_by_name(kube)
+            assert st["vip"]["phase"] == STARTING
+            # restarts budget untouched: preemption is not a failure.
+            assert int(st[victim].get("restarts", 0)) == 0
+
+    def test_victim_resumes_after_preemptor_completes(self, cluster):
+        kube, gang, sched, ctl = cluster
+        with faults.injected("seed=1") as inj:
+            self._fill_and_contest(kube, ctl)
+            victim = [n for n, s in phases_by_name(kube).items()
+                      if s["phase"] == JOB_PREEMPTING][0]
+            inj.advance_clock(10)
+            ctl.reconcile_all()
+            ctl.reconcile_all()
+            for p in kube.list_pods(
+                    "kubeflow",
+                    labels={"kubeflow-tpu.org/job-name": "vip"}):
+                kube.set_pod_phase("kubeflow", p["metadata"]["name"],
+                                   SUCCEEDED)
+            ctl.reconcile_all()
+            ctl.reconcile_all()
+            st = phases_by_name(kube)
+            assert st[victim]["phase"] == STARTING
+            # The flag was CONSUMED by the resume admission (a later
+            # ordinary restart must not count as another resume); the
+            # preemption count survives as history.
+            assert st[victim]["resumable"] is False
+            assert st[victim]["preemptions"] == 1
+            assert sched.status()["counters"]["resumed"] >= 1
+
+    def test_no_livelock_between_flapping_priorities(self, cluster):
+        """The resumed low job can never evict the high job back
+        (victims are strictly lower priority), and repeated passes
+        fire no further eviction waves."""
+        kube, gang, sched, ctl = cluster
+        with faults.injected("seed=1") as inj:
+            self._fill_and_contest(kube, ctl)
+            inj.advance_clock(10)
+            ctl.reconcile_all()
+            ctl.reconcile_all()
+            before = sched.status()["counters"]["preempted"]
+            for _ in range(5):
+                ctl.reconcile_all()
+            assert sched.status()["counters"]["preempted"] == before
+            st = phases_by_name(kube)
+            assert st["vip"]["phase"] in (STARTING, JOB_RUNNING)
+
+    def test_gang_finishing_mid_grace_succeeds_not_requeued(self,
+                                                            cluster):
+        """A victim whose workers all succeed during the grace window
+        completes normally — it must not be torn down, re-queued
+        resumable, and re-run from checkpoint."""
+        kube, gang, sched, ctl = cluster
+        with faults.injected("seed=1") as inj:
+            self._fill_and_contest(kube, ctl)
+            st = phases_by_name(kube)
+            victim = [n for n in st
+                      if st[n]["phase"] == JOB_PREEMPTING][0]
+            for p in kube.list_pods(
+                    "kubeflow",
+                    labels={"kubeflow-tpu.org/job-name": victim}):
+                kube.set_pod_phase("kubeflow", p["metadata"]["name"],
+                                   SUCCEEDED)
+            ctl.reconcile_all()
+            st = phases_by_name(kube)
+            assert st[victim]["phase"] == "Succeeded", st[victim]
+            # Slices freed without an eviction event; vip admits.
+            assert sched.status()["counters"]["preempted"] == 0
+            inj.advance_clock(60)   # stale grace must change nothing
+            ctl.reconcile_all()
+            st = phases_by_name(kube)
+            assert st[victim]["phase"] == "Succeeded"
+            assert st["vip"]["phase"] in (STARTING, JOB_RUNNING), st
+
+    def test_gang_failure_mid_grace_cuts_grace_and_counts_restart(
+            self, cluster):
+        """A victim whose workers FAIL during the grace window is dead
+        — nothing is checkpointing, so the grace is cut short, the
+        failure consumes restart budget like any WorkerFailed, and the
+        slices go to the preemptor immediately."""
+        kube, gang, sched, ctl = cluster
+        with faults.injected("seed=1"):
+            self._fill_and_contest(kube, ctl)
+            st = phases_by_name(kube)
+            victim = [n for n in st
+                      if st[n]["phase"] == JOB_PREEMPTING][0]
+            pod = kube.list_pods(
+                "kubeflow",
+                labels={"kubeflow-tpu.org/job-name": victim})[0]
+            kube.set_pod_phase("kubeflow", pod["metadata"]["name"],
+                               "Failed")
+            ctl.reconcile_all()   # no clock skew: grace NOT elapsed
+            st = phases_by_name(kube)
+            assert st[victim]["phase"] == QUEUED
+            assert st[victim]["reason"] == "PreemptedRequeued"
+            assert st[victim]["restarts"] == 1   # budget consumed
+            assert st[victim]["resumable"] is True
+            ctl.reconcile_all()
+            assert phases_by_name(kube)["vip"]["phase"] == STARTING
+
+    def test_eviction_cancelled_when_shortage_resolves_mid_grace(
+            self, cluster):
+        """The preemptor is deleted during the victim's grace window:
+        the next plan withdraws the eviction and the victim keeps
+        running — no teardown, no lost progress."""
+        kube, gang, sched, ctl = cluster
+        with faults.injected("seed=1"):
+            self._fill_and_contest(kube, ctl)
+            st = phases_by_name(kube)
+            victim = [n for n in st
+                      if st[n]["phase"] == JOB_PREEMPTING][0]
+            kube.delete_custom("kubeflow", "vip")
+            ctl.reconcile_all()
+            st = phases_by_name(kube)
+            assert st[victim]["phase"] in (STARTING, JOB_RUNNING), st
+            # A later eviction starts a FRESH grace window, and the
+            # eviction stamps are reverted — the job was never
+            # actually preempted.
+            assert victim not in ctl._preempt_deadline
+            assert st[victim]["resumable"] is False
+            assert st[victim]["preemptions"] == 0
+            events = [e for e in kube.events
+                      if e["reason"] == "PreemptionCancelled"]
+            assert events, kube.events
+
+    def test_plan_failure_mid_grace_holds_preempting(self, cluster):
+        """A wedged plan pass while a victim is mid-grace must hold
+        the eviction state, not flip the victim back to Running."""
+        kube, gang, sched, ctl = cluster
+        with faults.injected("seed=1"):
+            self._fill_and_contest(kube, ctl)
+            victim = [n for n, s in phases_by_name(kube).items()
+                      if s["phase"] == JOB_PREEMPTING][0]
+        with faults.injected("scheduler.admit:raise"):
+            ctl.reconcile_all()
+        assert phases_by_name(kube)[victim]["phase"] == JOB_PREEMPTING
+
+    def test_resumed_job_restores_latest_checkpoint_step(self,
+                                                         tmp_path):
+        """The trainer-side half of the resume contract: the victim's
+        checkpoint from before eviction is what restore_or_init hands
+        back on re-admission — start_step > 0, no retraining."""
+        import numpy as np
+
+        from kubeflow_tpu.runtime.checkpoint import CheckpointManager
+
+        base = np.arange(4, dtype=np.float32)
+        with CheckpointManager(tmp_path / "ckpt",
+                               save_interval_steps=1) as mgr:
+            # The gang checkpoints through step 7, then is preempted.
+            for step in range(8):
+                mgr.save(step, {"step": np.full((), step, np.int32),
+                                "w": base + step})
+        # Re-admitted gang: fresh init, same directory.
+        fresh = {"step": np.zeros((), np.int32),
+                 "w": np.zeros(4, dtype=np.float32)}
+        with CheckpointManager(tmp_path / "ckpt") as mgr2:
+            restored, start = mgr2.restore_or_init(fresh)
+        assert start == 8   # latest step + 1: past step-0
+        assert int(restored["step"]) == 7
+        np.testing.assert_allclose(restored["w"], base + 7)
+
+
+class TestPlanAndStatus:
+    def test_unsatisfiable_fails_fast_under_policy(self, cluster):
+        kube, gang, sched, ctl = cluster
+        kube.create_custom(make_cr("huge", num_slices=9))
+        ctl.reconcile_all()
+        st = phases_by_name(kube)["huge"]
+        assert st["phase"] == JOB_FAILED
+        assert st["reason"] == "UnsatisfiableResources"
+
+    def test_plan_failure_holds_queue_not_running_jobs(self, cluster):
+        """A wedged policy pass (scheduler.admit raise) keeps admitted
+        gangs reconciling and parks pending jobs instead of falling
+        back to FIFO admission."""
+        kube, gang, sched, ctl = cluster
+        kube.create_custom(make_cr("ok"))
+        ctl.reconcile_all()
+        kube.create_custom(make_cr("late"))
+        with faults.injected("scheduler.admit:raise"):
+            ctl.reconcile_all()
+        st = phases_by_name(kube)
+        assert st["ok"]["phase"] == STARTING
+        assert st["late"]["phase"] == QUEUED
+        assert st["late"]["reason"] == "WaitingForScheduler"
+        # Next healthy pass admits it.
+        ctl.reconcile_all()
+        assert phases_by_name(kube)["late"]["phase"] == STARTING
+
+    def test_status_payload_shape(self, cluster):
+        kube, gang, sched, ctl = cluster
+        for i in range(3):
+            kube.create_custom(make_cr(f"g{i}", tenant="greedy"))
+        ctl.reconcile_all()
+        status = sched.status()
+        by_job = {row["job"]: row for row in status["jobs"]}
+        assert by_job["kubeflow/g0"]["state"] == "Admitted"
+        assert by_job["kubeflow/g2"]["state"] == "QuotaExceeded"
+        assert by_job["kubeflow/g2"]["wait_s"] is not None
+        quota = status["quotas"][0]
+        assert quota == {"tenant": "greedy", "slice_type": "v5e-8",
+                         "used_chips": 16, "quota_chips": 16}
+
+    def test_scheduler_metrics_exported(self, cluster):
+        from kubeflow_tpu.runtime.prom import (
+            REGISTRY,
+            parse_metrics,
+            sample_value,
+        )
+
+        kube, gang, sched, ctl = cluster
+        for i in range(3):
+            kube.create_custom(make_cr(f"g{i}", tenant="greedy"))
+        ctl.reconcile_all()
+        # Depth gauges export at PLAN time (start of the pass); the
+        # second pass sees g0/g1 admitted and only g2 pending.
+        ctl.reconcile_all()
+        parsed = parse_metrics(REGISTRY.render())
+        assert sample_value(parsed, "kft_scheduler_queue_depth",
+                            tenant="greedy", priority="normal") == 1
+        assert sample_value(parsed, "kft_scheduler_quota_used_chips",
+                            tenant="greedy", slice_type="v5e-8") == 16
+        assert sample_value(parsed, "kft_scheduler_quota_chips",
+                            tenant="greedy", slice_type="v5e-8") == 16
+        assert (sample_value(parsed, "kft_scheduler_admitted_total",
+                             tenant="greedy") or 0) >= 2
+
+    def test_config_from_dict_wire_shape(self):
+        config = SchedulerConfig.from_dict({
+            "quotas": {"a": {"v5e-8": 32}},
+            "weights": {"a": 2.5},
+            "priorityClasses": {"low": 0, "normal": 10, "high": 99},
+            "enableBackfill": False,
+            "preemption": {"grace_period_s": 12.5,
+                           "max_preemptions": 2, "window_s": 60},
+        })
+        assert config.quotas == {"a": {"v5e-8": 32}}
+        assert config.weight("a") == 2.5
+        assert config.priority_value("high") == 99
+        assert config.enable_backfill is False
+        assert config.preemption.grace_period_s == 12.5
+        with pytest.raises(ValueError, match="unknown scheduler"):
+            SchedulerConfig.from_dict({"nope": 1})

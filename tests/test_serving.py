@@ -266,6 +266,45 @@ class TestMicroBatcher:
         # Requests were coalesced: fewer device calls than requests.
         assert sum(calls) >= 4 and len(calls) < 4
 
+    def test_cycle_profile_consistent_under_concurrent_runners(self):
+        """ADVICE r5 regression: stage timings are accumulated per
+        _process locally and folded into self._cycle under the lock —
+        with in_flight>1 runners racing a stats() reader, the profile
+        must stay internally consistent (every stage present, finite,
+        non-negative) instead of showing torn/lost updates."""
+        import concurrent.futures as cf
+
+        def predict(inputs):
+            return {"y": inputs["x"]}
+
+        mb = MicroBatcher(predict, max_batch_size=4,
+                          allowed_batch_sizes=[1, 2, 4],
+                          batch_timeout_s=0.002, in_flight=4)
+        try:
+            snapshots = []
+            with cf.ThreadPoolExecutor(9) as ex:
+                futures = [
+                    ex.submit(mb.submit, {"x": np.full((1, 2), float(i))})
+                    for i in range(64)]
+                # stats() races the runner threads mid-dispatch.
+                for _ in range(16):
+                    snapshots.append(mb.stats())
+                for f in futures:
+                    f.result()
+            stats = mb.stats()
+        finally:
+            mb.close()
+        assert stats["requests"] == 64
+        assert stats["batches"] == sum(stats["batch_size_hist"].values())
+        profile = stats["cycle_profile_ms"]
+        assert set(profile) == {"queue_wait", "collate", "pad",
+                                "predict", "to_host", "deliver"}
+        for stage, ms in profile.items():
+            assert np.isfinite(ms) and ms >= 0.0, (stage, ms)
+        for snap in snapshots:
+            for stage, ms in snap["cycle_profile_ms"].items():
+                assert np.isfinite(ms) and ms >= 0.0, (stage, ms)
+
     def test_error_propagates(self):
         def predict(inputs):
             raise RuntimeError("boom")

@@ -8,6 +8,7 @@ from kubeflow_tpu.testing.e2e import (
     engine_smoke,
     fault_injection_smoke,
     fleet_smoke,
+    scheduler_smoke,
     serving_smoke,
     tpujob_smoke,
 )
@@ -61,6 +62,15 @@ class TestWorkflowDAG:
 class TestE2EDrivers:
     def test_tpujob_smoke(self):
         tpujob_smoke()
+
+    def test_scheduler_smoke(self):
+        # The ci/e2e_config.yaml hermetic `scheduler` step: two
+        # tenants over the fake apiserver — quota-capped greedy
+        # tenant, backfill past a blocked large job, priority
+        # preemption through the checkpoint grace window with a
+        # resumed-from-latest-step victim, kft_scheduler_* metrics
+        # (see kubeflow_tpu/testing/e2e.py scheduler_smoke).
+        scheduler_smoke()
 
     def test_serving_smoke(self):
         serving_smoke()

@@ -43,6 +43,24 @@ class TestTensorProtoWireCompat:
         out = tf_compat.tensorproto_to_numpy(clone)
         np.testing.assert_array_equal(out, np.full((2, 2), 3.5, np.float32))
 
+    def test_decoded_arrays_are_writable(self):
+        """Both request encodings must hand predict a WRITABLE array:
+        frombuffer over tensor_content (and broadcast_to on the
+        one-value shorthand) view read-only memory, and an in-place
+        normalize/pad downstream would raise only for those payloads
+        — a payload-dependent failure mode (ADVICE r5)."""
+        arr = np.arange(12, dtype=np.float32).reshape(3, 4)
+        packed = pb.TensorProto.FromString(
+            tf.make_tensor_proto(arr).SerializeToString())
+        out = tf_compat.tensorproto_to_numpy(packed)
+        assert out.flags.writeable
+        out *= 2.0  # the in-place op that used to raise
+        broadcast = pb.TensorProto.FromString(
+            tf.make_tensor_proto(3.5, shape=[4]).SerializeToString())
+        out = tf_compat.tensorproto_to_numpy(broadcast)
+        assert out.flags.writeable
+        out += 1.0
+
     def test_parses_string_tensor(self):
         blobs = [b"raw-jpeg-1", b"raw-jpeg-2"]
         real = tf.make_tensor_proto(blobs, shape=[2])
