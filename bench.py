@@ -1152,6 +1152,188 @@ def _bench_shared_prefix(spec, rng, cfg, on_tpu, DecodeEngine):
     }
 
 
+def _bench_speculative(spec, rng, cfg, on_tpu, DecodeEngine):
+    """Speculative-decoding probe: n-gram drafting + batched verify
+    (engine ``speculative_tokens``), spec ON vs OFF on otherwise
+    identical engines (both sync_lag 0 — speculation forces a
+    synchronous loop, so the OFF control pays the same read
+    discipline and the delta is speculation alone).
+
+    Two workloads:
+      * high-acceptance — repetitive pattern-tiled prompts whose
+        greedy continuations the drafter can predict.  Candidates are
+        scored BEFORE timing by simulating the drafter against the
+        reference continuations (host-only), and the most draftable
+        ones are kept: the probe characterizes the high-acceptance
+        regime, not prompt luck.  Acceptance bound: ON >= 1.3x OFF
+        delivered tok/s.
+      * low-acceptance — random prompts with short budgets, where the
+        drafter should stay silent and the adaptive gates (per-slot
+        width backoff, batch mass gate, measured-throughput gate)
+        must hold ON ~at OFF (no-regression bound; a few percent of
+        scheduling noise on a GIL-shared CPU box).
+
+    Windows interleave ON/OFF with alternating order (ordering bias
+    measured ~2% on the smoke box) and the max window is the
+    capability estimate, as everywhere else in this bench.
+    """
+    import dataclasses
+    import threading
+
+    import numpy as np
+
+    from kubeflow_tpu.models.generate import generate
+    from kubeflow_tpu.serving.engine import _ngram_propose
+
+    if on_tpu:
+        slots, k, windows, workers = 4, 6, 2, 4
+        pat_w, reps, probe_new = 8, 8, 128
+        prefill, n_high, n_low, low_new = 64, 24, 32, 8
+    else:
+        slots, k, windows, workers = 2, 6, 3, 2
+        pat_w, reps, probe_new = 4, 4, 96
+        prefill, n_high, n_low, low_new = 16, 12, 32, 8
+    # The probe owns its completion budget (longer runs amortize the
+    # per-request draft warm-up), so it rides its own decode config
+    # clamped to the model's real room.
+    probe_new = min(probe_new, cfg.max_seq_len - prefill)
+    decode = dataclasses.replace(spec["decode"],
+                                 max_new_tokens=probe_new)
+
+    def sim_gain(prompt, cont):
+        """Drafter simulation against a known continuation: net tokens
+        speculation would save (accepted minus verify rounds)."""
+        hist = list(prompt) + [cont[0]]
+        gained = rounds = 0
+        i = 1
+        while i < len(cont):
+            room = len(cont) - i - 1
+            prop = (_ngram_propose(np.asarray(hist, np.int32),
+                                   min(k, room))
+                    if room > 0 else np.empty((0,), np.int32))
+            a = 0
+            for j, p in enumerate(prop.tolist()):
+                if p == cont[i + j]:
+                    a += 1
+                else:
+                    break
+            gained += a
+            emitted = a + 1
+            hist.extend(cont[i:i + emitted])
+            i += emitted
+            rounds += 1
+        return gained - rounds
+
+    cand = [np.tile(rng.randint(1, cfg.vocab_size, size=(pat_w,)),
+                    reps).astype(np.int32) for _ in range(2 * n_high)]
+    refs = np.asarray(generate(cfg, spec["params"], np.stack(cand),
+                               decode)[0])
+    plen = pat_w * reps
+    ranked = sorted(
+        range(len(cand)),
+        key=lambda i: sim_gain(cand[i].tolist(),
+                               refs[i, plen:].tolist()),
+        reverse=True)
+    high = [cand[i] for i in ranked[:n_high]]
+    low = [rng.randint(1, cfg.vocab_size, size=(plen,)).astype(np.int32)
+           for _ in range(n_low)]
+
+    def make_engine(spec_tokens, label):
+        engine = DecodeEngine(
+            spec["cfg"], spec["params"], decode, slots=slots,
+            prefill_len=prefill, prefill_chunk_tokens=prefill,
+            prefix_pool_blocks=0, sync_lag=0,
+            speculative_tokens=spec_tokens,
+            name=f"bench-spec-{label}")
+        # Warm every program OUTSIDE the timed windows: one repetitive
+        # prompt drafts (chunked prefill + copy + verify), one random
+        # prompt decodes (step).
+        engine.submit({"tokens": np.tile(
+            rng.randint(1, cfg.vocab_size, size=(pat_w,)),
+            reps).astype(np.int32), "max_new_tokens": 12})
+        engine.submit({"tokens": rng.randint(
+            1, cfg.vocab_size, size=(pat_w,)).astype(np.int32),
+            "max_new_tokens": 2})
+        return engine
+
+    def window(engine, prompts, new):
+        sem = threading.Semaphore(workers)
+
+        def client(prompt):
+            with sem:
+                engine.submit({"tokens": prompt, "max_new_tokens": new})
+
+        threads = [threading.Thread(target=client, args=(p,))
+                   for p in prompts]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return len(prompts) * new / (time.perf_counter() - t0)
+
+    def compare(prompts, new, label):
+        on_engine = make_engine(k, f"{label}-on")
+        off_engine = make_engine(0, f"{label}-off")
+        on_rates, off_rates = [], []
+        try:
+            for w in range(windows):
+                first, second = ((on_engine, off_engine) if w % 2 == 0
+                                 else (off_engine, on_engine))
+                rate1 = window(first, prompts, new)
+                rate2 = window(second, prompts, new)
+                if first is on_engine:
+                    on_rates.append(rate1)
+                    off_rates.append(rate2)
+                else:
+                    off_rates.append(rate1)
+                    on_rates.append(rate2)
+            return (max(on_rates), max(off_rates),
+                    on_engine.stats(), off_engine.stats(),
+                    on_engine.compiled_programs())
+        finally:
+            on_engine.close()
+            off_engine.close()
+
+    on_tok_s, off_tok_s, on_stats, off_stats, programs = compare(
+        high, probe_new, "high")
+    speedup = on_tok_s / off_tok_s if off_tok_s else 0.0
+    lo_on, lo_off, lo_stats, _, _ = compare(low, low_new, "low")
+    lo_ratio = lo_on / lo_off if lo_off else 0.0
+    print(f"speculative: high-acceptance ON {on_tok_s:.1f} tok/s vs "
+          f"OFF {off_tok_s:.1f} ({speedup:.2f}x), acceptance "
+          f"{on_stats['spec_acceptance_rate']}, accepted/step "
+          f"{on_stats['accepted_per_step']}; low-acceptance ratio "
+          f"{lo_ratio:.2f} ({lo_stats['spec_drafted']} drafted)",
+          file=sys.stderr)
+    return {
+        "draft_tokens": k,
+        "slots": slots,
+        "windows": windows,
+        "probe_new_tokens": probe_new,
+        "acceptance_rate": on_stats["spec_acceptance_rate"],
+        "accepted_per_step": on_stats["accepted_per_step"],
+        "drafted": on_stats["spec_drafted"],
+        "accepted": on_stats["spec_accepted"],
+        "verify_steps": on_stats["spec_steps"],
+        "tok_s_spec_on": round(on_tok_s, 1),
+        "tok_s_spec_off": round(off_tok_s, 1),
+        "speedup": round(speedup, 3),
+        "inter_token_gap_p50_ms_spec_on":
+            on_stats["inter_token_gap_p50_ms"],
+        "inter_token_gap_p50_ms_spec_off":
+            off_stats["inter_token_gap_p50_ms"],
+        "compiled_programs_spec_on": programs,
+        "low_acceptance": {
+            "tok_s_spec_on": round(lo_on, 1),
+            "tok_s_spec_off": round(lo_off, 1),
+            "ratio": round(lo_ratio, 3),
+            "drafted": lo_stats["spec_drafted"],
+            "accepted": lo_stats["spec_accepted"],
+        },
+    }
+
+
 def bench_lm_engine(args, devices, n_chips, on_tpu):
     """Continuous-batching DecodeEngine vs the static BucketedLMBatcher
     on ONE mixed open-loop workload.
@@ -1344,6 +1526,14 @@ def bench_lm_engine(args, devices, n_chips, on_tpu):
         shared_prefix = _bench_shared_prefix(
             spec, rng, cfg, on_tpu, DecodeEngine)
 
+        # --- speculation probe: n-gram drafting + batched verify on
+        # repetitive (high-acceptance) and random (low-acceptance)
+        # prompts, spec ON vs OFF.  Acceptance: ON >= 1.3x delivered
+        # tok/s on the repetitive workload; the random workload must
+        # hold ~at OFF (the adaptive gates' no-regression bound).
+        speculative = _bench_speculative(
+            spec, rng, cfg, on_tpu, DecodeEngine)
+
     eng_rates = [w["rate"] for w in engine_windows]
     bat_rates = [w["rate"] for w in batcher_windows]
     eng_tok_s, bat_tok_s = max(eng_rates), max(bat_rates)
@@ -1393,6 +1583,7 @@ def bench_lm_engine(args, devices, n_chips, on_tpu):
                 engine_stats["inter_token_gap_max_ms"],
             "cached_token_ratio": engine_stats["cached_token_ratio"],
             "shared_prefix": shared_prefix,
+            "speculative": speculative,
             "mean_slot_occupancy": engine_stats["mean_occupancy"],
             "slots": slots,
             "steps_per_call": spc,

@@ -75,9 +75,10 @@ def _layer_step(cfg: TransformerConfig, layer_params, x, cache_kv,
     cache_kv: (k, v) each [b, max_len, hkv, d];
     cache_len: number of valid cache positions before this call — a
     scalar (whole batch at one length, the generate() path) or a [b]
-    array (per-row lengths, the slot-based decode_step path; t must be
-    1 there — each row writes its new k/v at its OWN column and attends
-    under its own causal frontier via the per-row kv_offset mask);
+    array (per-row lengths, the slot-based decode_step / verify_step
+    paths; each row writes its t new k/v columns starting at its OWN
+    frontier and attends under its own causal mask via the per-row
+    kv_offset — t is 1 at decode and k+1 at speculative verify);
     pad_amount: per-row [b] left-pad width (bucketed mixed-length
     prompts) — cache columns before it hold pad-token garbage and are
     masked out of every attention.
@@ -111,23 +112,26 @@ def _layer_step(cfg: TransformerConfig, layer_params, x, cache_kv,
     t = x.shape[1]
     per_row = not isinstance(cache_len, int) and cache_len.ndim == 1
     if per_row:
-        # Slot-based decode: one new token per row, scattered to each
-        # row's own column.  mode="drop" makes an out-of-range column a
-        # no-op — that is how retired slots skip the write without a
-        # separate program.
-        rows = jnp.arange(x.shape[0])
-        cols = cache_len if write_cols is None else write_cols
+        # Slot-based decode/verify: t new tokens per row, scattered to
+        # each row's own columns [base, base + t).  mode="drop" makes
+        # an out-of-range column a no-op — that is how retired slots
+        # skip the write without a separate program, and how a verify
+        # window overhanging the cache end drops only its unreachable
+        # tail columns.
+        rows = jnp.arange(x.shape[0])[:, None]
+        base = cache_len if write_cols is None else write_cols
+        cols = base[:, None] + jnp.arange(t)[None, :]
 
-        def store(c, new):  # new: [b, 1, hk, d]
+        def store(c, new):  # new: [b, t, hk, d]
             if isinstance(c, QTensor):
                 vals, s = quantize_array(new, (-1,))
                 return QTensor(
-                    c.values.at[rows, cols].set(vals[:, 0], mode="drop"),
-                    c.scale.at[rows, cols].set(s[:, 0], mode="drop"),
+                    c.values.at[rows, cols].set(vals, mode="drop"),
+                    c.scale.at[rows, cols].set(s, mode="drop"),
                     c.axes,
                 )
             return c.at[rows, cols].set(
-                new[:, 0].astype(c.dtype), mode="drop")
+                new.astype(c.dtype), mode="drop")
 
         ck = store(ck, k)
         cv = store(cv, v)
@@ -197,10 +201,11 @@ def _forward_with_cache(cfg: TransformerConfig, params, tokens, cache,
     """tokens [b, t] -> (logits [b, t, v], new cache).
 
     cache_len scalar: the whole batch sits at one length (generate()).
-    cache_len [b] array: per-row lengths (slot-based decode_step) —
-    requires t == 1; each row ropes at its own position, writes its own
-    cache column (write_cols, defaulting to cache_len), and attends
-    under its own causal frontier.
+    cache_len [b] array: per-row lengths (slot-based decode_step /
+    verify_step) — each row ropes its t tokens at its own positions
+    [len, len + t), writes its own cache columns (write_cols,
+    defaulting to cache_len), and attends under its own causal
+    frontier (t = 1 at decode, k+1 at speculative verify).
     """
     from flax import linen as nn
 
@@ -210,9 +215,8 @@ def _forward_with_cache(cfg: TransformerConfig, params, tokens, cache,
     x = embed_lookup(embed, tokens, dt)  # int8-aware row gather
     per_row = not isinstance(cache_len, int) and cache_len.ndim == 1
     if per_row:
-        assert tokens.shape[1] == 1, (
-            "per-row cache_len is the single-token decode path")
-        positions = cache_len[:, None]
+        positions = (cache_len[:, None]
+                     + jnp.arange(tokens.shape[1])[None, :])
     else:
         positions = cache_len + jnp.arange(tokens.shape[1])[None, :]
         positions = jnp.broadcast_to(positions, tokens.shape)
@@ -412,14 +416,25 @@ def generate(
 #                            its OWN length (per-row rope position,
 #                            per-row causal frontier, per-row cache
 #                            column scatter)
+#   verify_step              speculative decoding: score k host-drafted
+#                            candidate tokens per slot in ONE forward
+#                            pass at each slot's frontier, accept the
+#                            longest exact greedy prefix (+1 token from
+#                            the verify logits), and roll rejected
+#                            columns back by NOT advancing cache_len
+#                            over them — the cache_len-gated attention
+#                            masks stale columns past the frontier, so
+#                            rollback is a length reset, not a scatter-
+#                            erase
 #
-# Static shapes throughout: slot count, chunk width, pool geometry, and
-# max_len are fixed at engine construction, so the whole serving
-# lifetime compiles exactly three programs (chunked prefill, prefix
-# copy, step).  Retirement is a device-side `done` flag (a slot that
-# hits its stop length or EOS stops advancing and drops its cache
-# writes), so freeing + reusing a slot needs no extra program — the
-# next admission's copy_prefix_into_slot freezes and overwrites it.
+# Static shapes throughout: slot count, chunk width, pool geometry,
+# draft width, and max_len are fixed at engine construction, so the
+# whole serving lifetime compiles at most four programs (chunked
+# prefill, prefix copy, step, verify — the fourth only when
+# speculation is enabled).  Retirement is a device-side `done` flag (a
+# slot that hits its stop length or EOS stops advancing and drops its
+# cache writes), so freeing + reusing a slot needs no extra program —
+# the next admission's copy_prefix_into_slot freezes and overwrites it.
 # ---------------------------------------------------------------------------
 
 
@@ -505,6 +520,89 @@ def decode_step(cfg: TransformerConfig, params, state,
         return state, toks[None]
     state, toks = jax.lax.scan(one, state, None, length=steps)
     return state, toks
+
+
+@partial(jax.jit, static_argnums=(0, 3, 4), donate_argnums=(2,))
+def verify_step(cfg: TransformerConfig, params, state,
+                decode: DecodeConfig, k: int, draft: jax.Array,
+                draft_len: jax.Array):
+    """Speculative verify: score up to ``k`` host-drafted tokens per
+    slot in ONE forward pass; returns (state, tokens [S, k+1],
+    emitted [S]).
+
+    ``draft`` [S, k] carries each slot's candidate continuation
+    (prompt-lookup / n-gram proposals — serving/engine.py drafts them
+    host-side) and ``draft_len`` [S] how many are real (0 = the slot
+    rides along undrafted, a mixed batch).  The forward runs at t =
+    k+1 — column 0 is the slot's pending ``last_token``, columns 1..k
+    the draft — with per-row rope positions, per-row causal frontiers,
+    and per-row cache-column scatters, i.e. decode_step's math widened
+    to a k+1 window, so position j's logits are bit-for-bit the logits
+    the (j+1)-th sequential decode_step would have produced whenever
+    the first j draft tokens match greedy decode.
+
+    Acceptance is exact-match greedy (the engine only speculates at
+    temperature 0, which is what makes speculation token-IDENTICAL to
+    the non-speculative path): with ``a`` = the longest prefix of the
+    draft equal to the argmax targets, the slot emits a+1 tokens —
+    the a accepted drafts plus one free token from the verify logits
+    (the first disagreement, or the bonus continuation after a full
+    accept) — clipped to the slot's remaining budget and cut at EOS.
+
+    Rollback is DEVICE-SIDE and free: the k+1 fresh k/v columns were
+    written at [len, len + k] as the forward ran, but ``lengths``
+    advances only over the emitted prefix.  Columns past the new
+    frontier hold rejected-draft garbage that the cache_len-gated
+    attention masks out of every later call, and the next step's
+    write window starts at the new frontier and overwrites them
+    before its own attention runs — a length reset, never a
+    scatter-erase.  Retired slots park their writes out of range and
+    emit 0 tokens, exactly like decode_step.
+    """
+    lengths, done = state["lengths"], state["done"]
+    max_len = state["cache_k"].shape[2]
+    advance = ~done
+    write_cols = jnp.where(advance, lengths, max_len)
+    tokens = jnp.concatenate(
+        [state["last_token"][:, None], draft.astype(jnp.int32)], axis=1)
+    logits, (ck, cv) = _forward_with_cache(
+        cfg, params, tokens, (state["cache_k"], state["cache_v"]),
+        lengths, write_cols=write_cols)
+    targets = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [S, k+1]
+    # Longest accepted draft prefix (positions beyond draft_len never
+    # match), then +1 free token, clipped to the per-slot budget: a
+    # live slot always has stop_len - lengths >= 1 emission of room,
+    # so every advancing slot nets at least one token per call — a
+    # verify call never delivers less than a decode step would.
+    pos = jnp.arange(k)[None, :]
+    match = (draft.astype(jnp.int32) == targets[:, :k]) \
+        & (pos < draft_len[:, None])
+    accepted = jnp.sum(
+        jnp.cumprod(match.astype(jnp.int32), axis=1), axis=1)
+    emit = jnp.minimum(accepted + 1,
+                       jnp.maximum(state["stop_len"] - lengths, 0))
+    if decode.eos_token >= 0:
+        is_eos = targets == decode.eos_token
+        eos_cut = jnp.where(jnp.any(is_eos, axis=1),
+                            jnp.argmax(is_eos, axis=1) + 1, k + 2)
+        done_eos = advance & (eos_cut <= emit)
+        emit = jnp.minimum(emit, eos_cut)
+    else:
+        done_eos = jnp.zeros_like(done)
+    emit = jnp.where(advance, emit, 0)
+    out = jnp.where(jnp.arange(k + 1)[None, :] < emit[:, None],
+                    targets, 0)
+    new_lengths = lengths + emit
+    last_tok = jnp.take_along_axis(
+        targets, jnp.maximum(emit - 1, 0)[:, None], axis=1)[:, 0]
+    state = dict(state)
+    state["cache_k"], state["cache_v"] = ck, cv
+    state["lengths"] = new_lengths
+    state["last_token"] = jnp.where(emit > 0, last_tok,
+                                    state["last_token"])
+    state["done"] = done | done_eos \
+        | (advance & (new_lengths >= state["stop_len"]))
+    return state, out, emit.astype(jnp.int32)
 
 
 def init_prefix_pool(cfg: TransformerConfig, blocks: int, pool_len: int,

@@ -31,8 +31,20 @@ over ONE persistent KV cache of ``slots`` rows:
     their slots are reused — no request ever waits for the batch to
     drain, and per-request ``max_new_tokens`` is data, not a compiled
     constant;
-  - every shape is static, so the engine's whole lifetime compiles
-    exactly three programs (chunked prefill, prefix copy, step).
+  - with ``speculative_tokens`` > 0 (greedy exports only), a host-side
+    **n-gram drafter** proposes up to k candidate tokens per slot by
+    longest-suffix match against the slot's own prompt + generated
+    history (no second model), a single ``verify_step`` forward scores
+    the k+1 positions at each slot's frontier, the longest exact
+    greedy prefix is accepted (+1 free token from the verify logits),
+    and rejected columns roll back device-side by NOT advancing the
+    slot's ``cache_len`` over them — per-slot adaptive k backs off
+    when acceptance drops, and a round in which no slot drafts runs
+    the plain decode program, so low-acceptance traffic never pays
+    the verify window;
+  - every shape is static, so the engine's whole lifetime compiles at
+    most four programs (chunked prefill, prefix copy, step, verify —
+    the fourth only when speculation is enabled).
 
 The host loop reads sampled tokens with a small LAG (``sync_lag``
 steps): step N+lag is dispatched before step N's tokens are
@@ -83,6 +95,104 @@ PREFIX_EVICTIONS_TOTAL = "kft_engine_prefix_evictions_total"
 PREFIX_EVICTIONS_HELP = "donor prefix-pool rows evicted (LRU), by engine"
 PREFILL_CHUNKS_TOTAL = "kft_engine_prefill_chunks_total"
 PREFILL_CHUNKS_HELP = "prefill chunk program calls, by engine"
+SPEC_DRAFTED_TOTAL = "kft_engine_spec_drafted_total"
+SPEC_DRAFTED_HELP = "draft tokens proposed to verify_step, by engine"
+SPEC_ACCEPTED_TOTAL = "kft_engine_spec_accepted_total"
+SPEC_ACCEPTED_HELP = "draft tokens accepted by verify_step, by engine"
+
+# N-gram drafter bounds: suffixes of up to _SPEC_NGRAM_MAX tokens are
+# matched against the request's own history, down to _SPEC_NGRAM_MIN.
+# The floor is a BIGRAM on purpose: a single repeated token recurs
+# constantly in unrepetitive text (birthday-bound in the vocab) and
+# measured ~25% acceptance — pure wasted verify windows — while every
+# actually-periodic regime (constant runs, alternations, repeated
+# phrases) repeats its bigrams too.  After a slot's adaptive draft
+# width backs off to zero it re-probes with width 1 once
+# _SPEC_COOLDOWN rounds pass, so a tail that TURNS repetitive can
+# recover speculation.
+_SPEC_NGRAM_MAX = 4
+_SPEC_NGRAM_MIN = 2
+_SPEC_COOLDOWN = 8
+# When every live slot keeps proposing nothing, the drafting scan
+# itself is pure per-round overhead — back off to scanning every
+# _SPEC_SCAN_STRIDE_MAX rounds (histories grow one token per round,
+# so draftability changes slowly); any hit or any new admission
+# resets to every round.
+_SPEC_SCAN_STRIDE_MAX = 8
+# Throughput gate: speculation keeps running only while the verify
+# program's MEASURED delivered token rate (EMA) beats the decode
+# program's by this factor — the break-even is model/hardware
+# dependent (a k+1-wide window costs ~constant extra on a
+# bandwidth-bound TPU but ~linear extra on a compute-bound CPU), so
+# the engine measures it instead of assuming it.  While gated off, a
+# probe verify runs every _SPEC_PROBE_EVERY gated rounds to refresh
+# the estimate (traffic that turns repetitive re-enables itself).
+_SPEC_RATE_MARGIN = 0.95
+_SPEC_PROBE_EVERY = 4
+_SPEC_RATE_ALPHA = 0.3
+
+
+_NO_DRAFT = np.empty((0,), np.int32)
+
+
+def _ngram_propose(history: np.ndarray, k: int,
+                   nmax: int = _SPEC_NGRAM_MAX,
+                   nmin: int = _SPEC_NGRAM_MIN) -> np.ndarray:
+    """Prompt-lookup drafting: find the most recent earlier occurrence
+    of the history's longest matchable suffix (n-gram, longest n
+    first) and propose the up-to-k tokens that followed it.  Returns
+    an empty array when no suffix recurs — the caller then runs the
+    plain decode program.  Proposals carry NO correctness weight
+    (verify_step accepts only exact greedy matches); they only set the
+    acceptance rate, so a wrong guess costs one verify window, never a
+    wrong token.
+
+    This runs once per live slot per decode round, so the no-repeat
+    common case must be near-free: every matchable suffix ends with
+    the history's last token, and one vectorized scan for its earlier
+    occurrences prunes unrepetitive text to a single compare."""
+    n_hist = int(history.shape[0])
+    if n_hist < nmin + 1 or k <= 0:
+        return _NO_DRAFT
+    # End positions of candidate occurrences: indices e < n_hist - 1
+    # holding the last token (a follower at e + 1 always exists, and
+    # the trivial self-match at the suffix itself is excluded).
+    ends = np.flatnonzero(history[:n_hist - 1] == history[n_hist - 1])
+    if ends.size == 0:
+        return _NO_DRAFT
+    if nmin >= 2:
+        # Fold the bigram floor into the precheck: every matchable
+        # suffix must end with the last TWO tokens, which prunes the
+        # single-repeated-token noise before any n-gram scan runs.
+        ends = ends[ends >= 1]
+        ends = ends[history[ends - 1] == history[n_hist - 2]]
+        if ends.size == 0:
+            return _NO_DRAFT
+    for n in range(min(nmax, n_hist - 1), nmin - 1, -1):
+        cand = ends[ends >= n - 1]
+        if cand.size == 0:
+            continue
+        if n > 1:
+            pattern = history[n_hist - n:]
+            idx = (cand - (n - 1))[:, None] + np.arange(n)[None, :]
+            cand = cand[(history[idx] == pattern[None, :]).all(axis=1)]
+            if cand.size == 0:
+                continue
+        starts = cand + 1  # continuation start per occurrence
+        # Most recent occurrence with a FULL k-token continuation,
+        # else the most recent at all.  A short continuation (the
+        # match sits near the history's end — the steady state of a
+        # periodic tail) extends CYCLICALLY: the tokens between the
+        # match and the history's end are the period, and proposing
+        # them on repeat is exactly the guess that pays off on the
+        # repetitive text speculation targets.
+        full = starts[starts + k <= n_hist]
+        start = int(full[-1] if full.size else starts[-1])
+        proposal = history[start:start + k]
+        if proposal.size < k:
+            proposal = np.resize(history[start:], k)
+        return proposal.astype(np.int32)
+    return _NO_DRAFT
 
 
 def _true_token_len(row: np.ndarray) -> int:
@@ -138,6 +248,17 @@ class DecodeEngine:
         by slots + max_queue_depth.
       overload_retry_after_s: the Retry-After hint a shed submission
         carries back to the client.
+      speculative_tokens: self-speculative (prompt-lookup / n-gram)
+        decoding — the static draft width k of the fourth AOT program
+        (``verify_step``): up to k host-drafted candidate tokens per
+        slot verify in ONE forward pass, token-identical to greedy
+        decode (0 disables).  Requires a greedy export (temperature
+        0) — sampling exports silently fall back to plain decode,
+        because drafting would perturb the per-request sample
+        streams.  Speculation forces a synchronous host loop
+        (sync_lag 0): the drafter reads each slot's materialized
+        history, and the k-token verify window amortizes dispatch
+        the way the read lag otherwise would.
     """
 
     def __init__(
@@ -157,6 +278,7 @@ class DecodeEngine:
         prefix_block_tokens: int = 16,
         max_queue_depth: int = 0,
         overload_retry_after_s: float = 1.0,
+        speculative_tokens: int = 0,
         name: str = "engine",
     ):
         from kubeflow_tpu.models.generate import (
@@ -199,6 +321,28 @@ class DecodeEngine:
         self.max_queue_depth = max(0, int(max_queue_depth))
         self.overload_retry_after_s = overload_retry_after_s
         self._eos = decode.eos_token >= 0
+        # Speculative draft width: greedy exports only (verify accepts
+        # exact argmax matches; under sampling, drafting would have to
+        # perturb the per-request sample streams), capped so a draft
+        # can never exceed the largest completion minus its free
+        # verify token.
+        spec = max(0, int(speculative_tokens))
+        spec = min(spec, max(0, int(decode.max_new_tokens) - 1))
+        if spec and decode.temperature > 0:
+            import logging
+
+            logging.warning(
+                "engine %r: speculative_tokens=%d ignored — the export "
+                "samples at temperature %g and speculation is greedy-"
+                "only", name, spec, decode.temperature)
+            spec = 0
+        self.speculative_tokens = spec
+        if spec:
+            # The drafter proposes from each slot's materialized
+            # history, so the loop must drain emissions every round;
+            # the k-token verify window is what amortizes dispatch
+            # instead of the read lag.
+            self.sync_lag = 0
         self._state = init_slot_state(cfg, slots, self.max_len,
                                       decode.kv_cache_dtype)
         # Donor prefix pool: allocated even when caching is off (one
@@ -224,6 +368,16 @@ class DecodeEngine:
         self._chunk_exec = None
         self._copy_exec = None
         self._step_exec = None
+        self._verify_exec = None
+        # Drafting-scan backoff (loop-thread-owned): consecutive empty
+        # scans stretch the scan period toward _SPEC_SCAN_STRIDE_MAX.
+        self._spec_stride = 1
+        self._spec_tick = 0
+        # Measured delivered-rate EMAs of the two step programs (the
+        # throughput gate's inputs) and the gated-round probe counter.
+        self._rate_step_ema = None
+        self._rate_verify_ema = None
+        self._spec_probe = 0
 
         self._lock = threading.Lock()
         self._work = threading.Condition(self._lock)
@@ -246,6 +400,7 @@ class DecodeEngine:
             "shed": 0, "expired": 0,
             "prefix_hits": 0, "prefix_misses": 0, "prefix_evictions": 0,
             "prefill_chunks": 0, "cached_tokens": 0, "prompt_tokens": 0,
+            "spec_drafted": 0, "spec_accepted": 0, "spec_steps": 0,
         }
         self._step_times: List[float] = []   # bounded reservoirs
         self._chunk_times: List[float] = []
@@ -275,6 +430,10 @@ class DecodeEngine:
             PREFIX_EVICTIONS_TOTAL, PREFIX_EVICTIONS_HELP)
         self._chunks_ctr = REGISTRY.counter(
             PREFILL_CHUNKS_TOTAL, PREFILL_CHUNKS_HELP)
+        self._spec_drafted_ctr = REGISTRY.counter(
+            SPEC_DRAFTED_TOTAL, SPEC_DRAFTED_HELP)
+        self._spec_accepted_ctr = REGISTRY.counter(
+            SPEC_ACCEPTED_TOTAL, SPEC_ACCEPTED_HELP)
         # Fault-layer series: same names as the static batchers', so
         # shed/expired rates read uniformly across batching planes.
         self._shed_ctr = REGISTRY.counter(SHED_TOTAL, SHED_HELP)
@@ -371,11 +530,33 @@ class DecodeEngine:
             "tokens": tokens, "new": new, "seed": seed,
             "emitted": [], "scheduled": 0, "slot": None,
             "prefilling": False, "pos": 0, "cached": 0, "pool_row": None,
+            # Adaptive draft width: grows on full accepts, shrinks on
+            # full rejects; 0 = backed off (re-probes after cooldown).
+            "spec_k": self.speculative_tokens, "spec_cool": 0,
+            # Drafting history (prompt + emitted), maintained
+            # incrementally by the drain — rebuilding it per round
+            # costs more than the draft search itself at step rates.
+            "hist": None, "hist_len": 0,
             "deadline": deadline,
             "want_timing": bool(inputs.get("return_timing")),
             "event": threading.Event(), "out": None, "err": None,
             "t": time.monotonic(), "t_first": None,
         }
+        if self.speculative_tokens:
+            hist = np.empty((length + new,), np.int32)
+            hist[:length] = tokens[0]
+            entry["hist"] = hist
+            entry["hist_len"] = length
+            # Does the PROMPT alone carry a repeated bigram?  Only
+            # then can drafting fire at admission, so only then is an
+            # admission worth resetting the scan-stride backoff for.
+            if length >= 3:
+                pairs = (hist[:length - 1].astype(np.int64) << 32) \
+                    | hist[1:length].astype(np.int64)
+                entry["spec_seed"] = bool(
+                    np.unique(pairs).size < length - 1)
+            else:
+                entry["spec_seed"] = False
         with self._lock:
             if self._stopped:
                 raise BatcherClosed(
@@ -402,13 +583,16 @@ class DecodeEngine:
 
     def compiled_programs(self) -> Dict[str, int]:
         """How many device programs this engine has compiled — by
-        construction at most one chunked-prefill, one prefix-copy, and
-        one step executable (the build sites are None-guarded), so a
-        healthy engine reports {"chunked_prefill": 1, "copy_prefix": 1,
-        "step": 1} for its whole lifetime."""
+        construction at most one chunked-prefill, one prefix-copy, one
+        step, and one speculative-verify executable (the build sites
+        are None-guarded), so a healthy engine reports at most
+        {"chunked_prefill": 1, "copy_prefix": 1, "step": 1,
+        "verify": 1} for its whole lifetime ("verify" stays 0 unless
+        speculation is enabled AND a slot actually drafted)."""
         return {"chunked_prefill": int(self._chunk_exec is not None),
                 "copy_prefix": int(self._copy_exec is not None),
-                "step": int(self._step_exec is not None)}
+                "step": int(self._step_exec is not None),
+                "verify": int(self._verify_exec is not None)}
 
     def stats(self) -> Dict[str, Any]:
         """Locked snapshot of the engine counters: occupancy, queue
@@ -427,15 +611,23 @@ class DecodeEngine:
             })
         steps = c["steps"]
 
-        def pct(values, q):
-            if not values:
-                return 0.0
-            values = sorted(values)
-            return round(values[min(len(values) - 1,
-                                    int(len(values) * q))] * 1e3, 3)
+        # Sort each reservoir ONCE, outside the lock: the lock only
+        # pays the four list copies, and every percentile below reads
+        # the one sorted copy — the old shape re-sorted the same
+        # 4096-entry reservoir per pct() call while a hot /stats +
+        # /metrics scrape pattern held the decode loop's lock.
+        times = sorted(extra["step_times"])
+        gaps = sorted(extra["gap_times"])
+        chunks = sorted(extra["chunk_times"])
+        ttfts = sorted(extra["ttft_times"])
 
-        times = extra["step_times"]
-        gaps = extra["gap_times"]
+        def pct(sorted_values, q):
+            if not sorted_values:
+                return 0.0
+            return round(sorted_values[min(len(sorted_values) - 1,
+                                           int(len(sorted_values) * q))]
+                         * 1e3, 3)
+
         prompt_toks = c["prompt_tokens"]
         return {
             "requests": c["requests"],
@@ -467,11 +659,30 @@ class DecodeEngine:
             "cached_token_ratio": round(
                 c["cached_tokens"] / prompt_toks, 4)
             if prompt_toks else 0.0,
+            # Speculative decoding: drafted vs accepted tokens and the
+            # per-verify-call yield.  accepted_per_step is the mean
+            # EXTRA tokens a verify call delivered beyond the one a
+            # plain decode step would have — the speedup signal to
+            # watch (acceptance_rate alone can look high while k is
+            # backed off to 1).
+            "spec_drafted": c["spec_drafted"],
+            "spec_accepted": c["spec_accepted"],
+            "spec_steps": c["spec_steps"],
+            "spec_acceptance_rate": round(
+                c["spec_accepted"] / c["spec_drafted"], 4)
+            if c["spec_drafted"] else 0.0,
+            "accepted_per_step": round(
+                c["spec_accepted"] / c["spec_steps"], 3)
+            if c["spec_steps"] else 0.0,
+            # Which AOT programs exist — the four-program guarantee,
+            # observable over the :stats route (the hermetic engine
+            # e2e asserts it end to end).
+            "compiled_programs": self.compiled_programs(),
             # Chunked prefill: calls made and their latency — one chunk
             # is the most an arriving prompt may stall in-flight decode
             # per scheduling turn.
             "prefill_chunks": c["prefill_chunks"],
-            "prefill_chunk_p95_ms": pct(extra["chunk_times"], 0.95),
+            "prefill_chunk_p95_ms": pct(chunks, 0.95),
             "mean_occupancy": round(c["occupancy_sum"] / steps, 2)
             if steps else 0.0,
             "tokens_per_sec": round(c["tokens"] / c["busy_s"], 1)
@@ -486,10 +697,10 @@ class DecodeEngine:
             # spike the max.
             "inter_token_gap_p50_ms": pct(gaps, 0.50),
             "inter_token_gap_p99_ms": pct(gaps, 0.99),
-            "inter_token_gap_max_ms": round(max(gaps) * 1e3, 3)
+            "inter_token_gap_max_ms": round(gaps[-1] * 1e3, 3)
             if gaps else 0.0,
-            "ttft_p50_ms": pct(extra["ttft_times"], 0.50),
-            "ttft_p99_ms": pct(extra["ttft_times"], 0.99),
+            "ttft_p50_ms": pct(ttfts, 0.50),
+            "ttft_p99_ms": pct(ttfts, 0.99),
         }
 
     def close(self, drain_s: float = 10.0) -> None:
@@ -563,7 +774,7 @@ class DecodeEngine:
         # append: the slot scan above already moved every expired slot
         # entry into `expired`, and the identity dedup skips those (and
         # entries recurring across snapshots).
-        for _, snapshot in self._pending:
+        for _, snapshot, _ in self._pending:
             for _, entry in snapshot:
                 if entry["event"].is_set():
                     continue
@@ -712,7 +923,7 @@ class DecodeEngine:
         if finished:
             entry["prefilling"] = False
             entry["scheduled"] = 1
-            self._pending.append((tok, [(0, entry)]))
+            self._pending.append((tok, [(0, entry)], None))
             if entry["pool_row"] is not None and self._index is not None:
                 self._index.commit_capture(
                     entry["pool_row"], prompt, true_len)
@@ -749,23 +960,40 @@ class DecodeEngine:
         """Materialize the oldest pending emission and hand its tokens
         to their requests; retire + resolve the ones that completed.
         Counter merges are batched: one locked update per drained call,
-        not per token."""
-        arr, snapshot = self._pending.pop(0)
+        not per token.
+
+        Three emission shapes ride the one stream: a prefill's [1]
+        first token (counts None, col 0), a decode call's
+        [steps, slots] grid (counts None — every live slot emitted one
+        token per fused step), and a verify call's [slots, k+1] grid
+        with a per-slot ``counts`` vector (speculation emits a
+        VARIABLE number of tokens per slot per call — the accepted
+        prefix plus its free token, cut at EOS/budget on device)."""
+        arr, snapshot, counts = self._pending.pop(0)
         host = np.asarray(arr)
-        if host.ndim < 2:   # prefill emission: [1] first token, the
-            host = host[None]   # snapshot's col is 0
         emitted = 0
         finished = 0
         ttfts: List[float] = []
-        for row in host:           # fused calls carry [steps, slots]
-            for col, entry in snapshot:
+        if counts is not None:
+            counts = np.asarray(counts)
+        for col, entry in snapshot:
+            if counts is not None:       # verify: row per slot
+                toks = host[col, :int(counts[col])]
+            elif host.ndim >= 2:         # decode: [steps, slots]
+                toks = host[:, col]
+            else:                        # prefill first token: [1]
+                toks = host
+            for tok in toks:
                 if entry["event"].is_set() or len(entry["emitted"]) >= \
                         entry["new"]:
-                    continue
-                tok = int(row[col])
+                    break
+                tok = int(tok)
                 if entry["t_first"] is None:
                     entry["t_first"] = time.monotonic()
                 entry["emitted"].append(tok)
+                if entry["hist"] is not None:
+                    entry["hist"][entry["hist_len"]] = tok
+                    entry["hist_len"] += 1
                 emitted += 1
                 complete = len(entry["emitted"]) >= entry["new"] or (
                     self._eos and tok == self.decode.eos_token)
@@ -778,6 +1006,7 @@ class DecodeEngine:
                     self._finish(entry)
                     ttfts.append(entry["t_first"] - entry["t"])
                     finished += 1
+                    break
         with self._lock:
             self._counters["tokens"] += emitted
             self._counters["requests"] += finished
@@ -787,6 +1016,199 @@ class DecodeEngine:
                 del self._ttft_times[:2048]
         if emitted:
             self._tok_counter.inc(emitted, engine=self._metric_name)
+
+    @staticmethod
+    def _blend_rate(ema, rate):
+        return rate if ema is None else (
+            (1 - _SPEC_RATE_ALPHA) * ema + _SPEC_RATE_ALPHA * rate)
+
+    def _record_step_timing(self, t0, end, norm, steps, occupancy,
+                            extra=None):
+        """Shared per-round accounting for BOTH step programs (decode
+        and verify): busy time, step/occupancy counters, the per-token
+        latency and inter-token-gap reservoirs, and the step
+        histogram — one discipline, so the percentiles the bench and
+        e2e assert on mean the same thing on either path.  ``norm`` is
+        tokens-per-slot-stream this call (fused steps for decode, mean
+        emissions of advancing slots for verify); ``extra`` merges
+        additional counters under the same lock (a scrape must never
+        see spec_steps ahead of steps)."""
+        dt = end - t0
+        per_tok = dt / norm
+        gap = (end - self._last_step_end
+               if self._last_step_end is not None else None)
+        self._last_step_end = end
+        with self._lock:
+            self._counters["steps"] += steps
+            self._counters["occupancy_sum"] += occupancy
+            self._counters["busy_s"] += dt
+            if extra:
+                for key, value in extra.items():
+                    self._counters[key] += value
+            self._step_times.append(per_tok)
+            if len(self._step_times) > 4096:
+                del self._step_times[:2048]
+            if gap is not None:
+                self._gap_times.append(gap / norm)
+                if len(self._gap_times) > 4096:
+                    del self._gap_times[:2048]
+        self._step_hist.observe(per_tok, engine=self._metric_name)
+
+    def _collect_drafts(self):
+        """Host-side n-gram drafting pass over the live slots.
+
+        Returns (snapshot, draft [slots, k], draft_len [slots]) when at
+        least one slot proposed tokens, else None — the loop then runs
+        the plain decode program, so traffic the drafter cannot
+        predict (and slots whose adaptive width backed off to zero)
+        never pays the k+1-wide verify window.  Histories are exact:
+        speculation forces sync_lag 0, so every emitted token is
+        already materialized when the drafter reads it."""
+        k = self.speculative_tokens
+        # Draft buffers allocate lazily: most rounds on unrepetitive
+        # traffic propose nothing, and this runs once per decode round
+        # — its no-draft path must cost microseconds.
+        draft = draft_len = None
+        snapshot: List[tuple] = []
+        for i, entry in enumerate(self._slot_req):
+            if entry is None or entry["prefilling"]:
+                continue
+            snapshot.append((i, entry))
+            if entry["spec_k"] <= 0:
+                # Backed off: tick the cooldown, then re-probe at a
+                # width that can clear the draft-mass floor on its own
+                # (a width-1 probe from a lone drafting slot would be
+                # mass-gated forever), so a tail that TURNS repetitive
+                # recovers.
+                entry["spec_cool"] -= 1
+                if entry["spec_cool"] <= 0:
+                    entry["spec_k"] = max(1, k // 2)
+                continue
+            # Never draft past the budget: the final budgeted token is
+            # the verify call's free token, so a request with <= 1
+            # token of room gains nothing from drafting.
+            room = entry["new"] - len(entry["emitted"]) - 1
+            width = min(k, entry["spec_k"], room)
+            if width <= 0:
+                continue
+            proposal = _ngram_propose(
+                entry["hist"][:entry["hist_len"]], width)
+            if proposal.size:
+                if draft is None:
+                    draft = np.zeros((self.slots, k), np.int32)
+                    draft_len = np.zeros((self.slots,), np.int32)
+                draft[i, :proposal.size] = proposal
+                draft_len[i] = proposal.size
+        if draft is None:
+            return None
+        return snapshot, draft, draft_len
+
+    def _spec_gates_pass(self, draft_len) -> bool:
+        """Should this round's proposals actually dispatch verify?
+
+        Mass gate: the verify window is STATICALLY k+1 wide — its
+        device cost does not shrink with the actual draft mass — so a
+        round proposing under half of even ONE window's worth
+        (room-capped request tails) cannot win.
+
+        Throughput gate: dispatch verify only while its MEASURED
+        delivered rate beats the decode program's (EMAs over real
+        calls — break-even is hardware dependent, so it is measured,
+        not assumed).  Persistently mediocre acceptance — drafts that
+        match often enough to pass the mass gate but not often enough
+        to pay for the window — lands here; a probe verify every few
+        gated rounds keeps the estimate fresh so traffic that turns
+        repetitive re-enables itself.  Together with the per-slot
+        width backoff these gates are the no-regression guarantee for
+        low-acceptance traffic."""
+        if int(draft_len.sum()) < max(1, self.speculative_tokens // 2):
+            return False
+        if self._rate_step_ema is not None \
+                and self._rate_verify_ema is not None:
+            if self._rate_verify_ema \
+                    < _SPEC_RATE_MARGIN * self._rate_step_ema:
+                self._spec_probe += 1
+                if self._spec_probe < _SPEC_PROBE_EVERY:
+                    return False
+            self._spec_probe = 0
+        return True
+
+    def _verify_round(self, snapshot, draft, draft_len,
+                      live: int) -> None:
+        """One speculative round: dispatch verify_step over every live
+        slot, drain the variable-count emissions synchronously, and
+        fold the outcome into the adaptive widths + counters.
+
+        Rejected drafts need no host-side cleanup: the program only
+        advanced each slot's cache_len over the accepted prefix, so
+        the rejected columns are already behind the attention mask
+        (device-side rollback), and donor-pool capture only ever runs
+        in the prefill-chunk program — a drafted-but-rejected token
+        can never be captured into a prefix-pool row."""
+        from kubeflow_tpu.models.generate import verify_step
+
+        if self._verify_exec is None:
+            self._verify_exec = verify_step.lower(
+                self.cfg, self.params, self._state, self.decode,
+                self.speculative_tokens, draft, draft_len).compile()
+        # Chaos hook: the same site as the decode step — injected
+        # stalls/deaths must hit speculative rounds identically
+        # (deadlines expire mid-verify, _abort resolves waiters).
+        faults.fire("engine.step")
+        t0 = time.perf_counter()
+        self._state, toks, counts = self._verify_exec(
+            self.params, self._state, draft, draft_len)
+        # Materialize ONCE and share the host copies with the drain —
+        # a second device->host transfer per round would show up at
+        # this call rate.
+        toks_np = np.asarray(toks)
+        counts_np = np.asarray(counts)
+        self._pending.append((toks_np, snapshot, counts_np))
+        while len(self._pending) > self.sync_lag:  # sync: drains all
+            self._drain_one()
+        end = time.perf_counter()
+        dt = end - t0
+        drafted = int(draft_len.sum())
+        accepted = 0
+        for col, entry in snapshot:
+            d = int(draft_len[col])
+            if not d:
+                continue
+            lim = min(d, int(counts_np[col]))
+            a = 0
+            while a < lim and toks_np[col, a] == draft[col, a]:
+                a += 1
+            accepted += a
+            # Adaptive width: additive increase on a full accept,
+            # additive decrease on a full reject; at zero the slot
+            # stops paying drafting until the cooldown re-probe.
+            if a == d:
+                entry["spec_k"] = min(self.speculative_tokens,
+                                      entry["spec_k"] + 1)
+            elif a == 0:
+                entry["spec_k"] -= 1
+                if entry["spec_k"] <= 0:
+                    entry["spec_k"] = 0
+                    entry["spec_cool"] = _SPEC_COOLDOWN
+        total = int(counts_np.sum())
+        advancing = int(np.count_nonzero(counts_np))
+        if dt > 0:
+            self._rate_verify_ema = self._blend_rate(
+                self._rate_verify_ema, total / dt)
+        # Per-TOKEN latency/gap samples: one verify call delivers a
+        # variable token count, so normalize by the mean emissions of
+        # the slots that advanced — the client-visible stream pace.
+        norm = max(1.0, total / advancing) if advancing else 1.0
+        self._record_step_timing(
+            t0, end, norm, steps=1, occupancy=live,
+            extra={"spec_steps": 1, "spec_drafted": drafted,
+                   "spec_accepted": accepted})
+        if drafted:
+            self._spec_drafted_ctr.inc(drafted,
+                                       engine=self._metric_name)
+        if accepted:
+            self._spec_accepted_ctr.inc(accepted,
+                                        engine=self._metric_name)
 
     def _run(self) -> None:
         from kubeflow_tpu.models.generate import decode_step
@@ -870,6 +1292,46 @@ class DecodeEngine:
                     sum(r is not None for r in self._slot_req))
                 live = sum(1 for r in self._slot_req
                            if r is not None and not r["prefilling"])
+                if live and self.speculative_tokens:
+                    # Speculation: draft host-side; when at least one
+                    # slot proposed, one verify call replaces this
+                    # round's decode step (undrafted slots ride along
+                    # at draft_len 0 and still net their one token).
+                    # No drafts => fall through to the plain decode
+                    # program — the adaptive backoff's no-regression
+                    # guarantee for low-acceptance traffic — and
+                    # stretch the scan stride so persistent
+                    # unrepetitive traffic stops paying even the scan.
+                    self._spec_tick += 1
+                    if any(e.get("spec_seed") for e, _ in admissions):
+                        # A draftable prompt arrived: scan next round
+                        # and let the first drafted round probe even
+                        # if earlier traffic measured speculation
+                        # unprofitable — a new request is a new
+                        # regime.
+                        self._spec_stride = 1
+                        self._spec_tick = self._spec_stride
+                        self._spec_probe = _SPEC_PROBE_EVERY
+                    if self._spec_tick >= self._spec_stride:
+                        self._spec_tick = 0
+                        drafts = self._collect_drafts()
+                        if drafts is None:
+                            # Truly EMPTY scan (nothing proposed):
+                            # stretch the scan period.  Gate-blocked
+                            # rounds below do NOT — proposals exist,
+                            # so the scan stays productive and the
+                            # probe cadence stays honest.
+                            self._spec_stride = min(
+                                self._spec_stride * 2,
+                                _SPEC_SCAN_STRIDE_MAX)
+                        else:
+                            self._spec_stride = 1
+                            if self._spec_gates_pass(drafts[2]):
+                                self._verify_round(*drafts, live)
+                                self._set_occ_gauge(sum(
+                                    r is not None
+                                    for r in self._slot_req))
+                                continue
                 if live:
                     k = self.steps_per_call
                     # Build (one-time) OUTSIDE the timed window: the
@@ -886,12 +1348,21 @@ class DecodeEngine:
                     # does not masquerade as device latency in the
                     # step histogram.
                     faults.fire("engine.step")
+                    # Counter read is loop-thread-local (the sync
+                    # drain below merges into it on this same thread):
+                    # the delta across the drain is the tokens this
+                    # round actually DELIVERED — post-EOS/post-budget
+                    # fused steps emit nothing, so live*k would
+                    # overstate the decode rate and the throughput
+                    # gate would suppress profitable speculation.
+                    tok_before = (self._counters["tokens"]
+                                  if self.speculative_tokens else 0)
                     t0 = time.perf_counter()
                     self._state, sampled = self._step_exec(
                         self.params, self._state)
                     self._pending.append((sampled, [
                         (i, r) for i, r in enumerate(self._slot_req)
-                        if r is not None and not r["prefilling"]]))
+                        if r is not None and not r["prefilling"]], None))
                     # Deterministic retirement: with no EOS in play a
                     # request's completion step is known at dispatch —
                     # free the slot NOW so the next admission overlaps
@@ -908,28 +1379,23 @@ class DecodeEngine:
                     while len(self._pending) > self.sync_lag:
                         self._drain_one()
                     end = time.perf_counter()
-                    dt = end - t0
-                    per_step = dt / k
-                    gap = (end - self._last_step_end
-                           if self._last_step_end is not None else None)
-                    self._last_step_end = end
-                    with self._lock:
-                        self._counters["steps"] += k
-                        self._counters["occupancy_sum"] += live * k
-                        self._counters["busy_s"] += dt
-                        self._step_times.append(per_step)
-                        if len(self._step_times) > 4096:
-                            del self._step_times[:2048]
-                        if gap is not None:
-                            # Per-call gap normalized by fused steps:
-                            # what a client streaming tokens would see
-                            # between tokens, including interleaved
-                            # admission/prefill work.
-                            self._gap_times.append(gap / k)
-                            if len(self._gap_times) > 4096:
-                                del self._gap_times[:2048]
-                    self._step_hist.observe(per_step,
-                                            engine=self._metric_name)
+                    if self.speculative_tokens and end > t0:
+                        # Feed the speculation throughput gate its
+                        # decode-side comparison rate, in DELIVERED
+                        # tokens (same currency as the verify side's
+                        # counts sum).
+                        delivered = (self._counters["tokens"]
+                                     - tok_before)
+                        if delivered > 0:
+                            self._rate_step_ema = self._blend_rate(
+                                self._rate_step_ema,
+                                delivered / (end - t0))
+                    # Per-call latency and gap normalized by fused
+                    # steps: what a client streaming tokens would see
+                    # between tokens, including interleaved
+                    # admission/prefill work.
+                    self._record_step_timing(
+                        t0, end, k, steps=k, occupancy=live * k)
                 else:
                     self._last_step_end = None
                     if not self._prefilling:
@@ -966,7 +1432,7 @@ class DecodeEngine:
                 entry["err"] = err
                 entry["event"].set()
             self._slot_req[i] = None
-        for _, snapshot in self._pending:
+        for _, snapshot, _ in self._pending:
             for _, entry in snapshot:
                 if not entry["event"].is_set():
                     entry["err"] = err

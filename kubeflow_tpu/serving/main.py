@@ -37,7 +37,8 @@ def batcher_factory(*, micro_batch_size: int, batch_timeout_s: float,
                     prefix_pool_blocks: int = 4,
                     prefix_block_tokens: int = 16,
                     max_queue_depth: int = 0,
-                    overload_retry_after_s: float = 1.0):
+                    overload_retry_after_s: float = 1.0,
+                    speculative_tokens: int = 0):
     """ModelServer.enable_batching factory: picks the batcher per model.
 
     lm_generate models default to the continuous-batching DecodeEngine
@@ -67,7 +68,7 @@ def batcher_factory(*, micro_batch_size: int, batch_timeout_s: float,
             # Prefill width: explicit flag > largest bucket > a capped
             # share of whatever prompt room the model's max_seq_len
             # leaves after the configured completion budget.  The width
-            # is a STATIC program shape (the three-program guarantee), so
+            # is a STATIC program shape (the four-program guarantee), so
             # every admission prefills at this width no matter how
             # short the prompt, and the persistent cache is sized
             # slots x (width + budget) — hence the flagless cap: a
@@ -100,6 +101,7 @@ def batcher_factory(*, micro_batch_size: int, batch_timeout_s: float,
                     prefix_block_tokens=prefix_block_tokens,
                     max_queue_depth=max_queue_depth,
                     overload_retry_after_s=overload_retry_after_s,
+                    speculative_tokens=speculative_tokens,
                     name=f"{model.name}-v{model.version}")
             logging.warning(
                 "decode engine disabled for %r: max_new_tokens %d "
@@ -208,6 +210,16 @@ def main(argv=None) -> int:
                     help="prefix cache hash/match granularity in "
                          "tokens — prefixes are cached and matched in "
                          "multiples of this")
+    ap.add_argument("--speculative_tokens", type=int, default=0,
+                    help="DecodeEngine self-speculative decoding: up "
+                         "to this many n-gram-drafted candidate tokens "
+                         "verify per slot in ONE forward pass "
+                         "(prompt-lookup drafting, no second model), "
+                         "token-identical to greedy decode; per-slot "
+                         "adaptive backoff protects low-acceptance "
+                         "traffic.  Greedy exports only (sampling "
+                         "exports fall back to plain decode); 0 "
+                         "disables")
     ap.add_argument("--max_queue_depth", type=int, default=256,
                     help="bounded admission: submissions beyond this "
                          "many pending requests per model fail fast "
@@ -272,6 +284,7 @@ def main(argv=None) -> int:
                 prefix_block_tokens=args.prefix_block_tokens,
                 max_queue_depth=args.max_queue_depth,
                 overload_retry_after_s=args.overload_retry_after_s,
+                speculative_tokens=args.speculative_tokens,
             ),
         )
         logging.info(

@@ -99,7 +99,11 @@ def engine_smoke(namespace: str = "kubeflow-test") -> None:
     prompt) must register prefix-cache hits in
     ``kft_engine_prefix_hits_total`` and keep the max inter-token gap
     of in-flight slots under the chunk-budget bound (no full-prefill
-    stall spike)."""
+    stall spike).  Finally a speculative burst (--speculative_tokens
+    rebuild, repetitive prompts the n-gram drafter can predict) must
+    register accepted drafts in ``kft_engine_spec_accepted_total``,
+    report all four compiled programs over :stats, and produce
+    token-IDENTICAL output to a spec-OFF control rebuild."""
     import json
     import tempfile
     import threading
@@ -120,8 +124,9 @@ def engine_smoke(namespace: str = "kubeflow-test") -> None:
         "n_kv_heads": 2, "d_ff": 64, "head_dim": 8, "max_seq_len": 64,
         "dtype": "float32",
     }
-    max_new = 8
-    model = Transformer(_model_config(overrides))
+    max_new = 16
+    cfg = _model_config(overrides)
+    model = Transformer(cfg)
     variables = model.init(jax.random.key(0), np.zeros((1, 4), np.int32))
     with tempfile.TemporaryDirectory() as tmp:
         export(f"{tmp}/lm", 1, variables,
@@ -231,6 +236,127 @@ def engine_smoke(namespace: str = "kubeflow-test") -> None:
             assert hits > 0, "kft_engine_prefix_hits_total not exported"
             assert sample_value(
                 parsed, "kft_serving_cached_token_ratio") is not None
+
+            # --- speculative burst: rebuild the batching plane with
+            # speculation on (fresh engine, fourth AOT program) and
+            # drive repetitive prompts — tiled patterns whose greedy
+            # continuations collapse into runs the n-gram drafter
+            # predicts.  Speculation must ACCEPT drafts (counted in
+            # kft_engine_spec_accepted_total) while staying token-
+            # identical to a spec-OFF control rebuild.
+            def rebuild(spec_tokens):
+                server.enable_batching("lm", batcher_factory(
+                    micro_batch_size=0, batch_timeout_s=0.005,
+                    lm_engine=True, lm_engine_slots=2,
+                    lm_engine_prefill_len=16, prefill_chunk_tokens=8,
+                    prefix_pool_blocks=2, prefix_block_tokens=4,
+                    speculative_tokens=spec_tokens))
+
+            rebuild(4)
+            # Pick burst prompts the DRAFTER itself would succeed on,
+            # by simulating it host-side against the reference greedy
+            # continuations (the same selection bench.py's
+            # speculation probe uses): the spec_accepted assert below
+            # must hold by construction, independent of the measured-
+            # throughput gate's scheduling-sensitive timing on a
+            # loaded box.
+            from kubeflow_tpu.models.generate import (
+                DecodeConfig,
+                generate,
+            )
+            from kubeflow_tpu.serving.engine import _ngram_propose
+
+            cand = [np.asarray(
+                (rng.randint(1, 128, size=(4,)).tolist() * 3)[:12],
+                np.int32) for _ in range(8)]
+            refs = np.asarray(generate(
+                cfg, variables["params"], np.stack(cand),
+                DecodeConfig(max_new_tokens=max_new,
+                             temperature=0.0))[0])
+
+            def sim_accepts(prompt, cont):
+                hist = list(prompt) + [cont[0]]
+                accepted, i = 0, 1
+                while i < len(cont):
+                    room = len(cont) - i - 1
+                    prop = (_ngram_propose(
+                        np.asarray(hist, np.int32), min(4, room))
+                        if room > 0 else np.empty((0,), np.int32))
+                    a = 0
+                    for j, p in enumerate(prop.tolist()):
+                        if p == cont[i + j]:
+                            a += 1
+                        else:
+                            break
+                    accepted += a
+                    hist.extend(cont[i:i + a + 1])
+                    i += a + 1
+                return accepted
+
+            scores = [sim_accepts(cand[i].tolist(),
+                                  refs[i, 12:].tolist())
+                      for i in range(len(cand))]
+            ranked = sorted(range(len(cand)),
+                            key=lambda i: scores[i], reverse=True)
+            assert scores[ranked[0]] > 0, (
+                "no candidate prompt is draftable under the n-gram "
+                "drafter; widen the candidate pool")
+            spec_prompts = [cand[i].tolist() for i in ranked[:4]]
+            outs.clear()
+            threads = [threading.Thread(target=client, args=(i, p))
+                       for i, p in enumerate(spec_prompts)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            spec_out = {}
+            for i, prompt in enumerate(spec_prompts):
+                tokens = outs[i]["predictions"][0]["tokens"]
+                assert tokens[:len(prompt)] == prompt
+                assert len(tokens) == len(prompt) + max_new
+                spec_out[i] = tokens
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/model/lm:stats",
+                    timeout=30) as resp:
+                stats = json.loads(resp.read())["batcher"]
+            assert stats["spec_drafted"] > 0, (
+                f"speculative burst proposed no drafts: {stats}")
+            assert stats["spec_accepted"] > 0, (
+                f"speculative burst accepted no drafts: {stats}")
+            assert 0 < stats["spec_acceptance_rate"] <= 1
+            # The four-program guarantee, end to end over :stats —
+            # verify exists exactly once; a purely-drafted burst may
+            # never need the plain step program, so it is 0 or 1.
+            programs = stats["compiled_programs"]
+            assert set(programs) == {"chunked_prefill", "copy_prefix",
+                                     "step", "verify"}, programs
+            assert programs["verify"] == 1, programs
+            assert programs["chunked_prefill"] == 1, programs
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics",
+                    timeout=30) as resp:
+                parsed = parse_metrics(resp.read().decode())
+            accepted = sample_value(
+                parsed, "kft_engine_spec_accepted_total") or 0
+            drafted = sample_value(
+                parsed, "kft_engine_spec_drafted_total") or 0
+            assert accepted > 0, (
+                "kft_engine_spec_accepted_total not exported/zero")
+            assert drafted >= accepted
+            # Spec-OFF control: identical tokens on a fresh engine.
+            rebuild(0)
+            outs.clear()
+            for i, prompt in enumerate(spec_prompts):
+                client(i, prompt)
+                assert outs[i]["predictions"][0]["tokens"] \
+                    == spec_out[i], (
+                    f"speculation changed tokens for prompt {i}")
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/model/lm:stats",
+                    timeout=30) as resp:
+                stats = json.loads(resp.read())["batcher"]
+            assert stats["spec_drafted"] == 0
+            assert stats["compiled_programs"]["verify"] == 0
         finally:
             httpd.shutdown()
             server.stop()

@@ -78,7 +78,11 @@ class TestE2EDrivers:
     def test_engine_smoke(self):
         # The ci/e2e_config.yaml hermetic `engine` step: mixed-length
         # requests through the HTTP surface against the in-process
-        # continuous-batching engine, occupancy drains to zero.
+        # continuous-batching engine (occupancy drains to zero), a
+        # shared-prefix burst (kft_engine_prefix_hits_total > 0,
+        # bounded inter-token gap), and a speculative burst
+        # (kft_engine_spec_accepted_total > 0, four compiled
+        # programs, token-identical to a spec-OFF control).
         engine_smoke()
 
     def test_fault_injection_smoke(self):
