@@ -1,0 +1,40 @@
+"""kft-analyze: semantic static analysis for this repo's invariants.
+
+``ci/lint.py`` enforces *formatting* rules (the reference's
+autoformat-as-a-build-step policy); this package enforces *semantic*
+invariants the codebase keeps by design — the defect classes every
+review cycle since PR 2 has caught by hand:
+
+  clock-discipline  policy modules (serving, fleet, scheduler,
+                    operator) never read ``time.monotonic()`` /
+                    ``time.time()`` directly — deadline, backoff, and
+                    aging decisions route through the skewable
+                    ``testing.faults.monotonic()`` policy clock so the
+                    seeded clock-skew fault tests actually cover them
+  lock-guard        an attribute written under ``with self._lock`` in
+                    any method of a class is *guarded*: writing it
+                    outside the lock anywhere else in the class is the
+                    lost-update bug class (the PR-6 cycle-profile bug)
+  jit-purity        functions handed to ``jax.jit`` / AOT lowering
+                    must not call host-effect modules (time, random,
+                    threading, ...) — tracer-era nondeterminism breaks
+                    the compiled-program identity guarantees
+  metric-hygiene    every Prometheus name literal starts ``kft_``,
+                    counters end ``_total`` (and only counters do),
+                    and one metric name keeps ONE label set across
+                    every call site
+
+Run ``python -m kubeflow_tpu.analysis`` (or ``python ci/lint.py
+--deep``).  Per-line suppressions use ``# kft: allow=<check>``; known
+pre-existing findings live in the shrink-only baseline
+``ci/analysis_baseline.json`` (see ``core.py``).  Stdlib-only.
+"""
+
+from kubeflow_tpu.analysis.core import (  # noqa: F401
+    Finding,
+    analyze_source,
+    load_baseline,
+    run,
+)
+
+__all__ = ["Finding", "analyze_source", "load_baseline", "run"]

@@ -1,0 +1,83 @@
+"""clock-discipline: policy modules read the skewable policy clock.
+
+The fault harness (testing/faults.py) tests deadlines, backoffs, and
+queue aging by SKEWING a policy clock — ``faults.monotonic()`` —
+instead of sleeping through wall time.  That only works if policy code
+actually reads it: a ``time.monotonic()`` smuggled into a drain loop
+is invisible to every seeded clock-skew scenario, which is exactly how
+the pre-PR-8 drain/aging sites escaped coverage.
+
+Rule: inside the policy packages (serving, fleet, scheduler,
+operator), direct calls to ``time.monotonic()`` or ``time.time()``
+are findings.  ``time.perf_counter()`` stays legal — measuring a
+DURATION (step latency, scrape cost) is instrumentation, not policy,
+and must not bend under an injected skew.  Wall-clock timestamps that
+leave the process (CR status stamps, event logs) suppress with
+``# kft: allow=clock-discipline`` and say why.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import ast
+
+from kubeflow_tpu.analysis.core import Finding
+
+CHECK = "clock-discipline"
+
+POLICY_PREFIXES = ("kubeflow_tpu/serving/", "kubeflow_tpu/fleet/",
+                   "kubeflow_tpu/scheduler/", "kubeflow_tpu/operator/")
+
+_BANNED = {"monotonic", "time"}
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self, rel: str):
+        self.rel = rel
+        self.stack: List[str] = []
+        self.findings: List[Finding] = []
+
+    def _qualname(self) -> str:
+        return ".".join(self.stack) or "<module>"
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self.stack.append(node.name)
+        self.generic_visit(node)
+        self.stack.pop()
+
+    def _visit_func(self, node) -> None:
+        self.stack.append(node.name)
+        self.generic_visit(node)
+        self.stack.pop()
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+    def visit_Call(self, node: ast.Call) -> None:
+        func = node.func
+        if (isinstance(func, ast.Attribute)
+                and isinstance(func.value, ast.Name)
+                and func.value.id == "time"
+                and func.attr in _BANNED):
+            self.findings.append(Finding(
+                check=CHECK, path=self.rel, line=node.lineno,
+                col=node.col_offset,
+                message=(f"policy module calls time.{func.attr}() "
+                         f"directly; route through faults.monotonic() "
+                         f"so clock-skew fault tests cover this site"),
+                symbol=f"time.{func.attr}@{self._qualname()}"))
+        self.generic_visit(node)
+
+
+class ClockDiscipline:
+    def visit_module(self, rel: str, tree: ast.Module,
+                     text: str) -> List[Finding]:
+        if not rel.startswith(POLICY_PREFIXES):
+            return []
+        v = _Visitor(rel)
+        v.visit(tree)
+        return v.findings
+
+    def finish(self) -> List[Finding]:
+        return []

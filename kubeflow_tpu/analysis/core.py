@@ -1,0 +1,249 @@
+"""Checker framework: findings, suppressions, baseline, runner.
+
+The moving parts, in the order a run uses them:
+
+  * every checker is a class with ``visit_module(rel, tree, text)``
+    (called once per file) and ``finish()`` (called once per run, for
+    cross-module checks like label-set consistency) — both return
+    Finding lists;
+  * a ``# kft: allow=<check>[,<check>...]`` comment suppresses a
+    finding on its own line; a standalone comment line carrying the
+    directive suppresses the next code line (for findings on lines
+    with no column budget left);
+  * the baseline file (``ci/analysis_baseline.json``) is SHRINK-ONLY:
+    a finding whose fingerprint is listed is tolerated, but a listed
+    fingerprint that no longer fires is an error ("stale baseline
+    entry — delete it"), so the file can never quietly grow and can
+    only march toward empty.  ``--write-baseline`` regenerates it from
+    the current findings (review the diff: it should only remove
+    lines).
+
+Fingerprints deliberately omit line numbers — ``check::path::symbol``
+where ``symbol`` names the construct (qualified function, attribute,
+metric name), so unrelated edits above a grandfathered finding don't
+churn the baseline.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import pathlib
+import re
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+import ast
+
+SKIP_DIRS = {"__pycache__", ".git", ".pytest_cache", "artifacts",
+             "node_modules", ".claude"}
+
+# Generated code is exempt (mirrors ci/lint.py).
+GENERATED = {"kubeflow_tpu/serving/protos/prediction_pb2.py",
+             "kubeflow_tpu/serving/protos/tf_compat_pb2.py"}
+
+_ALLOW_RE = re.compile(r"#\s*kft:\s*allow=([A-Za-z0-9_,-]+)")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation at one site.
+
+    ``symbol`` is the stable identity used for baselining (qualified
+    name of the enclosing construct plus a disambiguator), never the
+    line number."""
+
+    check: str
+    path: str
+    line: int
+    col: int
+    message: str
+    symbol: str
+
+    def fingerprint(self) -> str:
+        return f"{self.check}::{self.path}::{self.symbol}"
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col}: {self.check}: "
+                f"{self.message}")
+
+    def to_json(self) -> Dict[str, object]:
+        return {"check": self.check, "path": self.path,
+                "line": self.line, "col": self.col,
+                "message": self.message,
+                "fingerprint": self.fingerprint()}
+
+
+def dedupe_symbols(findings: List[Finding]) -> List[Finding]:
+    """Disambiguate repeated (check, path, symbol) triples with a #n
+    suffix so every fingerprint in a run is unique (two bare
+    ``time.time()`` calls in one function must not collapse into one
+    baseline entry)."""
+    seen: Dict[str, int] = {}
+    out: List[Finding] = []
+    for f in findings:
+        n = seen.get(f.fingerprint(), 0)
+        seen[f.fingerprint()] = n + 1
+        if n:
+            f = dataclasses.replace(f, symbol=f"{f.symbol}#{n + 1}")
+        out.append(f)
+    return out
+
+
+def suppressions(text: str) -> Dict[int, Set[str]]:
+    """Map line number -> suppressed check names.
+
+    A directive on a code line covers that line; a directive on a
+    COMMENT-ONLY line covers that line and the next non-blank,
+    non-comment line below it."""
+    out: Dict[int, Set[str]] = {}
+    pending: Set[str] = set()
+    for lineno, line in enumerate(text.splitlines(), 1):
+        stripped = line.strip()
+        m = _ALLOW_RE.search(line)
+        checks = ({c.strip() for c in m.group(1).split(",") if c.strip()}
+                  if m else set())
+        if checks:
+            out.setdefault(lineno, set()).update(checks)
+        if stripped.startswith("#"):
+            pending |= checks
+            continue
+        if stripped and pending:
+            out.setdefault(lineno, set()).update(pending)
+            pending = set()
+    return out
+
+
+def apply_suppressions(findings: List[Finding],
+                       per_file: Dict[str, Dict[int, Set[str]]]
+                       ) -> Tuple[List[Finding], int]:
+    kept: List[Finding] = []
+    suppressed = 0
+    for f in findings:
+        allowed = per_file.get(f.path, {}).get(f.line, set())
+        if f.check in allowed or "all" in allowed:
+            suppressed += 1
+        else:
+            kept.append(f)
+    return kept, suppressed
+
+
+# -- baseline ---------------------------------------------------------------
+
+def load_baseline(path: pathlib.Path) -> List[str]:
+    if not path.exists():
+        return []
+    data = json.loads(path.read_text(encoding="utf-8"))
+    entries = data.get("findings", data if isinstance(data, list) else [])
+    if not isinstance(entries, list) or not all(
+            isinstance(e, str) for e in entries):
+        raise ValueError(f"baseline {path}: want a list of fingerprint "
+                         f"strings under 'findings'")
+    return list(entries)
+
+
+def write_baseline(path: pathlib.Path, findings: List[Finding]) -> None:
+    payload = {
+        "comment": "shrink-only: entries may be removed, never added; "
+                   "regenerate with python -m kubeflow_tpu.analysis "
+                   "--write-baseline",
+        "findings": sorted(f.fingerprint() for f in findings),
+    }
+    path.write_text(json.dumps(payload, indent=2) + "\n",
+                    encoding="utf-8")
+
+
+def split_by_baseline(findings: List[Finding], baseline: List[str]
+                      ) -> Tuple[List[Finding], List[Finding], List[str]]:
+    """-> (new findings, baselined findings, stale baseline entries)."""
+    known = set(baseline)
+    new = [f for f in findings if f.fingerprint() not in known]
+    old = [f for f in findings if f.fingerprint() in known]
+    fired = {f.fingerprint() for f in findings}
+    stale = sorted(known - fired)
+    return new, old, stale
+
+
+# -- runner -----------------------------------------------------------------
+
+def py_files(root: pathlib.Path) -> Iterator[pathlib.Path]:
+    """The analyzed set: the kubeflow_tpu package (tests poke at
+    internals on purpose; the invariants bind production code)."""
+    pkg = root / "kubeflow_tpu"
+    base = pkg if pkg.is_dir() else root
+    for path in sorted(base.rglob("*.py")):
+        parts = path.relative_to(root).parts
+        if SKIP_DIRS.intersection(parts):
+            continue
+        if any(p.startswith(".") for p in parts[:-1]):
+            continue
+        if path.relative_to(root).as_posix() in GENERATED:
+            continue
+        yield path
+
+
+def default_checkers() -> List[object]:
+    from kubeflow_tpu.analysis.clock import ClockDiscipline
+    from kubeflow_tpu.analysis.jitpurity import JitPurity
+    from kubeflow_tpu.analysis.locks import LockGuard
+    from kubeflow_tpu.analysis.metrics import MetricHygiene
+
+    return [ClockDiscipline(), LockGuard(), JitPurity(), MetricHygiene()]
+
+
+@dataclasses.dataclass
+class Report:
+    findings: List[Finding]            # unsuppressed, not in baseline
+    baselined: List[Finding]
+    stale: List[str]
+    suppressed: int
+    files: int
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings and not self.stale
+
+
+def run(root: pathlib.Path,
+        baseline: Optional[List[str]] = None,
+        checkers: Optional[List[object]] = None) -> Report:
+    checkers = default_checkers() if checkers is None else checkers
+    per_file: Dict[str, Dict[int, Set[str]]] = {}
+    findings: List[Finding] = []
+    files = 0
+    for path in py_files(root):
+        rel = path.relative_to(root).as_posix()
+        text = path.read_text(encoding="utf-8")
+        try:
+            tree = ast.parse(text)
+        except SyntaxError:
+            continue  # ci/lint.py owns the parse gate
+        files += 1
+        per_file[rel] = suppressions(text)
+        for checker in checkers:
+            findings.extend(checker.visit_module(rel, tree, text))
+    for checker in checkers:
+        findings.extend(checker.finish())
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.check))
+    findings = dedupe_symbols(findings)
+    findings, suppressed = apply_suppressions(findings, per_file)
+    new, old, stale = split_by_baseline(findings, baseline or [])
+    return Report(findings=new, baselined=old, stale=stale,
+                  suppressed=suppressed, files=files)
+
+
+def analyze_source(text: str, rel: str = "kubeflow_tpu/mod.py",
+                   checkers: Optional[List[object]] = None
+                   ) -> List[Finding]:
+    """One in-memory module through the full pipeline (checkers +
+    suppressions, no baseline) — the test fixture entry point."""
+    checkers = default_checkers() if checkers is None else checkers
+    tree = ast.parse(text)
+    findings: List[Finding] = []
+    for checker in checkers:
+        findings.extend(checker.visit_module(rel, tree, text))
+    for checker in checkers:
+        findings.extend(checker.finish())
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.check))
+    findings = dedupe_symbols(findings)
+    findings, _ = apply_suppressions(findings, {rel: suppressions(text)})
+    return findings

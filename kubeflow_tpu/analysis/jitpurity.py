@@ -1,0 +1,127 @@
+"""jit-purity: no host side effects inside traced/AOT-compiled code.
+
+The serving loop's guarantees lean on compiled-program IDENTITY — the
+engine proves "at most four programs, ever" over :stats, and the
+speculative/prefix paths assume a program's behavior is a pure
+function of its inputs.  A ``time.time()`` or ``random.random()``
+inside a jitted function executes at TRACE time: the value is frozen
+into the compiled artifact, differs between compiles, and silently
+breaks replayability (the classic tracer-era nondeterminism bug).
+
+Detection (lexical, same-module):
+
+  * a function is *jitted* when decorated ``@jax.jit`` /
+    ``@partial(jax.jit, ...)`` / ``@functools.partial(jax.jit, ...)``,
+    or passed as the first argument to a ``jax.jit(...)`` call whose
+    argument names a function defined in the module (this also covers
+    the engine's AOT ``jitted.lower(...).compile()`` sites — the
+    lowered callable is the decorated one);
+  * inside a jitted function, any call whose receiver chain roots at a
+    host-effect module (time, random, threading, os, socket,
+    subprocess, datetime) or hits an effectful builtin (open, print,
+    input) is a finding.  ``jax.random`` and ``jax.debug.print`` root
+    at ``jax`` and stay legal.
+
+The walk is not transitive through helper calls — a helper that leaks
+effects gets caught when it is itself jitted or inlined; keep helpers
+called from jitted code trivially pure.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Set
+
+import ast
+
+from kubeflow_tpu.analysis.core import Finding
+
+CHECK = "jit-purity"
+
+HOST_MODULES = {"time", "random", "threading", "os", "socket",
+                "subprocess", "datetime"}
+HOST_BUILTINS = {"open", "print", "input", "breakpoint"}
+
+
+def _root_name(expr: ast.expr):
+    while isinstance(expr, ast.Attribute):
+        expr = expr.value
+    return expr.id if isinstance(expr, ast.Name) else None
+
+
+def _is_jax_jit(expr: ast.expr) -> bool:
+    return (isinstance(expr, ast.Attribute) and expr.attr == "jit"
+            and _root_name(expr) == "jax") or (
+        isinstance(expr, ast.Name) and expr.id == "jit")
+
+
+def _is_partial(expr: ast.expr) -> bool:
+    return (isinstance(expr, ast.Name) and expr.id == "partial") or (
+        isinstance(expr, ast.Attribute) and expr.attr == "partial")
+
+
+def _decorated_jitted(fn) -> bool:
+    for dec in fn.decorator_list:
+        if _is_jax_jit(dec):
+            return True
+        if (isinstance(dec, ast.Call) and _is_partial(dec.func)
+                and dec.args and _is_jax_jit(dec.args[0])):
+            return True
+        if (isinstance(dec, ast.Call) and _is_jax_jit(dec.func)):
+            return True
+    return False
+
+
+class JitPurity:
+    def visit_module(self, rel: str, tree: ast.Module,
+                     text: str) -> List[Finding]:
+        defs: Dict[str, ast.AST] = {}
+        jitted: List[ast.AST] = []
+        jitted_ids: Set[int] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+                defs.setdefault(node.name, node)
+                if _decorated_jitted(node) and id(node) not in jitted_ids:
+                    jitted.append(node)
+                    jitted_ids.add(id(node))
+        for node in ast.walk(tree):
+            if (isinstance(node, ast.Call) and _is_jax_jit(node.func)
+                    and node.args
+                    and isinstance(node.args[0], ast.Name)):
+                target = defs.get(node.args[0].id)
+                if target is not None and id(target) not in jitted_ids:
+                    jitted.append(target)
+                    jitted_ids.add(id(target))
+        findings: List[Finding] = []
+        for fn in jitted:
+            findings.extend(self._check_body(rel, fn))
+        return findings
+
+    def _check_body(self, rel: str, fn) -> List[Finding]:
+        out: List[Finding] = []
+        for stmt in fn.body:
+            for node in ast.walk(stmt):
+                if not isinstance(node, ast.Call):
+                    continue
+                callee = node.func
+                bad = None
+                if isinstance(callee, ast.Attribute):
+                    root = _root_name(callee)
+                    if root in HOST_MODULES:
+                        bad = f"{root}.{callee.attr}"
+                elif (isinstance(callee, ast.Name)
+                        and callee.id in HOST_BUILTINS):
+                    bad = callee.id
+                if bad is not None:
+                    out.append(Finding(
+                        check=CHECK, path=rel, line=node.lineno,
+                        col=node.col_offset,
+                        message=(f"jit-compiled {fn.name}() calls "
+                                 f"{bad}() — a host effect evaluated "
+                                 f"at trace time breaks compiled-"
+                                 f"program identity"),
+                        symbol=f"{bad}@{fn.name}"))
+        return out
+
+    def finish(self) -> List[Finding]:
+        return []

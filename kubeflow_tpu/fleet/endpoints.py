@@ -129,6 +129,13 @@ class _EjectBreaker:
         with self._lock:
             return self.failures > 0
 
+    def failure_count(self) -> int:
+        """Locked read for status snapshots (describe): ``failures``
+        is written under the lock on every verdict, so a bare read
+        could tear against a concurrent record_failure."""
+        with self._lock:
+            return self.failures
+
 
 class EndpointState:
     """Mutable per-endpoint fleet state (owned by the registry; the
@@ -494,12 +501,23 @@ class EndpointRegistry:
         """Summed scraped in-flight + queue depth across READY replicas
         — the autoscaler's utilization numerator (draining/ejected
         replicas are capacity leaving the fleet, not load to plan
-        for)."""
-        return sum(s.inflight + s.queue_depth
-                   for s in self.all() if s.ready)
+        for).  Each replica's pair is read under its state lock: the
+        scrape writes both fields in one locked section, and a torn
+        read (new inflight + previous pass's queue depth) would feed
+        the autoscaler a load that never existed."""
+        total = 0.0
+        for s in self.all():
+            with s._lock:
+                if s.ready:
+                    total += s.inflight + s.queue_depth
+        return total
 
     def ready_count(self) -> int:
-        return sum(1 for s in self.all() if s.ready)
+        count = 0
+        for s in self.all():
+            with s._lock:
+                count += bool(s.ready)
+        return count
 
     def describe(self) -> List[Dict[str, Any]]:
         """JSON-able endpoint table (the router's /fleet/endpoints
@@ -515,7 +533,7 @@ class EndpointRegistry:
                     "queue_depth": s.queue_depth,
                     "local_inflight": s.local_inflight,
                     "cached_token_ratio": s.cached_token_ratio,
-                    "breaker_failures": s.breaker.failures,
+                    "breaker_failures": s.breaker.failure_count(),
                 })
         return out
 

@@ -159,8 +159,8 @@ def main(argv=None) -> int:
     stop.wait()
     # Drain: readiness already flipped in the handler; give proxied
     # in-flight requests their budget before the listener closes.
-    deadline = time.monotonic() + max(0.0, args.drain_deadline_s)
-    while time.monotonic() < deadline and any(
+    deadline = faults.monotonic() + max(0.0, args.drain_deadline_s)
+    while faults.monotonic() < deadline and any(
             s.local_inflight for s in registry.all()):
         time.sleep(0.05)
     if autoscaler is not None:

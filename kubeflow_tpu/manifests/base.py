@@ -1,7 +1,8 @@
 """Kubernetes object builders.
 
 The reference assembles manifests by hand in jsonnet (e.g.
-kubeflow/core/tf-job-operator.libsonnet:61-125, kubeflow/core/ambassador.libsonnet:1-60).
+kubeflow/core/tf-job-operator.libsonnet:61-125,
+kubeflow/core/ambassador.libsonnet:1-60).
 These helpers produce the same API objects as plain dicts with consistent
 labeling, so component packages read like the jsonnet did but with typed
 params and no string templating.

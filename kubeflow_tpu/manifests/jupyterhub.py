@@ -53,9 +53,12 @@ def spawner_config(authenticator: str, notebook_image: str,
         "    def options_from_form(self, formdata):",
         "        options = {}",
         "        options['image'] = formdata.get('image', [''])[0].strip()",
-        "        options['cpu_guarantee'] = formdata.get('cpu_guarantee', [''])[0].strip()",
-        "        options['mem_guarantee'] = formdata.get('mem_guarantee', [''])[0].strip()",
-        "        options['tpu_resources'] = formdata.get('tpu_resources', [''])[0].strip()",
+        "        options['cpu_guarantee'] = "
+        "formdata.get('cpu_guarantee', [''])[0].strip()",
+        "        options['mem_guarantee'] = "
+        "formdata.get('mem_guarantee', [''])[0].strip()",
+        "        options['tpu_resources'] = "
+        "formdata.get('tpu_resources', [''])[0].strip()",
         "        return options",
         "",
         "    @property",
@@ -77,7 +80,8 @@ def spawner_config(authenticator: str, notebook_image: str,
             "c.KubeSpawner.pvc_name_template = 'claim-{username}{servername}'",
             f"c.KubeSpawner.user_storage_capacity = '10Gi'",
             f"c.KubeSpawner.volumes = [{{'name': 'volume-{{username}}{{servername}}',"
-            f" 'persistentVolumeClaim': {{'claimName': 'claim-{{username}}{{servername}}'}}}}]",
+            f" 'persistentVolumeClaim': {{'claimName': "
+            f"'claim-{{username}}{{servername}}'}}}}]",
             f"c.KubeSpawner.volume_mounts = [{{'mountPath': '{notebook_pvc_mount}',"
             f" 'name': 'volume-{{username}}{{servername}}'}}]",
         ]
@@ -94,7 +98,8 @@ def spawner_config(authenticator: str, notebook_image: str,
         ]
     else:
         lines += [
-            "c.JupyterHub.authenticator_class = 'dummyauthenticator.DummyAuthenticator'",
+            "c.JupyterHub.authenticator_class = "
+            "'dummyauthenticator.DummyAuthenticator'",
         ]
     return "\n".join(lines) + "\n"
 

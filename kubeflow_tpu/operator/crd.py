@@ -67,7 +67,8 @@ class _SpecBase:
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]):
-        field_names = {f.name for f in dataclasses.fields(cls)}  # type: ignore[arg-type]
+        field_names = {  # type: ignore[arg-type]
+            f.name for f in dataclasses.fields(cls)}
         kwargs = {}
         for key, value in d.items():
             name = key if key in field_names else _snake(key)

@@ -16,8 +16,9 @@ from __future__ import annotations
 
 import dataclasses
 import threading
-import time
 from typing import Dict, List, Optional
+
+from kubeflow_tpu.testing import faults
 
 
 @dataclasses.dataclass
@@ -61,17 +62,17 @@ class GangScheduler:
             if job in self.claims:
                 return True
             entry = {"job": job, "slice_type": slice_type, "count": count,
-                     "queue": queue, "enqueued_at": time.monotonic()}
+                     "queue": queue, "enqueued_at": faults.monotonic()}
             if not any(e["job"] == job for e in self.queue):
                 self.queue.append(entry)
-            self._drain()
+            self._drain_locked()
             return job in self.claims
 
     def release(self, job: str) -> None:
         with self._lock:
             self.claims.pop(job, None)
             self.queue = [e for e in self.queue if e["job"] != job]
-            self._drain()
+            self._drain_locked()
 
     def admitted(self, job: str) -> bool:
         with self._lock:
@@ -95,8 +96,9 @@ class GangScheduler:
                     return i
             return None
 
-    def _drain(self) -> None:
-        """Admit queue heads while capacity allows (per-queue FIFO)."""
+    def _drain_locked(self) -> None:
+        """Admit queue heads while capacity allows (per-queue FIFO).
+        Caller holds ``self._lock`` (the ``_locked`` contract)."""
         blocked_queues = set()
         remaining = []
         for entry in self.queue:
@@ -111,7 +113,7 @@ class GangScheduler:
                 remaining.append(entry)
                 continue
             if self.free(entry["slice_type"]) >= entry["count"]:
-                now = time.monotonic()
+                now = faults.monotonic()
                 self.claims[entry["job"]] = SliceClaim(
                     job=entry["job"], slice_type=entry["slice_type"],
                     count=entry["count"], admitted_at=now,

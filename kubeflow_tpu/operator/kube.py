@@ -217,5 +217,8 @@ class FakeKube:
             self.events.append({
                 "namespace": namespace, "involvedObject": involved,
                 "reason": reason, "message": message, "type": type_,
+                # Wall-clock event timestamp leaving the process (the
+                # apiserver convention) — not a policy decision.
+                # kft: allow=clock-discipline
                 "ts": time.time(),
             })

@@ -433,7 +433,7 @@ class TPUJobController:
                                 message=f"queue position "
                                         f"{self.scheduler.position(key)}")
             return QUEUED
-        self._admitted_at.setdefault(key, time.monotonic())
+        self._admitted_at.setdefault(key, faults.monotonic())
 
         # 2. Materialize service + pods (idempotent).
         try:
@@ -481,8 +481,8 @@ class TPUJobController:
         if len(pods) == job.num_workers and all(
                 ph in (RUNNING, SUCCEEDED) for ph in phases):
             if phase != JOB_RUNNING:
-                latency = time.monotonic() - self._admitted_at.get(
-                    key, time.monotonic())
+                latency = faults.monotonic() - self._admitted_at.get(
+                    key, faults.monotonic())
                 self.metrics.append({
                     "event": "gang_running", "job": key,
                     "schedule_to_running_s": latency,
@@ -605,6 +605,9 @@ class TPUJobController:
             "phase": phase,
             "reason": reason,
             "message": message,
+            # Wall-clock CR status stamp read by kubectl/humans — not
+            # a policy decision.
+            # kft: allow=clock-discipline
             "lastTransition": time.time(),
             **(extra or {}),
         })

@@ -144,7 +144,9 @@ def parse_slice_type(name: str) -> SliceTopology:
         for topo in _TOPOLOGIES.values():
             if topo.generation == gen and topo.ici_mesh == mesh:
                 return topo
-        raise ValueError(f"unsupported topology {name!r} ({gen}, {mesh}, {chips} chips)")
+        raise ValueError(
+            f"unsupported topology {name!r} ({gen}, {mesh}, "
+            f"{chips} chips)")
     raise ValueError(
         f"unknown slice type {name!r}; known: {sorted(_TOPOLOGIES)}"
     )

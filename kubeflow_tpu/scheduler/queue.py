@@ -208,16 +208,20 @@ class ClusterScheduler:
     def note_admitted(self, key: str, backfilled: bool = False,
                       resumed: bool = False) -> None:
         wait = self.queue.note_admitted(key)
-        view = self._last_views.get(key)
-        tenant = view.tenant if view else "default"
         from kubeflow_tpu.runtime.prom import REGISTRY
 
+        # View lookup joins the counter update under the lock:
+        # plan() REBINDS _last_views under it, and the tenant label
+        # must come from the same snapshot the caller's plan produced
+        # (status() reads both under this lock too).
         with self._lock:
+            view = self._last_views.get(key)
             self._counters["admitted"] += 1
             if backfilled:
                 self._counters["backfilled"] += 1
             if resumed:
                 self._counters["resumed"] += 1
+        tenant = view.tenant if view else "default"
         REGISTRY.counter(
             "kft_scheduler_admitted_total",
             "jobs admitted through the policy layer").inc(tenant=tenant)
@@ -238,10 +242,10 @@ class ClusterScheduler:
                 buckets=_WAIT_BUCKETS).observe(wait)
 
     def note_preempted(self, key: str) -> None:
-        view = self._last_views.get(key)
-        tenant = view.tenant if view else "default"
         with self._lock:
+            view = self._last_views.get(key)
             self._counters["preempted"] += 1
+        tenant = view.tenant if view else "default"
         from kubeflow_tpu.runtime.prom import REGISTRY
 
         REGISTRY.counter(

@@ -540,7 +540,7 @@ class DecodeEngine:
             "deadline": deadline,
             "want_timing": bool(inputs.get("return_timing")),
             "event": threading.Event(), "out": None, "err": None,
-            "t": time.monotonic(), "t_first": None,
+            "t": faults.monotonic(), "t_first": None,
         }
         if self.speculative_tokens:
             hist = np.empty((length + new,), np.int32)
@@ -714,7 +714,8 @@ class DecodeEngine:
                 self._work.notify_all()
             else:
                 self._stopped = True
-                self._drain_deadline = time.monotonic() + max(0.0, drain_s)
+                self._drain_deadline = faults.monotonic() \
+                    + max(0.0, drain_s)
                 self._work.notify_all()
         self._thread.join(timeout=max(5.0, drain_s + 5.0))
         # The prefix index dies with the engine (reload invalidation:
@@ -949,7 +950,7 @@ class DecodeEngine:
              np.asarray(entry["emitted"], np.int32)[None]], axis=1)
         entry["out"] = {"tokens": out}
         if entry["want_timing"]:
-            now = time.monotonic()
+            now = faults.monotonic()
             entry["out"]["ttft_s"] = (
                 (entry["t_first"] or now) - entry["t"])
             entry["out"]["latency_s"] = now - entry["t"]
@@ -989,7 +990,7 @@ class DecodeEngine:
                     break
                 tok = int(tok)
                 if entry["t_first"] is None:
-                    entry["t_first"] = time.monotonic()
+                    entry["t_first"] = faults.monotonic()
                 entry["emitted"].append(tok)
                 if entry["hist"] is not None:
                     entry["hist"][entry["hist_len"]] = tok
@@ -1002,6 +1003,11 @@ class DecodeEngine:
                     # same step, so freeing it here (possibly sync_lag
                     # calls late on the EOS path) never races the cache.
                     if self._slot_req[entry["slot"]] is entry:
+                        # Slot table is loop-thread-owned: only _run/
+                        # _drain_one rebind entries; stats() reads a
+                        # GIL-atomic snapshot under the lock purely
+                        # for counter consistency.
+                        # kft: allow=lock-guard
                         self._slot_req[entry["slot"]] = None
                     self._finish(entry)
                     ttfts.append(entry["t_first"] - entry["t"])
@@ -1226,7 +1232,7 @@ class DecodeEngine:
                         return
                     stopping = self._stopped
                     past_drain = (stopping and self._drain_deadline
-                                  is not None and time.monotonic()
+                                  is not None and faults.monotonic()
                                   > self._drain_deadline)
                     expired = self._sweep_expired_locked()
                     admissions = []
@@ -1375,6 +1381,8 @@ class DecodeEngine:
                         r["scheduled"] = min(r["new"],
                                              r["scheduled"] + k)
                         if not self._eos and r["scheduled"] >= r["new"]:
+                            # Loop-thread-owned (see _drain_one).
+                            # kft: allow=lock-guard
                             self._slot_req[i] = None
                     while len(self._pending) > self.sync_lag:
                         self._drain_one()
@@ -1431,6 +1439,9 @@ class DecodeEngine:
             if entry is not None and not entry["event"].is_set():
                 entry["err"] = err
                 entry["event"].set()
+            # Loop thread is dead or dying here; no concurrent writer
+            # exists (see _drain_one).
+            # kft: allow=lock-guard
             self._slot_req[i] = None
         for _, snapshot, _ in self._pending:
             for _, entry in snapshot:

@@ -351,13 +351,13 @@ def wait_for_drain(server: ModelServer, deadline_s: float,
     ``settle_s`` (new stragglers may still arrive while load balancers
     catch up with the readiness flip) or ``deadline_s`` passes.
     Returns True when the server quiesced inside the budget."""
-    deadline = time.monotonic() + max(0.0, deadline_s)
+    deadline = faults.monotonic() + max(0.0, deadline_s)
     quiet_since = None
-    while time.monotonic() < deadline:
+    while faults.monotonic() < deadline:
         if server.inflight() == 0:
             if quiet_since is None:
-                quiet_since = time.monotonic()
-            elif time.monotonic() - quiet_since >= settle_s:
+                quiet_since = faults.monotonic()
+            elif faults.monotonic() - quiet_since >= settle_s:
                 return True
         else:
             quiet_since = None

@@ -701,7 +701,7 @@ class MicroBatcher:
         whose deadline passes pre-dispatch is failed by the runner
         sweep instead of being dispatched."""
         entry = {"inputs": inputs,
-                 "t": time.monotonic(), "deadline": deadline,
+                 "t": faults.monotonic(), "deadline": deadline,
                  "event": threading.Event(), "out": None, "err": None}
         if deadline is not None and faults.monotonic() >= deadline:
             with self._lock:
@@ -826,8 +826,11 @@ class MicroBatcher:
         request deadlines join the wakeup computation so an expiring
         entry is failed promptly even when no batch deadline is near.
         """
-        now = time.monotonic()
-        pnow = faults.monotonic()  # policy clock (skewable) — deadlines
+        # ONE skewable policy clock for both request deadlines and
+        # batch-window aging: a seeded skew must age queued entries
+        # exactly like it expires deadlines, or the two sweeps drift.
+        now = faults.monotonic()
+        pnow = now
         best_sig, best_t = None, None
         self._next_deadline = None
 
@@ -845,9 +848,7 @@ class MicroBatcher:
                     continue
                 keep.append(e)
                 if d is not None:
-                    # Policy-clock remaining converted onto the real
-                    # clock the flusher waits against.
-                    note_wake(now + (d - pnow))
+                    note_wake(d)
             if len(keep) != len(q):
                 self._pending_total -= len(q) - len(keep)
                 if not keep:
@@ -892,7 +893,7 @@ class MicroBatcher:
                         self._flusher.wait(
                             timeout=None if self._next_deadline is None
                             else max(0.0, self._next_deadline
-                                     - time.monotonic()))
+                                     - faults.monotonic()))
                 if expired:
                     self._expired += len(expired)
                 if batch is not None:
@@ -904,7 +905,7 @@ class MicroBatcher:
                     self._size_hist.observe(
                         float(len(batch)), batcher=self._metric_name)
                     self._cycle["queue_wait"] += (
-                        time.monotonic() - batch[0]["t"])
+                        faults.monotonic() - batch[0]["t"])
                     self._in_process += 1
                     self._max_in_process = max(self._max_in_process,
                                                self._in_process)

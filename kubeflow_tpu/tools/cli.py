@@ -2,7 +2,8 @@
 
 Heir of the reference's ks workflow (README.md:93-134, user_guide.md:366-410):
 
-  ks generate <proto> <name> --param=v   ->  kubeflow-tpu generate <proto> <name> --param v
+  ks generate <proto> <name> --param=v -> kubeflow-tpu generate
+                                          <proto> <name> --param v
   ks param set <comp> <k> <v>            ->  kubeflow-tpu param set <comp> <k> <v>
   ks show default                        ->  kubeflow-tpu show
   ks apply default                       ->  kubeflow-tpu apply [--dry-run]

@@ -27,6 +27,36 @@ import pytest  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 
 
+# Lock-order sanitizer (KFT_LOCKCHECK=1): the serving/fleet suites
+# construct the heavily-threaded objects (engine, batchers, registry,
+# router), so they run with threading.Lock instrumented.  The
+# sanitizer installs ONCE and the acquisition graph accumulates
+# across tests — an inconsistent nesting order between two different
+# tests still closes a cycle, and the test that closed it fails with
+# both paths spelled out.  Off by default: instrumentation taxes
+# every acquire, and the tier-1 budget is tight.
+_LOCKCHECK_MODULES = {"test_serving", "test_fleet"}
+
+
+@pytest.fixture(autouse=True)
+def _lockcheck(request):
+    from kubeflow_tpu.testing import lockcheck
+
+    module = getattr(request, "module", None)
+    name = getattr(module, "__name__", "").rsplit(".", 1)[-1]
+    if not lockcheck.enabled_in_env() \
+            or name not in _LOCKCHECK_MODULES:
+        yield
+        return
+    sanitizer = lockcheck.install()  # idempotent; graph persists
+    before = len(sanitizer.violations())
+    yield
+    new = sanitizer.violations()[before:]
+    assert not new, (
+        "lock-order inversions recorded (KFT_LOCKCHECK):\n"
+        + "\n".join(repr(v) for v in new))
+
+
 @pytest.fixture(scope="session")
 def devices():
     devs = jax.devices()

@@ -1,0 +1,472 @@
+"""kft-analyze: per-checker fixtures, suppressions, baseline workflow,
+CLI, and the KFT_LOCKCHECK runtime lock-order sanitizer.
+
+Each checker gets (at least) a positive fire, a negative control, and
+a suppression-honored case; the baseline tests prove shrink-only
+enforcement end to end through the real CLI."""
+
+import json
+import pathlib
+import subprocess
+import sys
+import textwrap
+import threading
+
+from kubeflow_tpu.analysis import analyze_source, core
+from kubeflow_tpu.analysis.clock import ClockDiscipline
+from kubeflow_tpu.analysis.jitpurity import JitPurity
+from kubeflow_tpu.analysis.locks import LockGuard
+from kubeflow_tpu.analysis.metrics import MetricHygiene
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+POLICY = "kubeflow_tpu/serving/mod.py"
+
+
+def _src(s: str) -> str:
+    return '"""mod."""\n' + textwrap.dedent(s)
+
+
+class TestClockDiscipline:
+    def test_fires_on_policy_module(self):
+        found = analyze_source(_src("""
+            import time
+
+
+            def drain():
+                return time.monotonic() + 5
+        """), rel=POLICY)
+        assert [f.check for f in found] == ["clock-discipline"]
+        assert "faults.monotonic" in found[0].message
+        assert found[0].symbol == "time.monotonic@drain"
+
+    def test_time_time_also_banned(self):
+        found = analyze_source(_src("""
+            import time
+
+            STAMP = time.time()
+        """), rel=POLICY)
+        assert [f.symbol for f in found] == ["time.time@<module>"]
+
+    def test_perf_counter_and_sleep_stay_legal(self):
+        found = analyze_source(_src("""
+            import time
+
+
+            def measure():
+                t0 = time.perf_counter()
+                time.sleep(0.01)
+                return time.perf_counter() - t0
+        """), rel=POLICY)
+        assert found == []
+
+    def test_non_policy_module_exempt(self):
+        found = analyze_source(_src("""
+            import time
+
+
+            def wait():
+                return time.monotonic()
+        """), rel="kubeflow_tpu/runtime/mod.py")
+        assert found == []
+
+    def test_same_line_suppression(self):
+        found = analyze_source(_src("""
+            import time
+
+            T = time.time()  # kft: allow=clock-discipline
+        """), rel=POLICY)
+        assert found == []
+
+    def test_preceding_comment_suppression(self):
+        found = analyze_source(_src("""
+            import time
+
+            # wall-clock stamp leaving the process
+            # kft: allow=clock-discipline
+            T = time.time()
+        """), rel=POLICY)
+        assert found == []
+
+
+LOCK_CLASS = """
+    import threading
+
+
+    class C:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.x = 0
+
+        def bump(self):
+            with self._lock:
+                self.x += 1
+"""
+
+
+class TestLockGuard:
+    def test_bare_write_of_guarded_attr_fires(self):
+        found = analyze_source(_src(LOCK_CLASS + """
+        def reset(self):
+            self.x = 0
+    """), rel=POLICY)
+        assert [f.check for f in found] == ["lock-guard"]
+        assert "C.x" in found[0].message
+        assert found[0].symbol == "C.x@reset"
+
+    def test_locked_suffix_method_is_lock_context(self):
+        found = analyze_source(_src(LOCK_CLASS + """
+        def _reset_locked(self):
+            self.x = 0
+    """), rel=POLICY)
+        assert found == []
+
+    def test_init_writes_never_count(self):
+        found = analyze_source(_src(LOCK_CLASS), rel=POLICY)
+        assert found == []
+
+    def test_unguarded_attr_writes_fine(self):
+        found = analyze_source(_src(LOCK_CLASS + """
+        def other(self):
+            self.y = 1
+    """), rel=POLICY)
+        assert found == []
+
+    def test_nested_helper_inherits_lock_state(self):
+        found = analyze_source(_src("""
+            import threading
+
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.x = 0
+
+                def bump(self):
+                    with self._lock:
+                        def helper():
+                            self.x = 2
+                        helper()
+                        self.x += 1
+        """), rel=POLICY)
+        assert found == []
+
+    def test_suppression_honored(self):
+        found = analyze_source(_src(LOCK_CLASS + """
+        def reset(self):
+            # single-threaded by construction here
+            # kft: allow=lock-guard
+            self.x = 0
+    """), rel=POLICY)
+        assert found == []
+
+
+class TestJitPurity:
+    def test_partial_decorated_function_fires(self):
+        found = analyze_source(_src("""
+            from functools import partial
+
+            import jax
+            import time
+
+
+            @partial(jax.jit, static_argnums=(0,))
+            def step(n, x):
+                return x + time.time()
+        """), rel="kubeflow_tpu/models/mod.py")
+        assert [f.check for f in found] == ["jit-purity"]
+        assert "time.time" in found[0].message
+        assert found[0].symbol == "time.time@step"
+
+    def test_call_form_resolves_module_function(self):
+        found = analyze_source(_src("""
+            import jax
+            import random
+
+
+            def f(x):
+                return x * random.random()
+
+
+            g = jax.jit(f)
+        """), rel="kubeflow_tpu/models/mod.py")
+        assert [f.symbol for f in found] == ["random.random@f"]
+
+    def test_jax_random_and_plain_functions_legal(self):
+        found = analyze_source(_src("""
+            import jax
+            import time
+
+
+            @jax.jit
+            def step(x, key):
+                return x + jax.random.normal(key)
+
+
+            def host_loop():
+                return time.perf_counter()
+        """), rel="kubeflow_tpu/models/mod.py")
+        assert found == []
+
+    def test_suppression_honored(self):
+        found = analyze_source(_src("""
+            import jax
+            import os
+
+
+            @jax.jit
+            def step(x):
+                # kft: allow=jit-purity
+                flag = os.environ.get("DEBUG")
+                return x
+        """), rel="kubeflow_tpu/models/mod.py")
+        assert found == []
+
+
+class TestMetricHygiene:
+    def test_name_must_be_kft_prefixed(self):
+        found = analyze_source(_src("""
+            REGISTRY.counter("requests_total", "h").inc()
+        """))
+        assert [f.symbol for f in found] == ["name:requests_total"]
+
+    def test_counter_must_end_total(self):
+        found = analyze_source(_src("""
+            REGISTRY.counter("kft_requests", "h").inc()
+        """))
+        assert [f.symbol for f in found] == [
+            "counter-suffix:kft_requests"]
+
+    def test_gauge_must_not_end_total(self):
+        found = analyze_source(_src("""
+            REGISTRY.gauge("kft_jobs_total", "h").set(1)
+        """))
+        assert [f.symbol for f in found] == [
+            "gauge-suffix:kft_jobs_total"]
+
+    def test_label_mismatch_across_modules(self):
+        checker = MetricHygiene()
+        import ast
+
+        a = _src("""
+            C = REGISTRY.counter("kft_req_total", "h")
+            C.inc(model="m")
+        """)
+        b = _src("""
+            REGISTRY.counter("kft_req_total", "h").inc(endpoint="e")
+        """)
+        checker.visit_module("kubeflow_tpu/a.py", ast.parse(a), a)
+        checker.visit_module("kubeflow_tpu/b.py", ast.parse(b), b)
+        found = checker.finish()
+        assert len(found) == 1
+        assert found[0].symbol.startswith("labels:kft_req_total:")
+        assert "one name, one label set" in found[0].message
+
+    def test_aggregate_plus_labeled_is_sanctioned(self):
+        found = analyze_source(_src("""
+            G = REGISTRY.gauge("kft_inflight", "h")
+            G.set(3.0)
+            G.set(1.0, model="m")
+        """))
+        assert found == []
+
+    def test_constant_name_resolved(self):
+        found = analyze_source(_src("""
+            BAD = "kft_shed"
+
+            REGISTRY.counter(BAD, "h").inc(model="m")
+        """))
+        assert [f.symbol for f in found] == ["counter-suffix:kft_shed"]
+
+    def test_suppression_honored(self):
+        found = analyze_source(_src("""
+            # legacy wire name, kept for dashboard compat
+            # kft: allow=metric-hygiene
+            REGISTRY.counter("requests_total", "h").inc()
+        """))
+        assert found == []
+
+    def test_self_attr_binding_tracked(self):
+        found = analyze_source(_src("""
+            class S:
+                def __init__(self):
+                    self._ctr = REGISTRY.counter("kft_a_total", "h")
+
+                def hit(self):
+                    self._ctr.inc(model="m")
+
+                def miss(self):
+                    self._ctr.inc(reason="r")
+        """))
+        assert len(found) == 1
+        assert found[0].symbol.startswith("labels:kft_a_total:")
+
+
+class TestBaselineAndRunner:
+    def _finding(self, symbol="time.time@f"):
+        return core.Finding(check="clock-discipline", path=POLICY,
+                            line=3, col=0, message="m", symbol=symbol)
+
+    def test_split_by_baseline(self):
+        f_new = self._finding("new@f")
+        f_old = self._finding("old@f")
+        baseline = [f_old.fingerprint(), "clock-discipline::gone::x@y"]
+        new, old, stale = core.split_by_baseline([f_new, f_old],
+                                                 baseline)
+        assert new == [f_new]
+        assert old == [f_old]
+        assert stale == ["clock-discipline::gone::x@y"]
+
+    def test_dedupe_symbols_disambiguates(self):
+        a, b = self._finding(), self._finding()
+        out = core.dedupe_symbols([a, b])
+        assert out[0].symbol == "time.time@f"
+        assert out[1].symbol == "time.time@f#2"
+
+    def test_repo_runs_clean_in_process(self):
+        baseline = core.load_baseline(REPO / "ci"
+                                      / "analysis_baseline.json")
+        report = core.run(REPO, baseline=baseline)
+        assert report.ok, [f.render() for f in report.findings] \
+            + report.stale
+
+
+def _scratch_repo(tmp_path, body):
+    pkg = tmp_path / "kubeflow_tpu" / "serving"
+    pkg.mkdir(parents=True)
+    (tmp_path / "ci").mkdir()
+    (pkg / "mod.py").write_text('"""mod."""\nimport time\n' + body)
+    return tmp_path
+
+
+def _analyze(root, *args):
+    return subprocess.run(
+        [sys.executable, "-m", "kubeflow_tpu.analysis",
+         "--root", str(root), *args],
+        capture_output=True, text=True, cwd=str(REPO))
+
+
+class TestCLI:
+    def test_finding_fails_run_and_renders_json(self, tmp_path):
+        root = _scratch_repo(tmp_path,
+                             "D = time.monotonic() + 1\n")
+        proc = _analyze(root)
+        assert proc.returncode == 1
+        assert "clock-discipline" in proc.stdout
+        proc = _analyze(root, "--json")
+        assert proc.returncode == 1
+        payload = json.loads(proc.stdout)
+        assert payload["findings"][0]["check"] == "clock-discipline"
+        assert payload["findings"][0]["path"].endswith("mod.py")
+
+    def test_baseline_tolerates_then_shrink_only(self, tmp_path):
+        root = _scratch_repo(tmp_path,
+                             "D = time.monotonic() + 1\n")
+        # Grandfather the finding into the baseline: run goes green.
+        assert _analyze(root, "--write-baseline").returncode == 0
+        proc = _analyze(root)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "1 baselined" in proc.stderr
+        # Adding a NEW finding still fails — the baseline can't grow.
+        mod = root / "kubeflow_tpu" / "serving" / "mod.py"
+        mod.write_text(mod.read_text()
+                       + "E = time.monotonic() + 2\n")
+        assert _analyze(root).returncode == 1
+        # Fixing the original finding makes its entry STALE: the run
+        # fails until the entry is deleted (shrink-only enforcement).
+        mod.write_text('"""mod."""\nimport time\n')
+        proc = _analyze(root)
+        assert proc.returncode == 1
+        assert "stale baseline entry" in proc.stdout
+        assert _analyze(root, "--write-baseline").returncode == 0
+        assert _analyze(root).returncode == 0
+
+
+# The runtime half of the lock story: the static lock-guard checker
+# proves writes hold the lock; the sanitizer proves locks NEST in one
+# global order (tests/conftest.py enables it for the serving/fleet
+# suites under KFT_LOCKCHECK=1).
+class TestLockOrderSanitizer:
+    def test_inversion_closes_cycle(self):
+        from kubeflow_tpu.testing import lockcheck
+
+        sanitizer = lockcheck.install()
+        try:
+            sanitizer.reset()
+            a = threading.Lock()
+            b = threading.Lock()
+            with a:
+                with b:
+                    pass
+            with b:
+                with a:
+                    pass
+            violations = sanitizer.violations()
+            assert len(violations) == 1
+            assert "closes the cycle" in repr(violations[0])
+        finally:
+            lockcheck.uninstall()
+
+    def test_consistent_order_is_clean(self):
+        from kubeflow_tpu.testing import lockcheck
+
+        sanitizer = lockcheck.install()
+        try:
+            sanitizer.reset()
+            a = threading.Lock()
+            b = threading.Lock()
+            for _ in range(3):
+                with a:
+                    with b:
+                        pass
+            assert sanitizer.violations() == []
+        finally:
+            lockcheck.uninstall()
+
+    def test_same_site_pairs_ignored(self):
+        from kubeflow_tpu.testing import lockcheck
+
+        sanitizer = lockcheck.install()
+        try:
+            sanitizer.reset()
+            locks = [threading.Lock() for _ in range(2)]
+            with locks[0]:
+                with locks[1]:
+                    pass
+            with locks[1]:
+                with locks[0]:
+                    pass
+            assert sanitizer.violations() == []
+        finally:
+            lockcheck.uninstall()
+
+    def test_detects_cross_thread_inversion(self):
+        from kubeflow_tpu.testing import lockcheck
+
+        sanitizer = lockcheck.install()
+        try:
+            sanitizer.reset()
+            a = threading.Lock()
+            b = threading.Lock()
+
+            def forward():
+                with a:
+                    with b:
+                        pass
+
+            t = threading.Thread(target=forward)
+            t.start()
+            t.join()
+            with b:
+                with a:
+                    pass
+            assert len(sanitizer.violations()) == 1
+        finally:
+            lockcheck.uninstall()
+
+    def test_env_gate(self, monkeypatch):
+        from kubeflow_tpu.testing import lockcheck
+
+        assert not lockcheck.enabled_in_env({})
+        assert not lockcheck.enabled_in_env({"KFT_LOCKCHECK": "0"})
+        assert lockcheck.enabled_in_env({"KFT_LOCKCHECK": "1"})

@@ -895,3 +895,49 @@ class TestGRPCStatusMapping:
         for method in ("predict", "classify", "metadata"):
             sig = inspect.signature(getattr(PredictionClient, method))
             assert sig.parameters["timeout"].default is None, method
+
+
+class TestEngineDrainDeadlineSkew:
+    def test_drain_deadline_expires_under_skewed_policy_clock(
+            self, engine_model):
+        """PR-8 satellite: the engine's close() drain deadline rides
+        the POLICY clock (faults.monotonic), so a seeded skew expires
+        it without waiting out the drain budget.  Each step adds 500 s
+        of skew: the step AFTER close() arms the deadline pushes the
+        clock past it, the loop aborts the in-flight request, and
+        close() returns in wall-milliseconds despite drain_s=60.  On
+        the real clock (the pre-migration bug) the request would
+        simply complete inside the budget and no abort would fire."""
+        from kubeflow_tpu.serving.engine import DecodeEngine
+
+        spec, _ = engine_model
+        rng = np.random.RandomState(SEED)
+        prompt = rng.randint(1, VOCAB, size=(6,)).tolist()
+        with faults.injected(
+                "seed=1;engine.step:sleep=0.05;engine.step:skew=500"):
+            engine = DecodeEngine(spec["cfg"], spec["params"],
+                                  spec["decode"], slots=1,
+                                  prefill_len=16, name="ft-drain-skew")
+            outs: dict = {}
+
+            def client():
+                try:
+                    outs["r"] = engine.submit(
+                        {"tokens": np.asarray(prompt, np.int32)})
+                except Exception as exc:  # noqa: BLE001 — the point
+                    outs["r"] = exc
+            t = threading.Thread(target=client)
+            t.start()
+            deadline = time.monotonic() + 30
+            while not engine.stats()["in_flight_requests"]:
+                assert time.monotonic() < deadline, "never admitted"
+                time.sleep(0.01)
+            t0 = time.monotonic()
+            engine.close(drain_s=60.0)
+            wall = time.monotonic() - t0
+            t.join(timeout=30)
+            assert isinstance(outs.get("r"), RuntimeError), outs
+            assert "drain deadline" in str(outs["r"])
+            # Skew, not wall time, expired the drain: 60 s of budget
+            # consumed in well under 30 s of real time.
+            assert wall < 30.0, wall

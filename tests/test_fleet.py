@@ -644,3 +644,56 @@ class TestAutoscaler:
             out = scaler.reconcile_once()  # same load, same answer
         assert not out["applied"]
         assert kube.get_deployment("kf", "srv")["spec"]["replicas"] == 5
+
+
+class TestSnapshotLockDiscipline:
+    """PR-8 lock-guard audit regressions: every field a status/stats
+    snapshot reads must be read under the same lock the writer holds
+    (the analyzer catches bare WRITES; these pin the read side)."""
+
+    def _state(self):
+        from kubeflow_tpu.fleet.endpoints import (
+            EndpointState,
+            _EjectBreaker,
+        )
+
+        reg = EndpointRegistry(StaticEndpoints([]))
+        state = EndpointState(Endpoint(name="r0", url=""), 3,
+                              _EjectBreaker())
+        state.ready = True
+        reg._states["r0"] = state
+        return reg, state
+
+    def test_total_load_never_reads_torn_scrape_pairs(self):
+        """A scrape writes (inflight, queue_depth) atomically under
+        the state lock with a constant sum; total_load() must never
+        observe a mixture of two scrapes.  Pre-fix (bare reads) this
+        flaked; the locked read makes it deterministic."""
+        reg, state = self._state()
+        stop = threading.Event()
+
+        def scraper():
+            flip = 0.0
+            while not stop.is_set():
+                flip = 100.0 - flip
+                with state._lock:
+                    state.inflight = flip
+                    state.queue_depth = 100.0 - flip
+
+        t = threading.Thread(target=scraper, daemon=True)
+        t.start()
+        try:
+            for _ in range(3000):
+                assert reg.total_load() == 100.0
+        finally:
+            stop.set()
+            t.join(timeout=5)
+
+    def test_describe_reads_breaker_failures_via_locked_accessor(self):
+        """describe() must go through _EjectBreaker.failure_count()
+        (locked), not the bare attribute — the breaker mutates
+        failures under its own lock on every probe verdict."""
+        reg, state = self._state()
+        state.breaker.failure_count = lambda: 777
+        rows = reg.describe()
+        assert rows[0]["breaker_failures"] == 777

@@ -19,6 +19,34 @@ class TestLintGate:
         assert proc.returncode == 0, (
             f"lint problems:\n{proc.stdout}\n{proc.stderr}")
 
+    def test_deep_pass_runs_clean_on_repo(self):
+        """PR-8: the semantic analyzer (clock/lock/jit/metric
+        invariants) stays green with an empty shrink-only baseline."""
+        proc = subprocess.run(
+            [sys.executable, str(REPO / "ci" / "lint.py"), "--root",
+             str(REPO), "--deep"],
+            capture_output=True, text=True,
+        )
+        assert proc.returncode == 0, (
+            f"deep lint problems:\n{proc.stdout}\n{proc.stderr}")
+        assert "analysis:" in proc.stderr
+
+    def test_deep_gate_catches_semantic_violations(self, tmp_path):
+        """--deep must actually fire: a policy module reading the
+        wall clock fails the combined gate even when classic lint
+        passes."""
+        bad = tmp_path / "kubeflow_tpu" / "serving"
+        bad.mkdir(parents=True)
+        (bad / "mod.py").write_text(
+            '"""mod."""\nimport time\n\nD = time.monotonic() + 1\n')
+        proc = subprocess.run(
+            [sys.executable, str(REPO / "ci" / "lint.py"), "--root",
+             str(tmp_path), "--deep"],
+            capture_output=True, text=True,
+        )
+        assert proc.returncode == 1
+        assert "clock-discipline" in proc.stdout
+
     def test_gate_catches_violations(self, tmp_path):
         """The gate must actually fire — a sabotaged tree fails."""
         bad = tmp_path / "kubeflow_tpu"

@@ -617,3 +617,29 @@ class TestPlanAndStatus:
         assert config.preemption.grace_period_s == 12.5
         with pytest.raises(ValueError, match="unknown scheduler"):
             SchedulerConfig.from_dict({"nope": 1})
+
+
+class TestSchedulerSnapshotLockDiscipline:
+    def test_note_calls_read_last_views_under_lock(self, cluster):
+        """PR-8 lock-guard audit regression: plan() REBINDS
+        _last_views under sched._lock; note_admitted/note_preempted
+        must take the lock for their view lookup or the tenant label
+        can come from a half-superseded snapshot."""
+        kube, gang, sched, ctl = cluster
+
+        class GuardedDict(dict):
+            def __init__(self, lock):
+                super().__init__()
+                self.lock = lock
+                self.bare_reads = []
+
+            def get(self, key, default=None):
+                if not self.lock.locked():
+                    self.bare_reads.append(key)
+                return super().get(key, default)
+
+        guarded = GuardedDict(sched._lock)
+        sched._last_views = guarded
+        sched.note_admitted("default/j0")
+        sched.note_preempted("default/j0")
+        assert guarded.bare_reads == []
