@@ -1152,6 +1152,88 @@ def _bench_shared_prefix(spec, rng, cfg, on_tpu, DecodeEngine):
     }
 
 
+def _bench_tracing_overhead(spec, rng, cfg, on_tpu, DecodeEngine):
+    """Tracing overhead probe: the same concurrent decode window with
+    the tracer DISABLED (the library default — what the headline
+    engine windows above already run under) and ENABLED with every
+    request traced (worst case: sample_rate 1.0, so no span record is
+    skipped).  Windows interleave off/on so a one-sided stall cannot
+    fake a regression; the capability estimate per side is its best
+    window.  The acceptance claim is the DISABLED side: tracing off
+    must add no measurable per-step overhead (the engine's only
+    disabled-path cost is one None check per drain site), so the
+    headline tok/s stays within noise of the pre-tracing baseline.
+    """
+    import threading
+
+    import numpy as np
+
+    from kubeflow_tpu.runtime import tracing
+
+    n_requests, new, prompt_len, windows = (
+        (16, 32, 16, 2) if on_tpu else (12, 12, 8, 2))
+    prompts = [rng.randint(1, cfg.vocab_size,
+                           size=(1, prompt_len)).astype(np.int32)
+               for _ in range(n_requests)]
+
+    def run_window(engine, traced):
+        def client(prompt):
+            if traced:
+                span = tracing.start_span("bench.request")
+                with tracing.use_span(span):
+                    engine.submit({"tokens": prompt,
+                                   "max_new_tokens": new})
+                span.end()
+            else:
+                engine.submit({"tokens": prompt,
+                               "max_new_tokens": new})
+
+        threads = [threading.Thread(target=client, args=(p,))
+                   for p in prompts]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return n_requests * new / (time.perf_counter() - t0)
+
+    def make_engine(label):
+        engine = DecodeEngine(
+            spec["cfg"], spec["params"], spec["decode"], slots=4,
+            prefill_len=max(32, prompt_len),
+            name=f"bench-trace-{label}")
+        engine.submit({"tokens": prompts[0], "max_new_tokens": 2})
+        return engine
+
+    off_engine = make_engine("off")
+    on_engine = make_engine("on")
+    off_rates, on_rates = [], []
+    try:
+        for _ in range(windows):
+            tracing.disable()
+            off_rates.append(run_window(off_engine, traced=False))
+            tracing.enable(sample_rate=1.0, capacity=64)
+            try:
+                on_rates.append(run_window(on_engine, traced=True))
+            finally:
+                tracing.disable()
+    finally:
+        off_engine.close()
+        on_engine.close()
+    off_tok_s, on_tok_s = max(off_rates), max(on_rates)
+    ratio = on_tok_s / off_tok_s if off_tok_s else 0.0
+    print(f"tracing overhead: {off_tok_s:.1f} tok/s off vs "
+          f"{on_tok_s:.1f} on (every request traced), on/off "
+          f"{ratio:.3f}", file=sys.stderr)
+    return {
+        "tokens_per_sec_tracing_off": round(off_tok_s, 1),
+        "tokens_per_sec_tracing_on": round(on_tok_s, 1),
+        "on_vs_off": round(ratio, 3),
+        "requests": n_requests,
+        "sample_rate_on": 1.0,
+    }
+
+
 def _bench_speculative(spec, rng, cfg, on_tpu, DecodeEngine):
     """Speculative-decoding probe: n-gram drafting + batched verify
     (engine ``speculative_tokens``), spec ON vs OFF on otherwise
@@ -1534,6 +1616,14 @@ def bench_lm_engine(args, devices, n_chips, on_tpu):
         speculative = _bench_speculative(
             spec, rng, cfg, on_tpu, DecodeEngine)
 
+        # --- tracing overhead probe: the distributed-tracing spine
+        # (runtime/tracing.py) disabled vs enabled-and-traced on the
+        # same workload.  Disabled must be free (the headline windows
+        # above ran disabled); enabled costs only drain-time span
+        # stamping.
+        tracing_overhead = _bench_tracing_overhead(
+            spec, rng, cfg, on_tpu, DecodeEngine)
+
     eng_rates = [w["rate"] for w in engine_windows]
     bat_rates = [w["rate"] for w in batcher_windows]
     eng_tok_s, bat_tok_s = max(eng_rates), max(bat_rates)
@@ -1584,6 +1674,7 @@ def bench_lm_engine(args, devices, n_chips, on_tpu):
             "cached_token_ratio": engine_stats["cached_token_ratio"],
             "shared_prefix": shared_prefix,
             "speculative": speculative,
+            "tracing_overhead": tracing_overhead,
             "mean_slot_occupancy": engine_stats["mean_occupancy"],
             "slots": slots,
             "steps_per_call": spc,

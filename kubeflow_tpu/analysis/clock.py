@@ -8,11 +8,14 @@ is invisible to every seeded clock-skew scenario, which is exactly how
 the pre-PR-8 drain/aging sites escaped coverage.
 
 Rule: inside the policy packages (serving, fleet, scheduler,
-operator), direct calls to ``time.monotonic()`` or ``time.time()``
+operator) and the tracing runtime (``runtime/tracing.py`` — its
+tail-sampling threshold aging and open-trace expiry are policy
+decisions), direct calls to ``time.monotonic()`` or ``time.time()``
 are findings.  ``time.perf_counter()`` stays legal — measuring a
-DURATION (step latency, scrape cost) is instrumentation, not policy,
-and must not bend under an injected skew.  Wall-clock timestamps that
-leave the process (CR status stamps, event logs) suppress with
+DURATION (step latency, span duration, scrape cost) is
+instrumentation, not policy, and must not bend under an injected
+skew.  Wall-clock timestamps that leave the process (CR status
+stamps, event logs, the trace store's wall anchor) suppress with
 ``# kft: allow=clock-discipline`` and say why.
 """
 
@@ -27,7 +30,14 @@ from kubeflow_tpu.analysis.core import Finding
 CHECK = "clock-discipline"
 
 POLICY_PREFIXES = ("kubeflow_tpu/serving/", "kubeflow_tpu/fleet/",
-                   "kubeflow_tpu/scheduler/", "kubeflow_tpu/operator/")
+                   "kubeflow_tpu/scheduler/", "kubeflow_tpu/operator/",
+                   # The trace store makes policy decisions too (tail-
+                   # sampling threshold aging, open-trace expiry) —
+                   # they must bend under seeded clock skew like every
+                   # other deadline/backoff site.  Exact file, not a
+                   # stem prefix: a future tracing_*.py sibling is not
+                   # automatically a policy module.
+                   "kubeflow_tpu/runtime/tracing.py")
 
 _BANNED = {"monotonic", "time"}
 

@@ -89,12 +89,20 @@ def build_parser() -> argparse.ArgumentParser:
                     default=60.0)
     ap.add_argument("--autoscale_interval_s", type=float, default=2.0)
     ap.add_argument("--drain_deadline_s", type=float, default=30.0)
+    from kubeflow_tpu.runtime import tracing
+
+    tracing.add_cli_args(ap)
     return ap
 
 
 def main(argv=None) -> int:
+    from kubeflow_tpu.runtime import tracing
+
     args = build_parser().parse_args(argv)
     logging.basicConfig(level=logging.INFO, stream=sys.stderr)
+    if tracing.enable_from_args(args) is not None:
+        logging.info("request tracing on (sample rate %g) — "
+                     "GET /debug/traces", args.trace_sample_rate)
     if faults.install_from_env() is not None:
         logging.warning("fault injection ACTIVE (KFT_FAULTS set)")
 

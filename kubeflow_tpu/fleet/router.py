@@ -52,6 +52,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, Tuple
 
 from kubeflow_tpu.fleet.endpoints import EndpointRegistry, EndpointState
+from kubeflow_tpu.runtime import tracing
 from kubeflow_tpu.runtime.prom import REGISTRY
 from kubeflow_tpu.testing import faults
 
@@ -199,13 +200,29 @@ class FleetRouter:
         minus hop-by-hop headers) or a router-synthesized 429/502/503/
         504 when no replica could take the request."""
         t0 = time.perf_counter()
-        status, out_headers, out_body, outcome = self._route(
-            method, path, body, headers)
+        # Root (or continued) span of the distributed trace: each
+        # forward attempt becomes a child whose traceparent rides the
+        # proxied request, so the replica's server span joins THIS
+        # trace.  Tail sampling keeps every non-ok outcome.
+        span = tracing.start_span(
+            "router.request", parent=tracing.extract(headers),
+            attrs={"method": method, "path": path})
+        try:
+            status, out_headers, out_body, outcome = self._route(
+                method, path, body, headers, span)
+        except BaseException:
+            # A crashed route is exactly the trace tail sampling
+            # promises to keep: end the root as an error (completing
+            # the trace) before the handler's blanket 500 swallows it.
+            span.end(status="error")
+            raise
         self._requests.inc(outcome=outcome, code=str(status))
         self._latency.observe(time.perf_counter() - t0)
+        span.end(status=outcome, code=status)
         return status, out_headers, out_body
 
-    def _route(self, method, path, body, headers):
+    def _route(self, method, path, body, headers,
+               span=tracing.NULL_SPAN):
         self.budget.deposit()
         deadline, body = self._extract_deadline(method, path, body)
         tried: List[str] = []
@@ -221,11 +238,29 @@ class FleetRouter:
             if state is None:
                 break
             tried.append(state.name)
+            fwd_span = tracing.start_span(
+                "router.forward", parent=span,
+                attrs={"replica": state.name})
+            fwd_headers = headers
+            if fwd_span:
+                # The forward span's id becomes the replica's remote
+                # parent — per ATTEMPT (replacing any client-supplied
+                # header, whatever its case), so a retry's replica
+                # spans hang under the attempt that carried them.
+                fwd_headers = {
+                    k: v for k, v in headers.items()
+                    if k.lower() != tracing.TRACEPARENT}
+                fwd_headers[tracing.TRACEPARENT] = \
+                    fwd_span.traceparent()
             verdict = self._forward_once(state, method, path, body,
-                                         headers, deadline)
+                                         fwd_headers, deadline)
             kind = verdict[0]
             if kind == "response":
                 _, status, resp_headers, resp_body = verdict
+                fwd_span.end(
+                    status="shed" if status == 429 else
+                    "upstream_error" if status >= 500 else "ok",
+                    code=status)
                 if status == 429:
                     hint = _parse_retry_after(resp_headers)
                     if hint is not None:
@@ -239,6 +274,7 @@ class FleetRouter:
             # kind == "connect" (nothing sent) or "transport" (bytes
             # were sent; only idempotent work may be replayed).
             last_error = verdict[1]
+            fwd_span.end(status=kind, error=last_error)
             if kind == "connect" or (kind == "transport" and idempotent):
                 if self._grant_retry(kind):
                     continue
@@ -444,7 +480,7 @@ class _Handler(BaseHTTPRequestHandler):
     def _dispatch(self, method: str) -> None:
         router = self.router
         if self.path in ("/healthz", "/readyz", "/metrics",
-                         "/fleet/endpoints"):
+                         "/fleet/endpoints", "/debug/traces"):
             self._drain_body()
         if self.path == "/healthz":
             self._respond(200, {}, json.dumps(
@@ -469,6 +505,14 @@ class _Handler(BaseHTTPRequestHandler):
         if self.path == "/fleet/endpoints":
             self._respond(200, {}, json.dumps(
                 router.registry.describe()).encode())
+            return
+        if self.path == "/debug/traces":
+            # Tail-sampled request traces (router root + forward
+            # spans; replica spans too when the store is shared, as in
+            # the hermetic e2e).  Served on the router port so one
+            # scrape target covers health, metrics, and traces.
+            self._respond(200, {}, json.dumps(
+                tracing.snapshot()).encode())
             return
         length = int(self.headers.get("Content-Length", 0))
         body = self.rfile.read(length) if length else b""

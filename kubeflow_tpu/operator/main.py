@@ -49,9 +49,13 @@ def main(argv=None) -> int:
                     help="disable the multi-tenant policy layer and "
                          "admit jobs gang-FIFO (the pre-scheduler "
                          "behavior)")
+    from kubeflow_tpu.runtime import tracing
+
+    tracing.add_cli_args(ap, dashes=True)
     args = ap.parse_args(argv)
 
     logging.basicConfig(level=logging.INFO, stream=sys.stderr)
+    tracing.enable_from_args(args)
     from kubeflow_tpu.operator.gang import GangScheduler
     from kubeflow_tpu.operator.kube import FakeKube
     from kubeflow_tpu.operator.reconciler import TPUJobController
@@ -109,11 +113,12 @@ def main(argv=None) -> int:
     if args.metrics_port:
         from kubeflow_tpu.runtime.prom import serve_metrics
 
-        routes = {}
+        routes = {"/debug/traces": tracing.snapshot}
         if cluster is not None:
             routes["/queue"] = cluster.status
         serve_metrics(args.metrics_port, json_routes=routes)
-        logging.info("metrics on :%d/metrics", args.metrics_port)
+        logging.info("metrics on :%d/metrics (+ /debug/traces)",
+                     args.metrics_port)
     logging.info("operator up; inventory=%s scheduler=%s", inventory,
                  "off" if cluster is None else "on")
     controller.run(poll_interval_s=args.poll_interval_s,

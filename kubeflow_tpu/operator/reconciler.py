@@ -51,7 +51,7 @@ from kubeflow_tpu.operator.kube import (
     FakeKube,
     NotFound,
 )
-from kubeflow_tpu.runtime import bootstrap
+from kubeflow_tpu.runtime import bootstrap, tracing
 from kubeflow_tpu.testing import faults
 
 log = logging.getLogger(__name__)
@@ -169,6 +169,10 @@ class TPUJobController:
         self._admitted_at: Dict[str, float] = {}
         # Preemption grace deadlines on the policy clock, keyed by job.
         self._preempt_deadline: Dict[str, float] = {}
+        # Job-lifecycle traces (runtime/tracing.py): one trace per job,
+        # a span per phase dwelled in, the root stamped at the terminal
+        # transition (tail sampling then always keeps Failed jobs).
+        self._job_traces: Dict[str, dict] = {}
         self.metrics: List[dict] = []
 
     # -- main loop --------------------------------------------------------
@@ -237,6 +241,17 @@ class TPUJobController:
             "kft_operator_reconcile_passes_total",
             "full reconcile sweeps over all TPUJobs",
         ).inc()
+        # Trace state of jobs whose CR vanished pre-terminal (kubectl
+        # delete mid-run) would otherwise accumulate forever — no
+        # terminal transition will ever prune them.  Keys come from
+        # the SAME helper _set_phase stamps with (namespace default
+        # 'default', NOT cr_key's 'kubeflow') or a defaulted-namespace
+        # job's live trace would be wiped every sweep.
+        live_keys = {
+            self._trace_key(cr.get("metadata", {})) for cr in crs}
+        for key in [k for k in self._job_traces
+                    if k not in live_keys]:
+            del self._job_traces[key]
         gauge = REGISTRY.gauge(
             "kft_operator_jobs", "TPUJobs by phase at last sweep")
         for phase in (QUEUED, STARTING, JOB_RUNNING, JOB_PREEMPTING,
@@ -619,3 +634,66 @@ class TPUJobController:
             meta.get("namespace", "default"), f"TPUJob/{meta['name']}",
             reason or phase, message or phase,
         )
+        self._trace_transition(self._trace_key(meta), phase, reason,
+                               message)
+
+    @staticmethod
+    def _trace_key(meta: dict) -> str:
+        """The one job-trace key derivation, shared by the stamping
+        site (_set_phase) and the prune sweep (reconcile_all) — if
+        they diverged, a live job's trace state would be wiped every
+        sweep."""
+        return (f"{meta.get('namespace', 'default')}/"
+                f"{meta.get('name', '')}")
+
+    def _trace_transition(self, key: str, phase: str, reason: str,
+                          message: str) -> None:
+        """Job-lifecycle spans, drain-time stamped: each phase the job
+        dwelled in becomes one span (annotated with the queue/quota/
+        preemption reason that ENDED it), and the terminal transition
+        stamps the root span — Failed jobs complete with status
+        "error", so tail sampling always retains them."""
+        if not tracing.enabled():
+            self._job_traces.pop(key, None)
+            return
+        now = time.perf_counter()
+        tr = self._job_traces.get(key)
+        if tr is not None and tr.get("done"):
+            # Already terminally stamped.  A permanently invalid CR
+            # re-enters the Failed path EVERY sweep (its spec parse
+            # fails before the terminal short-circuit); without this
+            # tombstone each sweep would mint a fresh error-retained
+            # trace and LRU-flush the store in minutes.  The entry
+            # stays (bounded by live CRs, like _admitted_at) until the
+            # prune sweep sees the CR vanish.
+            return
+        if tr is None:
+            tr = self._job_traces[key] = {
+                "t0": now, "phase": None, "since": now, "spans": []}
+        prev = tr["phase"]
+        if prev is not None and prev != phase:
+            # Phase spans buffer in CONTROLLER memory (bounded: a few
+            # phases per job) and stamp at the terminal transition —
+            # a job Running for hours must not depend on the store's
+            # open-trace aging to keep its earlier phases.
+            tr["spans"].append(
+                (f"job.{prev}", tr["since"], now,
+                 {"job": key, "to": phase, "reason": reason,
+                  "message": message}))
+            tr["since"] = now
+        tr["phase"] = phase
+        if phase in TERMINAL:
+            ctx = tracing.new_root_ctx()
+            if ctx is not None:
+                for name, start, end, attrs in tr["spans"]:
+                    tracing.record_span(name, ctx, start, end,
+                                        attrs=attrs)
+                tracing.record_span(
+                    "job.lifecycle", ctx, tr["t0"], now,
+                    status="ok" if phase == JOB_SUCCEEDED
+                    else "error",
+                    attrs={"job": key, "phase": phase,
+                           "reason": reason, "message": message},
+                    root=True)
+            tr["spans"] = []
+            tr["done"] = True

@@ -46,10 +46,22 @@ def step_marker(step: int):
     return jax.profiler.StepTraceAnnotation("train_step", step_num=step)
 
 
-def start_server(port: int = 9999) -> object:
+def start_server(port: int = 9999) -> Optional[object]:
     """Start the on-demand capture server (connect with
-    ``jax.profiler.trace`` from another process / the XProf UI)."""
-    server = jax.profiler.start_server(port)
+    ``jax.profiler.trace`` from another process / the XProf UI).
+
+    Returns the profiler server object, or None when the server could
+    not start — the port is already bound, or the backend lacks the
+    profiler service.  Degrading with a warning instead of raising is
+    deliberate: the capture server is an observability SIDECAR, and a
+    busy port must never take down the trainer or serving process it
+    rides in."""
+    try:
+        server = jax.profiler.start_server(port)
+    except Exception as exc:  # port taken / backend without profiler
+        log.warning("profiler server on :%d unavailable: %s",
+                    port, exc)
+        return None
     log.info("profiler server on :%d", port)
     return server
 

@@ -63,11 +63,13 @@ class Counter(_Metric):
             self._values[key] = self._values.get(key, 0.0) + amount
 
     def _render(self) -> List[str]:
+        # Same snapshot-then-format discipline as Histogram._render:
+        # the lock pays one dict copy, not the string work.
         with self._lock:
-            return [
-                f"{self.name}{_fmt_labels(k)} {v}"
-                for k, v in sorted(self._values.items())
-            ] or [f"{self.name} 0"]
+            values = sorted(self._values.items())
+        return [
+            f"{self.name}{_fmt_labels(k)} {v}" for k, v in values
+        ] or [f"{self.name} 0"]
 
 
 class Gauge(_Metric):
@@ -89,10 +91,10 @@ class Gauge(_Metric):
 
     def _render(self) -> List[str]:
         with self._lock:
-            return [
-                f"{self.name}{_fmt_labels(k)} {v}"
-                for k, v in sorted(self._values.items())
-            ] or [f"{self.name} 0"]
+            values = sorted(self._values.items())
+        return [
+            f"{self.name}{_fmt_labels(k)} {v}" for k, v in values
+        ] or [f"{self.name} 0"]
 
 
 class Histogram(_Metric):
@@ -129,38 +131,47 @@ class Histogram(_Metric):
             self._sums[key] = self._sums.get(key, 0.0) + value
 
     def _render(self) -> List[str]:
-        out: List[str] = []
+        # Snapshot bucket counts AND the sum under the metric lock in
+        # one motion (list() copies each per-series count vector), then
+        # format OUTSIDE it: a concurrent observe() between reading a
+        # series' counts and its sum would otherwise scrape a torn pair
+        # — a _count that disagrees with _sum breaks every rate()/avg
+        # recording rule downstream — and string formatting has no
+        # business extending the writers' critical section.
         with self._lock:
-            if not self._counts:
-                # A registered-but-unobserved histogram must scrape as
-                # zero counts, not as a missing series — 'no data' is
-                # indistinguishable from 'scrape broken' on a dashboard.
-                # This bare-name guarantee only holds for UNLABELED
-                # histograms; labeled series get it via declare().
-                for b in self.buckets:
-                    out.append(f'{self.name}_bucket{{le="{b}"}} 0')
-                out.append(f'{self.name}_bucket{{le="+Inf"}} 0')
-                out.append(f"{self.name}_sum 0.0")
-                out.append(f"{self.name}_count 0")
-                return out
-            for key, counts in sorted(self._counts.items()):
-                # le labels built outside the f-string expressions:
-                # backslash escapes inside an f-string expression are a
-                # SyntaxError before Python 3.12, and serving must run
-                # on 3.10.
-                for i, b in enumerate(self.buckets):
-                    le = 'le="%s"' % b
-                    out.append(
-                        f"{self.name}_bucket"
-                        f"{_fmt_labels(key, le)} {counts[i]}")
-                le_inf = 'le="+Inf"'
+            snapshot = [(key, list(counts), self._sums[key])
+                        for key, counts in sorted(self._counts.items())]
+        out: List[str] = []
+        if not snapshot:
+            # A registered-but-unobserved histogram must scrape as
+            # zero counts, not as a missing series — 'no data' is
+            # indistinguishable from 'scrape broken' on a dashboard.
+            # This bare-name guarantee only holds for UNLABELED
+            # histograms; labeled series get it via declare().
+            for b in self.buckets:
+                out.append(f'{self.name}_bucket{{le="{b}"}} 0')
+            out.append(f'{self.name}_bucket{{le="+Inf"}} 0')
+            out.append(f"{self.name}_sum 0.0")
+            out.append(f"{self.name}_count 0")
+            return out
+        for key, counts, total in snapshot:
+            # le labels built outside the f-string expressions:
+            # backslash escapes inside an f-string expression are a
+            # SyntaxError before Python 3.12, and serving must run
+            # on 3.10.
+            for i, b in enumerate(self.buckets):
+                le = 'le="%s"' % b
                 out.append(
                     f"{self.name}_bucket"
-                    f"{_fmt_labels(key, le_inf)} {counts[-1]}")
-                out.append(
-                    f"{self.name}_sum{_fmt_labels(key)} {self._sums[key]}")
-                out.append(
-                    f"{self.name}_count{_fmt_labels(key)} {counts[-1]}")
+                    f"{_fmt_labels(key, le)} {counts[i]}")
+            le_inf = 'le="+Inf"'
+            out.append(
+                f"{self.name}_bucket"
+                f"{_fmt_labels(key, le_inf)} {counts[-1]}")
+            out.append(
+                f"{self.name}_sum{_fmt_labels(key)} {total}")
+            out.append(
+                f"{self.name}_count{_fmt_labels(key)} {counts[-1]}")
         return out
 
 

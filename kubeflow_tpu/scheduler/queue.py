@@ -21,6 +21,7 @@ import threading
 from typing import Any, Dict, List, Optional
 
 from kubeflow_tpu.operator import crd
+from kubeflow_tpu.runtime import tracing
 from kubeflow_tpu.scheduler.policy import (
     ADMIT,
     PREEMPT,
@@ -160,6 +161,24 @@ class ClusterScheduler:
         ``scheduler.admit`` lets the fault harness do exactly that on
         purpose).
         """
+        # Per-pass trace span: one single-span trace per plan pass
+        # (tail-sampled like everything else; a raising pass ends with
+        # status "error" and is always retained), annotated with the
+        # verdict counts — the operator-side analogue of the serving
+        # path's request spans.
+        span = tracing.start_span("scheduler.plan")
+        try:
+            plan = self._plan_inner(cr_objs)
+        except BaseException:
+            span.end(status="error")
+            raise
+        counts: Dict[str, int] = {}
+        for decision in plan.decisions.values():
+            counts[decision.action] = counts.get(decision.action, 0) + 1
+        span.end(status="ok", **counts)
+        return plan
+
+    def _plan_inner(self, cr_objs: List[dict]) -> Plan:
         faults.fire("scheduler.admit")
         pending: List[JobView] = []
         running: List[JobView] = []

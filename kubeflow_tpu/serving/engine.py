@@ -67,6 +67,7 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from kubeflow_tpu.runtime import tracing
 from kubeflow_tpu.serving.errors import (
     BatcherClosed,
     DeadlineExceeded,
@@ -526,9 +527,18 @@ class DecodeEngine:
             raise DeadlineExceeded(
                 f"deadline expired before engine "
                 f"{self._metric_name!r} admission")
+        # Trace context captured on the transport thread; the loop
+        # thread stamps spans from perf readings at drain time (never
+        # per token), so the hot step loop stays untouched and a
+        # disabled tracer costs one None check per site.
+        trace_ctx = tracing.current_ctx()
         entry = {
             "tokens": tokens, "new": new, "seed": seed,
             "emitted": [], "scheduled": 0, "slot": None,
+            "trace": trace_ctx,
+            "t_perf": time.perf_counter()
+            if trace_ctx is not None else 0.0,
+            "t_first_perf": None, "spec_acc": 0,
             "prefilling": False, "pos": 0, "cached": 0, "pool_row": None,
             # Adaptive draft width: grows on full accepts, shrinks on
             # full rejects; 0 = backed off (re-probes after cooldown).
@@ -796,6 +806,14 @@ class DecodeEngine:
         self._expired_ctr.inc(len(expired), batcher=self._metric_name)
         for entry in expired:
             if not entry["event"].is_set():
+                if entry["trace"] is not None:
+                    tracing.record_span(
+                        "engine.request", entry["trace"],
+                        entry["t_perf"], time.perf_counter(),
+                        status="deadline_expired",
+                        attrs={"engine": self._metric_name,
+                               "emitted": len(entry["emitted"]),
+                               "budget": entry["new"]})
                 entry["err"] = DeadlineExceeded(
                     f"deadline expired after {len(entry['emitted'])} "
                     f"of {entry['new']} tokens "
@@ -880,6 +898,19 @@ class DecodeEngine:
                 engine=self._metric_name)
             if evicted:
                 self._evict_ctr.inc(engine=self._metric_name)
+        if entry["trace"] is not None:
+            # Admission span: queue wait (submit -> slot claim) plus
+            # the prefix lookup/copy, annotated with the cache verdict
+            # — TTFT debugging's first question ("was it queued or was
+            # it prefill?") answered per request.
+            tracing.record_span(
+                "engine.admission", entry["trace"], entry["t_perf"],
+                time.perf_counter(),
+                attrs={"engine": self._metric_name, "slot": slot,
+                       "prompt_tokens": true_len,
+                       "cached_tokens": cached,
+                       "prefix": "hit" if cached else "miss",
+                       "copy_ms": round(dt * 1e3, 3)})
 
     def _prefill_chunk(self, entry: dict) -> None:
         """One static-width chunk of one entry's prompt into its slot
@@ -942,6 +973,12 @@ class DecodeEngine:
             if finished:
                 self._counters["prefills"] += 1
         self._chunks_ctr.inc(engine=self._metric_name)
+        if entry["trace"] is not None:
+            tracing.record_span(
+                "engine.prefill_chunk", entry["trace"], t0, t0 + dt,
+                attrs={"engine": self._metric_name, "start": start,
+                       "width": w,
+                       **({"final": True} if finished else {})})
 
     def _finish(self, entry: dict) -> None:
         """Resolve a completed request: prompt + emitted tokens."""
@@ -955,6 +992,19 @@ class DecodeEngine:
                 (entry["t_first"] or now) - entry["t"])
             entry["out"]["latency_s"] = now - entry["t"]
             entry["out"]["cached_tokens"] = entry["cached"]
+        if entry["trace"] is not None:
+            # ONE decode span per request, stamped at delivery: first
+            # token -> last token, annotated with the emitted count and
+            # the speculative tokens verify_step accepted on its
+            # behalf.  Per-step spans would cost the hot loop; this
+            # costs one record at drain.
+            end = time.perf_counter()
+            tracing.record_span(
+                "engine.decode", entry["trace"],
+                entry["t_first_perf"] or end, end,
+                attrs={"engine": self._metric_name,
+                       "tokens": len(entry["emitted"]),
+                       "spec_accepted": entry["spec_acc"]})
         entry["event"].set()
 
     def _drain_one(self) -> None:
@@ -991,6 +1041,8 @@ class DecodeEngine:
                 tok = int(tok)
                 if entry["t_first"] is None:
                     entry["t_first"] = faults.monotonic()
+                    if entry["trace"] is not None:
+                        entry["t_first_perf"] = time.perf_counter()
                 entry["emitted"].append(tok)
                 if entry["hist"] is not None:
                     entry["hist"][entry["hist_len"]] = tok
@@ -1185,6 +1237,10 @@ class DecodeEngine:
             while a < lim and toks_np[col, a] == draft[col, a]:
                 a += 1
             accepted += a
+            if entry["trace"] is not None:
+                # Per-request accepted-token tally for the decode
+                # span's annotation (stamped at delivery).
+                entry["spec_acc"] += a
             # Adaptive width: additive increase on a full accept,
             # additive decrease on a full reject; at zero the slot
             # stops paying drafting until the cooldown re-probe.

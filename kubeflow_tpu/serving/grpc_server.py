@@ -20,6 +20,7 @@ from typing import Optional
 import grpc
 import numpy as np
 
+from kubeflow_tpu.runtime import tracing
 from kubeflow_tpu.serving.errors import DeadlineExceeded, Overloaded
 from kubeflow_tpu.serving.model_server import ModelServer
 from kubeflow_tpu.serving.protos import prediction_pb2 as pb
@@ -181,29 +182,45 @@ def _wrap(servicer: PredictionServicer, name: str):
         spec_name = request.model_spec.name
         model_label = spec_name \
             if servicer.server.has_model(spec_name) else "_unknown_"
-        outcome = "error"
+        # gRPC carries trace context in invocation metadata (the
+        # transport's header analogue); the server span mirrors the
+        # REST face's and feeds the same tail-sampled store.
+        parent = None
+        if tracing.enabled():
+            parent = tracing.extract(
+                dict(context.invocation_metadata() or ()))
+        span = tracing.start_span(
+            f"server.{route}", parent=parent,
+            attrs={"model": model_label, "transport": "grpc"})
+        # `outcome` keeps the metric vocabulary; `span_status` names
+        # client faults so a 404/400 answer samples like ok traffic
+        # instead of riding tail sampling's always-keep error tier.
+        outcome = span_status = "error"
         t0 = _time.perf_counter()
         try:
-            resp = method(request, context)
-            outcome = "ok"
+            with tracing.use_span(span):
+                resp = method(request, context)
+            outcome = span_status = "ok"
             return resp
         except KeyError as e:
+            span_status = "not_found"
             context.abort(grpc.StatusCode.NOT_FOUND, str(e))
         except ValueError as e:
+            span_status = "invalid_argument"
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
         except Overloaded as e:
             # Same status pair as the REST face's 429/504: one failure
             # semantics across transports.  The Retry-After hint rides
             # STRUCTURED trailing metadata (the gRPC analogue of the
             # REST header) — clients must not parse prose.
-            outcome = "shed"
+            outcome = span_status = "shed"
             context.set_trailing_metadata(
                 (("retry-after", f"{e.retry_after_s}"),))
             context.abort(
                 grpc.StatusCode.RESOURCE_EXHAUSTED,
                 f"{e} (retry after {e.retry_after_s:.1f}s)")
         except DeadlineExceeded as e:
-            outcome = "deadline_exceeded"
+            outcome = span_status = "deadline_exceeded"
             context.abort(grpc.StatusCode.DEADLINE_EXCEEDED, str(e))
         finally:
             REGISTRY.counter(REQUESTS_TOTAL, REQUESTS_HELP).inc(
@@ -211,6 +228,7 @@ def _wrap(servicer: PredictionServicer, name: str):
             REGISTRY.histogram(
                 LATENCY_SECONDS, LATENCY_HELP,
             ).observe(_time.perf_counter() - t0, route=route)
+            span.end(status=span_status)
 
     return handler
 

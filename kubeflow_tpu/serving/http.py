@@ -25,6 +25,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from kubeflow_tpu.runtime import tracing
 from kubeflow_tpu.serving.errors import DeadlineExceeded, Overloaded
 from kubeflow_tpu.serving.model_server import ModelServer
 from kubeflow_tpu.testing import faults
@@ -52,6 +53,11 @@ _ROUTES = [
     # the kubelet does not kill a pod that is busy draining.
     ("GET", re.compile(r"^/readyz$"), "ready"),
     ("GET", re.compile(r"^/metrics$"), "metrics"),
+    # Retained request traces (tail-sampled spans: admission, queue
+    # wait, prefill chunks, decode — see runtime/tracing.py); rendered
+    # by `kubeflow-tpu trace list|show`.  Unknown /debug/* paths fall
+    # through to the drained-body 404 like any unrouted request.
+    ("GET", re.compile(r"^/debug/traces$"), "traces"),
 ]
 
 
@@ -205,7 +211,8 @@ class _Handler(BaseHTTPRequestHandler):
     # load-balancer probe or Prometheus scrape is not work a drain must
     # wait for, and counting scrapes as in-flight would feed the fleet
     # autoscaler a phantom +1 load per scrape.
-    _PROBE_PATHS = ("/metrics", "/healthz", "/readyz")
+    _PROBE_PATHS = ("/metrics", "/healthz", "/readyz",
+                    "/debug/traces")
 
     def _dispatch(self, method: str) -> None:
         # Bracket the WHOLE dispatch — body read included — in the
@@ -279,6 +286,8 @@ class _Handler(BaseHTTPRequestHandler):
             # values must be current now, not as of the last request.
             self.api.server.refresh_gauges()
             self._send(200, REGISTRY.render(), raw=True)
+        elif action == "traces":
+            self._send(200, tracing.snapshot())
         elif action == "metadata":
             self._send(200, self.api.metadata(groups["name"]))
         elif action == "stats":
@@ -303,16 +312,36 @@ class _Handler(BaseHTTPRequestHandler):
             name = groups["name"]
             model_label = name if self.api.server.has_model(name) \
                 else "_unknown_"
-            outcome = "error"
+            # Server span: continues the router's trace (traceparent
+            # header) or roots a fresh one; becoming the thread's
+            # current context is what lets the batching planes stamp
+            # child spans without signature changes.  Ends with the
+            # same outcome vocabulary the request counter uses, so
+            # tail sampling always keeps shed/expired/errored traces.
+            span = tracing.start_span(
+                f"server.{action}", parent=tracing.extract(self.headers),
+                attrs={"model": model_label, "transport": "rest"})
+            # `outcome` keeps the pre-tracing metric vocabulary (4xx
+            # counts as "error"); `span_status` additionally names the
+            # client faults so tail sampling treats a 404/400 as an
+            # answer, not an always-keep incident.
+            outcome = span_status = "error"
             t0 = _time.perf_counter()
             try:
-                out = fn(name, body, version)
-                outcome = "ok"
+                with tracing.use_span(span):
+                    out = fn(name, body, version)
+                outcome = span_status = "ok"
+            except KeyError:
+                span_status = "not_found"
+                raise
+            except ValueError:
+                span_status = "invalid_argument"
+                raise
             except Overloaded:
-                outcome = "shed"
+                outcome = span_status = "shed"
                 raise
             except DeadlineExceeded:
-                outcome = "deadline_exceeded"
+                outcome = span_status = "deadline_exceeded"
                 raise
             finally:
                 REGISTRY.counter(REQUESTS_TOTAL, REQUESTS_HELP).inc(
@@ -322,6 +351,7 @@ class _Handler(BaseHTTPRequestHandler):
                 REGISTRY.histogram(
                     LATENCY_SECONDS, LATENCY_HELP,
                 ).observe(_time.perf_counter() - t0, route=action)
+                span.end(status=span_status)
             self._send(200, out)
 
     def _send(self, code: int, payload: Any, raw: bool = False,

@@ -247,9 +247,16 @@ def main(argv=None) -> int:
                          "serving while the breaker is open)")
     ap.add_argument("--reload_backoff_cap_s", type=float, default=60.0,
                     help="circuit-breaker backoff ceiling")
+    from kubeflow_tpu.runtime import tracing
+
+    tracing.add_cli_args(ap)
     args = ap.parse_args(argv)
 
     logging.basicConfig(level=logging.INFO, stream=sys.stderr)
+    if tracing.enable_from_args(args) is not None:
+        logging.info("request tracing on (sample rate %g, store %d "
+                     "traces) — GET /debug/traces",
+                     args.trace_sample_rate, args.trace_capacity)
     # Scripted chaos (KFT_FAULTS env var): no-op unless set — see
     # kubeflow_tpu/testing/faults.py for the grammar.
     if faults.install_from_env() is not None:

@@ -23,6 +23,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
 
+from kubeflow_tpu.runtime import tracing
 from kubeflow_tpu.serving.errors import (  # noqa: F401 — re-exported
     BatcherClosed,
     DeadlineExceeded,
@@ -489,20 +490,37 @@ class ModelServer:
         generation; the direct path checks it at entry only — a jitted
         whole-generation program cannot be interrupted, which is exactly
         why the engine owns the LM hot path."""
-        with self._lock:
-            if self._max_inflight and self._inflight_by_model.get(
-                    name, 0) >= self._max_inflight:
-                from kubeflow_tpu.runtime.prom import REGISTRY
+        # Admission child span (trace context set by the transport
+        # layer): covers the in-flight-cap verdict; a shed admission
+        # records status="shed" so the trace is always tail-retained.
+        ctx = tracing.current_ctx()
+        t_adm = time.perf_counter() if ctx is not None else 0.0
+        try:
+            with self._lock:
+                if self._max_inflight and self._inflight_by_model.get(
+                        name, 0) >= self._max_inflight:
+                    from kubeflow_tpu.runtime.prom import REGISTRY
 
-                REGISTRY.counter(SHED_TOTAL, SHED_HELP).inc(
-                    batcher=f"{name}-inflight")
-                raise Overloaded(
-                    f"model {name!r} at its in-flight cap "
-                    f"({self._max_inflight})",
-                    retry_after_s=self._overload_retry_after_s)
-            self._inflight += 1
-            self._inflight_by_model[name] = \
-                self._inflight_by_model.get(name, 0) + 1
+                    REGISTRY.counter(SHED_TOTAL, SHED_HELP).inc(
+                        batcher=f"{name}-inflight")
+                    raise Overloaded(
+                        f"model {name!r} at its in-flight cap "
+                        f"({self._max_inflight})",
+                        retry_after_s=self._overload_retry_after_s)
+                self._inflight += 1
+                self._inflight_by_model[name] = \
+                    self._inflight_by_model.get(name, 0) + 1
+        except Overloaded:
+            if ctx is not None:
+                tracing.record_span(
+                    "server.admission", ctx, t_adm,
+                    time.perf_counter(), status="shed",
+                    attrs={"model": name})
+            raise
+        if ctx is not None:
+            tracing.record_span(
+                "server.admission", ctx, t_adm, time.perf_counter(),
+                attrs={"model": name})
         try:
             return self._predict(name, inputs, version, deadline)
         finally:
@@ -700,8 +718,16 @@ class MicroBatcher:
         arrival raises DeadlineExceeded immediately; a queued entry
         whose deadline passes pre-dispatch is failed by the runner
         sweep instead of being dispatched."""
+        # Trace context captured on the caller's thread (the transport
+        # set it); the runner threads stamp queue-wait/dispatch spans
+        # from these perf readings at dispatch time.  None when
+        # tracing is off — every span site below is gated on it.
+        trace_ctx = tracing.current_ctx()
         entry = {"inputs": inputs,
                  "t": faults.monotonic(), "deadline": deadline,
+                 "trace": trace_ctx,
+                 "t_perf": time.perf_counter()
+                 if trace_ctx is not None else 0.0,
                  "event": threading.Event(), "out": None, "err": None}
         if deadline is not None and faults.monotonic() >= deadline:
             with self._lock:
@@ -918,11 +944,26 @@ class MicroBatcher:
                 err = DeadlineExceeded(
                     f"deadline expired in batcher "
                     f"{self._metric_name!r} queue")
+                now_perf = time.perf_counter()
                 for e in expired:
+                    if e["trace"] is not None:
+                        tracing.record_span(
+                            "batcher.queue_wait", e["trace"],
+                            e["t_perf"], now_perf,
+                            status="deadline_expired",
+                            attrs={"batcher": self._metric_name})
                     e["err"] = err
                     e["event"].set()
             if batch is None:
                 continue
+            if any(e["trace"] is not None for e in batch):
+                now_perf = time.perf_counter()
+                for e in batch:
+                    if e["trace"] is not None:
+                        tracing.record_span(
+                            "batcher.queue_wait", e["trace"],
+                            e["t_perf"], now_perf,
+                            attrs={"batcher": self._metric_name})
             try:
                 self._process(batch)
             finally:
@@ -1005,10 +1046,20 @@ class MicroBatcher:
                     row = self._finish(row, metas[i])
                 e["out"] = row
                 e["event"].set()
-            cyc["deliver"] += time.perf_counter() - t4
+            t5 = time.perf_counter()
+            cyc["deliver"] += t5 - t4
             with self._lock:
                 for k, v in cyc.items():
                     self._cycle[k] += v
+            # Batch-assembly span per traced entry: the whole dispatch
+            # cycle (collate -> pad -> predict -> deliver) each row
+            # rode, annotated with the occupied/padded batch shape.
+            for e in batch:
+                if e["trace"] is not None:
+                    tracing.record_span(
+                        "batcher.dispatch", e["trace"], t0, t5,
+                        attrs={"batcher": self._metric_name,
+                               "batch_size": n, "padded_to": size})
         except Exception as exc:
             # Propagate to all waiters still pending.  Rows already
             # delivered (event set) keep their results — a `finish`

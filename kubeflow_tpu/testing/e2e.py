@@ -591,8 +591,17 @@ def fleet_smoke(namespace: str = "kubeflow-test") -> None:
       4. drain-aware rolling restart under continuous traffic — the
          draining replica gets no NEW work, finishes its in-flight,
          restarts, and ZERO accepted requests are lost end to end;
-      5. router/autoscaler outcomes visible in kft_router_* /
-         kft_autoscaler_* metrics.
+      5. distributed tracing end to end — a request proxied through
+         the router yields ONE trace whose span tree walks
+         router.request -> router.forward -> server.predict ->
+         engine.admission -> engine.prefill_chunk -> engine.decode
+         with a consistent trace_id (W3C traceparent propagation),
+         retrievable from /debug/traces on the router AND the
+         replica; with the healthy-sample rate at ZERO, a
+         deadline-expired request is still always retained (tail
+         sampling) while ok traffic is not;
+      6. router/autoscaler/trace outcomes visible in kft_router_* /
+         kft_autoscaler_* / kft_trace_* metrics.
 
     All replicas share one process (and thus one prom registry and one
     fault injector): per-endpoint /metrics scrapes stay correct because
@@ -618,6 +627,7 @@ def fleet_smoke(namespace: str = "kubeflow-test") -> None:
     from kubeflow_tpu.fleet.router import FleetRouter, make_router_server
     from kubeflow_tpu.models.transformer import Transformer
     from kubeflow_tpu.operator.kube_http import HttpKube
+    from kubeflow_tpu.runtime import tracing
     from kubeflow_tpu.serving.export import export
     from kubeflow_tpu.serving.http import make_http_server
     from kubeflow_tpu.serving.loaders import _model_config
@@ -657,11 +667,22 @@ def fleet_smoke(namespace: str = "kubeflow-test") -> None:
         except urllib.error.HTTPError as e:
             return e.code, json.loads(e.read())
 
+    def get_traces(port):
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/debug/traces",
+                timeout=30) as resp:
+            return json.loads(resp.read())
+
     model = Transformer(_model_config(overrides))
     variables = model.init(jax.random.key(0), np.zeros((1, 4), np.int32))
     replicas = []
     apiserver = router_httpd = None
     registry = None
+    # Tracing ON for the whole scenario: every hop below stamps spans
+    # into one shared in-process store (router + replicas share the
+    # process here, which is exactly what makes the cross-"process"
+    # trace_id consistency assertable end to end).
+    tracing.enable(sample_rate=1.0, capacity=256)
     with faults.injected(scenario) as inj, \
             tempfile.TemporaryDirectory() as tmp:
         export(f"{tmp}/lm", 1, variables,
@@ -744,6 +765,51 @@ def fleet_smoke(namespace: str = "kubeflow-test") -> None:
                              "requests", 0) > 0]
             assert len(served_by) >= 2, (
                 f"load not spread: replicas {served_by} served")
+
+            # -- 5a. trace propagation: router hop -> decode step ---------
+            # One routed request must yield ONE trace whose span tree
+            # carries the whole path with a consistent trace_id: the
+            # router injected its forward span's traceparent, the
+            # replica's server span continued it, and the engine
+            # stamped admission/prefill/decode children at drain time.
+            snap = get_traces(rport)
+            assert snap["enabled"], snap
+            full = None
+            for trace in snap["traces"]:
+                names = {s["name"] for s in trace["spans"]}
+                if {"router.request", "router.forward",
+                        "server.predict", "engine.admission",
+                        "engine.prefill_chunk",
+                        "engine.decode"} <= names:
+                    full = trace
+                    break
+            assert full is not None, (
+                f"no trace with the full router->engine span chain in "
+                f"{[sorted({s['name'] for s in t['spans']}) for t in snap['traces']]}")
+            tid = full["trace_id"]
+            assert all(s["trace_id"] == tid for s in full["spans"])
+            by_name = {}
+            for s in full["spans"]:
+                by_name.setdefault(s["name"], s)
+            # Parent chain: server span under the forward span, which
+            # is under the router root (the W3C header did its job).
+            root = by_name["router.request"]
+            assert root["parent_id"] is None
+            assert by_name["router.forward"]["parent_id"] \
+                == root["span_id"]
+            assert by_name["server.predict"]["parent_id"] \
+                == by_name["router.forward"]["span_id"]
+            assert by_name["engine.decode"]["attrs"]["tokens"] \
+                == max_new
+            # The router root span's id is retrievable from a REPLICA's
+            # /debug/traces too (shared store in the hermetic fleet):
+            # the trace one port shows is the trace every port shows.
+            replica_port = replicas[0][1].server_address[1]
+            replica_snap = get_traces(replica_port)
+            assert any(t["trace_id"] == tid
+                       for t in replica_snap["traces"]), (
+                f"trace {tid} not visible on replica "
+                f"{replica_port}")
 
             # -- 3. kill mid-generation -> eject -> recover ---------------
             victim_srv, victim_httpd = replicas[0]
@@ -833,7 +899,26 @@ def fleet_smoke(namespace: str = "kubeflow-test") -> None:
                 f"rolling restart lost {len(bad)}/{len(outcomes)} "
                 f"accepted requests: {bad[:5]}")
 
-            # -- 5. control-plane outcomes in kft_* metrics ---------------
+            # -- 5b. tail sampling: errored requests ALWAYS retained ------
+            # Fresh store with the healthy-sample rate at ZERO: ok
+            # traffic keeps nothing, a deadline-expired request is
+            # still captured (the always-keep tier).
+            tracing.enable(sample_rate=0.0, capacity=64)
+            assert predict_via(rport, body_full)[0] == 200
+            code, payload = predict_via(
+                rport, {**body_full, "deadline_ms": 0.001})
+            assert code == 504, (code, payload)
+            snap = get_traces(rport)
+            statuses = [t["status"] for t in snap["traces"]]
+            assert "deadline_exceeded" in statuses, snap["traces"]
+            kept = [t for t in snap["traces"]
+                    if t["status"] == "deadline_exceeded"]
+            assert all(t["retained"] == "error" for t in kept)
+            assert not any(t["status"] == "ok"
+                           for t in snap["traces"]), (
+                f"ok traffic retained at sample rate 0: {statuses}")
+
+            # -- 6. control-plane outcomes in kft_* metrics ---------------
             with urllib.request.urlopen(
                     f"http://127.0.0.1:{rport}/metrics",
                     timeout=30) as resp:
@@ -855,7 +940,16 @@ def fleet_smoke(namespace: str = "kubeflow-test") -> None:
             assert sample_value(parsed, "kft_router_endpoints",
                                 state="routable") == 3, parsed.get(
                                     "kft_router_endpoints")
+            # Trace-store health on the same scrape: spans recorded,
+            # the errored trace retained, occupancy visible.
+            assert (sample_value(parsed, "kft_trace_spans_total")
+                    or 0) > 0
+            assert (sample_value(parsed, "kft_trace_retained_total",
+                                 reason="error") or 0) >= 1
+            assert sample_value(
+                parsed, "kft_trace_store_traces") is not None
         finally:
+            tracing.disable()
             if router_httpd is not None:
                 router_httpd.shutdown()
             if apiserver is not None:

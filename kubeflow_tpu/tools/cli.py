@@ -228,6 +228,92 @@ def cmd_queue_status(args: argparse.Namespace) -> int:
     return 0
 
 
+def _fetch_traces(target: str, timeout: float) -> Dict[str, Any]:
+    import urllib.request
+
+    url = target.rstrip("/") + "/debug/traces"
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def cmd_trace_list(args: argparse.Namespace) -> int:
+    """Print the retained request traces of one /debug/traces server
+    (model server REST port, fleet router port, or operator metrics
+    port) — the tail-sampled store from runtime/tracing.py."""
+    payload = _fetch_traces(args.target, args.timeout)
+    if not payload.get("enabled", False):
+        print("tracing disabled on this server")
+        return 0
+    traces = payload.get("traces", [])
+    if not traces:
+        print("no retained traces (tail sampling kept nothing yet)")
+        return 0
+    fmt = "{:<34} {:<22} {:<18} {:>11} {:>6} {:<8}"
+    print(fmt.format("TRACE_ID", "ROOT", "STATUS", "DURATION_MS",
+                     "SPANS", "KEPT_BY"))
+    for t in traces:
+        print(fmt.format(t["trace_id"], t.get("root", ""),
+                         t.get("status", ""),
+                         t.get("duration_ms", 0.0),
+                         len(t.get("spans", [])),
+                         t.get("retained", "")))
+    return 0
+
+
+def _render_span_tree(spans: List[Dict[str, Any]], out) -> None:
+    """Indent spans under their parents (orphans — e.g. the replica
+    half of a cross-process trace whose router parent lives in another
+    store — render as extra roots), durations and attrs inline."""
+    by_id = {s["span_id"]: s for s in spans}
+    children: Dict[Any, List[dict]] = {}
+    for s in spans:
+        parent = s.get("parent_id")
+        key = parent if parent in by_id else None
+        children.setdefault(key, []).append(s)
+
+    def walk(span: Dict[str, Any], depth: int) -> None:
+        attrs = " ".join(f"{k}={v}" for k, v in
+                         sorted((span.get("attrs") or {}).items()))
+        print(f"{'  ' * depth}{'└─ ' if depth else ''}"
+              f"{span['name']}  {span.get('duration_ms', 0.0)}ms  "
+              f"{span.get('status', '')}"
+              f"{('  ' + attrs) if attrs else ''}", file=out)
+        kids = sorted(children.get(span["span_id"], []),
+                      key=lambda s: s.get("start_s", 0.0))
+        for kid in kids:
+            walk(kid, depth + 1)
+
+    roots = sorted(children.get(None, []),
+                   key=lambda s: s.get("start_s", 0.0))
+    for root in roots:
+        walk(root, 0)
+
+
+def cmd_trace_show(args: argparse.Namespace) -> int:
+    """Render one trace's span tree (trace_id may be a unique
+    prefix)."""
+    payload = _fetch_traces(args.target, args.timeout)
+    if not payload.get("enabled", False):
+        print("tracing disabled on this server")
+        return 1
+    matches = [t for t in payload.get("traces", [])
+               if t["trace_id"].startswith(args.trace_id)]
+    if not matches:
+        print(f"error: no retained trace matches {args.trace_id!r}",
+              file=sys.stderr)
+        return 1
+    if len(matches) > 1:
+        print(f"error: {args.trace_id!r} is ambiguous "
+              f"({len(matches)} matches)", file=sys.stderr)
+        return 1
+    trace = matches[0]
+    print(f"trace {trace['trace_id']}  status={trace.get('status')}  "
+          f"duration={trace.get('duration_ms')}ms  "
+          f"kept_by={trace.get('retained')}")
+    _render_span_tree(trace.get("spans", []), sys.stdout)
+    return 0
+
+
 def cmd_version(args: argparse.Namespace) -> int:
     from kubeflow_tpu.version import version_info
 
@@ -318,6 +404,29 @@ def build_parser() -> argparse.ArgumentParser:
                             "(default: %(default)s)")
     qstat.add_argument("--timeout", type=float, default=10.0)
     qstat.set_defaults(func=cmd_queue_status)
+
+    p = sub.add_parser(
+        "trace",
+        help="inspect distributed request traces (/debug/traces on "
+             "the model server, fleet router, or operator)")
+    trsub = p.add_subparsers(dest="action", required=True)
+    tlist = trsub.add_parser(
+        "list", help="retained traces, newest first")
+    tlist.add_argument("--target", default="http://127.0.0.1:8000",
+                       help="any /debug/traces server: model server "
+                            "REST port, router port, or operator "
+                            "metrics port (default: %(default)s)")
+    tlist.add_argument("--timeout", type=float, default=10.0)
+    tlist.set_defaults(func=cmd_trace_list)
+    tshow = trsub.add_parser(
+        "show", help="span tree of one trace with durations")
+    tshow.add_argument("trace_id",
+                       help="trace id (a unique prefix works)")
+    tshow.add_argument("--target", default="http://127.0.0.1:8000",
+                       help="any /debug/traces server "
+                            "(default: %(default)s)")
+    tshow.add_argument("--timeout", type=float, default=10.0)
+    tshow.set_defaults(func=cmd_trace_show)
 
     p = sub.add_parser("version", help="print version info")
     p.set_defaults(func=cmd_version)

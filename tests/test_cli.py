@@ -195,3 +195,125 @@ def test_fleet_status_renders_endpoint_table(capsys):
         assert "srv-1" in out and "ejected" in out
     finally:
         httpd.shutdown()
+
+
+def _trace_payload():
+    """A two-trace /debug/traces payload: one healthy proxied request
+    with the full router->server->engine span chain, one errored."""
+    ok_spans = [
+        {"trace_id": "aa" * 16, "span_id": "01" * 8, "parent_id": None,
+         "name": "router.request", "start_s": 100.0,
+         "duration_ms": 25.0, "status": "ok",
+         "attrs": {"path": "/model/lm:predict"}},
+        {"trace_id": "aa" * 16, "span_id": "02" * 8,
+         "parent_id": "01" * 8, "name": "router.forward",
+         "start_s": 100.001, "duration_ms": 24.0, "status": "ok",
+         "attrs": {"replica": "srv-0"}},
+        {"trace_id": "aa" * 16, "span_id": "03" * 8,
+         "parent_id": "02" * 8, "name": "server.predict",
+         "start_s": 100.002, "duration_ms": 23.0, "status": "ok",
+         "attrs": {"model": "lm"}},
+        {"trace_id": "aa" * 16, "span_id": "04" * 8,
+         "parent_id": "03" * 8, "name": "engine.decode",
+         "start_s": 100.01, "duration_ms": 20.0, "status": "ok",
+         "attrs": {"tokens": 16}},
+    ]
+    err_spans = [
+        {"trace_id": "bb" * 16, "span_id": "05" * 8, "parent_id": None,
+         "name": "router.request", "start_s": 101.0,
+         "duration_ms": 120.0, "status": "deadline_exceeded",
+         "attrs": {}},
+    ]
+    return {
+        "enabled": True, "capacity": 128, "sample_rate": 0.05,
+        "open_traces": 0,
+        "traces": [
+            {"trace_id": "bb" * 16, "root": "router.request",
+             "status": "deadline_exceeded", "retained": "error",
+             "duration_ms": 120.0, "spans": err_spans},
+            {"trace_id": "aa" * 16, "root": "router.request",
+             "status": "ok", "retained": "sampled",
+             "duration_ms": 25.0, "spans": ok_spans},
+        ],
+    }
+
+
+def _serve_traces(payload):
+    import json
+    import threading
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            assert self.path == "/debug/traces"
+            data = json.dumps(payload).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    return httpd
+
+
+def test_trace_list_renders_table(capsys):
+    """`kubeflow-tpu trace list` prints the retained traces of any
+    /debug/traces server (model server, router, or operator)."""
+    httpd = _serve_traces(_trace_payload())
+    try:
+        rc = cli.main([
+            "trace", "list", "--target",
+            f"http://127.0.0.1:{httpd.server_address[1]}"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "aa" * 16 in out and "bb" * 16 in out
+        assert "deadline_exceeded" in out and "error" in out
+        assert "router.request" in out
+    finally:
+        httpd.shutdown()
+
+
+def test_trace_show_renders_span_tree(capsys):
+    """`kubeflow-tpu trace show <id>` renders the span tree with
+    durations; a unique id prefix resolves."""
+    httpd = _serve_traces(_trace_payload())
+    try:
+        rc = cli.main([
+            "trace", "show", "aaaa", "--target",
+            f"http://127.0.0.1:{httpd.server_address[1]}"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        lines = out.splitlines()
+        assert lines[0].startswith(f"trace {'aa' * 16}")
+        assert "kept_by=sampled" in lines[0]
+        # Tree order and nesting: each hop indents under its parent.
+        idx = {name: next(i for i, ln in enumerate(lines)
+                          if name in ln)
+               for name in ("router.request", "router.forward",
+                            "server.predict", "engine.decode")}
+        assert idx["router.request"] < idx["router.forward"] \
+            < idx["server.predict"] < idx["engine.decode"]
+        fwd = lines[idx["router.forward"]]
+        srv = lines[idx["server.predict"]]
+        assert len(srv) - len(srv.lstrip()) \
+            > len(fwd) - len(fwd.lstrip())
+        assert "replica=srv-0" in out and "tokens=16" in out
+        assert "25.0ms" in out
+    finally:
+        httpd.shutdown()
+
+
+def test_trace_show_unknown_id_errors(capsys):
+    httpd = _serve_traces(_trace_payload())
+    try:
+        rc = cli.main([
+            "trace", "show", "ffff", "--target",
+            f"http://127.0.0.1:{httpd.server_address[1]}"])
+        assert rc == 1
+        assert "no retained trace" in capsys.readouterr().err
+    finally:
+        httpd.shutdown()

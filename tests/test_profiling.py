@@ -1,0 +1,41 @@
+"""runtime/profiling.py: the on-demand capture server must return the
+profiler server object on success and degrade with a WARNING (never a
+raise) when the port is taken or the backend lacks the profiler — it
+is an observability sidecar riding in the trainer/serving process."""
+
+import logging
+
+import jax
+import pytest
+
+from kubeflow_tpu.runtime import profiling
+
+
+class TestStartServer:
+    def test_returns_profiler_server_object(self, monkeypatch):
+        sentinel = object()
+        calls = []
+
+        def fake_start(port):
+            calls.append(port)
+            return sentinel
+
+        monkeypatch.setattr(jax.profiler, "start_server", fake_start)
+        assert profiling.start_server(9876) is sentinel
+        assert calls == [9876]
+
+    @pytest.mark.parametrize("exc", [
+        RuntimeError("Address already in use"),
+        NotImplementedError("profiler unavailable on this backend"),
+    ])
+    def test_failure_warns_and_returns_none(self, monkeypatch, caplog,
+                                            exc):
+        def fake_start(port):
+            raise exc
+
+        monkeypatch.setattr(jax.profiler, "start_server", fake_start)
+        with caplog.at_level(logging.WARNING,
+                             logger="kubeflow_tpu.runtime.profiling"):
+            assert profiling.start_server(9876) is None
+        assert any("unavailable" in rec.message
+                   for rec in caplog.records)

@@ -68,6 +68,55 @@ class TestRegistry:
         assert 'h_count{shard="a"} 0' in text
         assert 'h_count{shard="b"} 1' in text
 
+    def test_histogram_scrape_never_tears_sum_against_count(self):
+        # Torn-read audit: render() must snapshot a series' bucket
+        # counts AND its sum under the metric lock in one motion.  A
+        # concurrent observe() landing between the two reads would
+        # scrape a _count that disagrees with _sum — here every
+        # observation is exactly 1.0, so any honest scrape satisfies
+        # sum == count (and cumulative bucket monotonicity) no matter
+        # when it lands.
+        import threading
+
+        reg = Registry()
+        h = reg.histogram("t_seconds", "torn-read probe",
+                          buckets=(0.5, 2.0))
+        stop = threading.Event()
+
+        def writer():
+            while not stop.is_set():
+                h.observe(1.0, shard="w")
+
+        threads = [threading.Thread(target=writer) for _ in range(4)]
+        for t in threads:
+            t.start()
+        try:
+            for _ in range(200):
+                parsed = parse_metrics(reg.render())
+                count = sample_value(parsed, "t_seconds_count",
+                                     shard="w")
+                if count is None:
+                    continue  # nothing observed yet
+                total = sample_value(parsed, "t_seconds_sum",
+                                     shard="w")
+                assert total == count, (
+                    f"torn scrape: sum {total} != count {count} with "
+                    f"all-1.0 observations")
+                le_half = sample_value(parsed, "t_seconds_bucket",
+                                       shard="w", le="0.5")
+                le_two = sample_value(parsed, "t_seconds_bucket",
+                                      shard="w", le="2.0")
+                le_inf = sample_value(parsed, "t_seconds_bucket",
+                                      shard="w", le="+Inf")
+                assert le_half == 0
+                assert le_two == le_inf == count, (
+                    f"non-cumulative buckets: {le_two}/{le_inf} vs "
+                    f"count {count}")
+        finally:
+            stop.set()
+            for t in threads:
+                t.join()
+
 
 class TestParseMetrics:
     """parse_metrics is render's inverse for the three line shapes this

@@ -406,6 +406,52 @@ class TestGRPC:
         finally:
             server.stop(0)
 
+    def test_server_span_continues_client_traceparent(self, exported):
+        """The gRPC face reads ``traceparent`` from invocation
+        metadata: the server span joins the caller's trace (consistent
+        trace_id, parent = the caller's span id) and the admission
+        child span hangs under it."""
+        import grpc
+
+        from kubeflow_tpu.runtime import tracing
+        from kubeflow_tpu.serving import grpc_server as gs
+
+        base, _, _ = exported
+        srv = ModelServer()
+        srv.add_model("tiny", str(base))
+        server = gs.make_grpc_server(srv, port=0, host="127.0.0.1")
+        store = tracing.enable(sample_rate=1.0)
+        try:
+            channel = grpc.insecure_channel(
+                f"127.0.0.1:{server.bound_port}")
+            method = channel.unary_unary(
+                f"/{gs.SERVICE}/Predict",
+                request_serializer=(
+                    gs.pb.PredictRequest.SerializeToString),
+                response_deserializer=gs.pb.PredictResponse.FromString)
+            req = gs.pb.PredictRequest()
+            req.model_spec.name = "tiny"
+            rng = np.random.RandomState(9)
+            req.inputs["image"].CopyFrom(gs.numpy_to_tensor(
+                rng.randn(1, IMG, IMG, 3).astype(np.float32)))
+            trace_id = tracing.new_trace_id()
+            parent_id = tracing.new_span_id()
+            header = tracing.format_traceparent(trace_id, parent_id)
+            method(req, timeout=60,
+                   metadata=(("traceparent", header),))
+            channel.close()
+            traces = [t for t in store.traces()
+                      if t["trace_id"] == trace_id]
+            assert len(traces) == 1, store.traces()
+            spans = {s["name"]: s for s in traces[0]["spans"]}
+            assert spans["server.grpc_predict"]["parent_id"] \
+                == parent_id
+            assert spans["server.admission"]["parent_id"] \
+                == spans["server.grpc_predict"]["span_id"]
+        finally:
+            tracing.disable()
+            server.stop(0)
+
     def test_health_check_mirrors_readyz(self, exported):
         """grpc.health.v1 Check parity with /readyz: SERVING with a
         model loaded, NOT_SERVING once a drain begins — so the fleet

@@ -1,0 +1,685 @@
+"""Distributed request tracing: codec, span trees, tail sampling,
+store bounds, the /debug/traces HTTP surface, and the keep-alive 404
+guard for the /debug/* namespace (runtime/tracing.py + serving/http.py
++ fleet/router.py)."""
+
+import http.client
+import json
+import threading
+import time
+
+import pytest
+
+from kubeflow_tpu.runtime import tracing
+from kubeflow_tpu.testing import faults
+
+
+@pytest.fixture
+def enabled_store():
+    store = tracing.enable(sample_rate=1.0, capacity=16)
+    try:
+        yield store
+    finally:
+        tracing.disable()
+
+
+class TestTraceparentCodec:
+    def test_roundtrip(self):
+        trace_id, span_id = tracing.new_trace_id(), tracing.new_span_id()
+        header = tracing.format_traceparent(trace_id, span_id)
+        parsed = tracing.parse_traceparent(header)
+        assert parsed == (trace_id, span_id, 1)
+
+    def test_unsampled_flag(self):
+        header = tracing.format_traceparent("ab" * 16, "cd" * 8,
+                                            sampled=False)
+        assert tracing.parse_traceparent(header)[2] == 0
+
+    @pytest.mark.parametrize("bad", [
+        None, "", "garbage", "00-short-span-01",
+        "00-" + "0" * 32 + "-" + "ab" * 8 + "-01",   # all-zero trace
+        "00-" + "ab" * 16 + "-" + "0" * 16 + "-01",  # all-zero span
+        "ff-" + "ab" * 16 + "-" + "cd" * 8 + "-01",  # reserved version
+        "00-" + "zz" * 16 + "-" + "cd" * 8 + "-01",  # non-hex
+    ])
+    def test_malformed_is_none_not_raise(self, bad):
+        assert tracing.parse_traceparent(bad) is None
+
+    def test_extract_needs_enabled_tracer(self):
+        tracing.disable()
+        header = tracing.format_traceparent("ab" * 16, "cd" * 8)
+        assert tracing.extract({"traceparent": header}) is None
+
+    def test_extract_marks_context_remote(self, enabled_store):
+        header = tracing.format_traceparent("ab" * 16, "cd" * 8)
+        ctx = tracing.extract({"traceparent": header})
+        assert ctx is not None and ctx.remote
+        assert ctx.trace_id == "ab" * 16
+
+
+class TestDisabledIsFree:
+    def test_all_entry_points_noop(self):
+        tracing.disable()
+        span = tracing.start_span("x")
+        assert span is tracing.NULL_SPAN
+        assert not span
+        span.annotate(a=1)
+        span.end(status="error")
+        assert tracing.current_ctx() is None
+        assert tracing.record_span(
+            "y", tracing.SpanContext("a" * 32, "b" * 16), 0.0, 1.0
+        ) is None
+        assert tracing.new_root_ctx() is None
+        assert tracing.snapshot() == {"enabled": False, "traces": []}
+
+
+class TestSpansAndSampling:
+    def test_child_spans_share_trace_and_parent(self, enabled_store):
+        root = tracing.start_span("root")
+        child = tracing.start_span("child", parent=root)
+        assert child.trace_id == root.trace_id
+        assert child.parent_id == root.span_id
+        child.end()
+        root.end()
+        traces = enabled_store.traces()
+        assert len(traces) == 1
+        spans = {s["name"]: s for s in traces[0]["spans"]}
+        assert spans["child"]["parent_id"] == spans["root"]["span_id"]
+        assert spans["root"]["parent_id"] is None
+
+    def test_current_ctx_via_use_span(self, enabled_store):
+        assert tracing.current_ctx() is None
+        span = tracing.start_span("server")
+        with tracing.use_span(span):
+            ctx = tracing.current_ctx()
+            assert ctx is not None
+            assert ctx.span_id == span.span_id
+        assert tracing.current_ctx() is None
+
+    def test_remote_parent_makes_local_root(self, enabled_store):
+        header = tracing.format_traceparent(tracing.new_trace_id(),
+                                            tracing.new_span_id())
+        ctx = tracing.extract({"traceparent": header})
+        span = tracing.start_span("server.predict", parent=ctx)
+        span.end(status="ok")
+        # The local root's end completed the trace (sample_rate 1.0).
+        assert len(enabled_store.traces()) == 1
+
+    def test_error_always_retained_at_zero_sample_rate(self):
+        store = tracing.enable(sample_rate=0.0)
+        try:
+            for _ in range(5):
+                tracing.start_span("ok-request").end(status="ok")
+            assert store.traces() == []
+            tracing.start_span("bad-request").end(
+                status="deadline_exceeded")
+            traces = store.traces()
+            assert len(traces) == 1
+            assert traces[0]["retained"] == "error"
+            assert traces[0]["status"] == "deadline_exceeded"
+        finally:
+            tracing.disable()
+
+    def test_slow_traces_kept_by_rolling_threshold(self):
+        store = tracing.TraceStore(sample_rate=0.0,
+                                   min_slow_samples=4)
+        for i in range(8):
+            store.complete(f"{i:032x}", "ok", 0.01)
+        assert len(store) == 0
+        tid = "ab" * 16
+        store.add({"trace_id": tid, "span_id": "cd" * 8,
+                   "parent_id": None, "name": "slow", "start_s": 0.0,
+                   "duration_ms": 2000.0, "status": "ok", "attrs": {}})
+        assert store.complete(tid, "ok", 2.0) == "slow"
+        assert store.traces()[0]["retained"] == "slow"
+
+    def test_threshold_window_ages_on_policy_clock(self):
+        with faults.injected("seed=1") as inj:
+            store = tracing.TraceStore(sample_rate=0.0,
+                                       min_slow_samples=4,
+                                       slow_window_s=30.0)
+            for i in range(8):
+                store.complete(f"{i:032x}", "ok", 0.01)
+            inj.advance_clock(60)  # the whole window expires
+            # Below min samples again: nothing qualifies as slow.
+            assert store.complete("ab" * 16, "ok", 5.0) is None
+
+    def test_store_capacity_bounded(self):
+        store = tracing.TraceStore(capacity=4, sample_rate=0.0)
+        for i in range(10):
+            store.complete(f"{i:032x}", "error", 0.01)
+        assert len(store) == 4
+        newest = store.traces()[0]["trace_id"]
+        assert newest == f"{9:032x}"
+
+    def test_spans_per_trace_bounded(self, enabled_store):
+        enabled_store.max_spans_per_trace = 3
+        root = tracing.start_span("root")
+        for i in range(6):
+            tracing.start_span(f"c{i}", parent=root).end()
+        root.end()
+        spans = enabled_store.traces()[0]["spans"]
+        assert len(spans) == 3
+
+    def test_late_spans_append_to_retained_trace(self, enabled_store):
+        # The hermetic-fleet shape: the replica's local root completes
+        # the trace first; the router's spans arrive after and must
+        # still land in the kept entry.
+        root = tracing.start_span("router.request")
+        fwd = tracing.start_span("router.forward", parent=root)
+        ctx = tracing.extract({"traceparent": fwd.traceparent()})
+        server = tracing.start_span("server.predict", parent=ctx)
+        server.end(status="ok")        # completes (sample_rate 1.0)
+        fwd.end(status="ok")
+        root.end(status="ok")
+        traces = enabled_store.traces()
+        assert len(traces) == 1
+        names = {s["name"] for s in traces[0]["spans"]}
+        assert names == {"router.request", "router.forward",
+                         "server.predict"}
+
+    def test_record_span_stamps_perf_readings(self, enabled_store):
+        ctx = tracing.new_root_ctx()
+        t0 = time.perf_counter()
+        tracing.record_span("child", ctx, t0, t0 + 0.25,
+                            attrs={"k": "v"})
+        tracing.record_span("root", ctx, t0, t0 + 0.5, root=True)
+        trace = enabled_store.traces()[0]
+        spans = {s["name"]: s for s in trace["spans"]}
+        assert spans["child"]["duration_ms"] == 250.0
+        assert spans["child"]["parent_id"] == ctx.span_id
+        assert spans["root"]["span_id"] == ctx.span_id
+        assert spans["root"]["parent_id"] is None
+
+    def test_trace_metrics_exported(self):
+        from kubeflow_tpu.runtime.prom import REGISTRY, parse_metrics
+
+        store = tracing.enable(sample_rate=0.0)
+        try:
+            tracing.start_span("boom").end(status="error")
+            assert len(store) == 1
+        finally:
+            tracing.disable()
+        parsed = parse_metrics(REGISTRY.render())
+        assert "kft_trace_spans_total" in parsed
+        assert any(labels.get("reason") == "error"
+                   for labels, _ in parsed["kft_trace_retained_total"])
+        assert "kft_trace_store_traces" in parsed
+
+
+class TestDebugRoutes:
+    """/debug/traces on the serving REST port + the keep-alive 404
+    guard extended to the /debug/* namespace."""
+
+    @pytest.fixture
+    def http_server(self):
+        from kubeflow_tpu.serving.http import make_http_server
+        from kubeflow_tpu.serving.model_server import ModelServer
+
+        server = ModelServer()
+        httpd, _ = make_http_server(server, port=0, host="127.0.0.1")
+        try:
+            yield httpd.server_address[1]
+        finally:
+            httpd.shutdown()
+            server.stop()
+
+    def test_debug_traces_route(self, http_server):
+        store = tracing.enable(sample_rate=1.0)
+        try:
+            tracing.start_span("probe").end()
+            assert len(store) == 1
+            conn = http.client.HTTPConnection("127.0.0.1", http_server,
+                                              timeout=30)
+            conn.request("GET", "/debug/traces")
+            resp = conn.getresponse()
+            payload = json.loads(resp.read())
+            conn.close()
+        finally:
+            tracing.disable()
+        assert resp.status == 200
+        assert payload["enabled"] is True
+        assert payload["traces"][0]["root"] == "probe"
+
+    def test_debug_traces_disabled_still_answers(self, http_server):
+        tracing.disable()
+        conn = http.client.HTTPConnection("127.0.0.1", http_server,
+                                          timeout=30)
+        conn.request("GET", "/debug/traces")
+        resp = conn.getresponse()
+        payload = json.loads(resp.read())
+        conn.close()
+        assert resp.status == 200
+        assert payload == {"enabled": False, "traces": []}
+
+    def test_unknown_debug_route_404_and_keepalive_survives(
+            self, http_server):
+        # A POST with a body to an unknown /debug/* path must answer
+        # 404 JSON with the body DRAINED: on this same keep-alive
+        # connection an unread body would be parsed as the next
+        # request line, desyncing everything after it.
+        conn = http.client.HTTPConnection("127.0.0.1", http_server,
+                                          timeout=30)
+        body = json.dumps({"pad": "x" * 4096}).encode()
+        conn.request("POST", "/debug/nonexistent", body=body)
+        resp = conn.getresponse()
+        payload = json.loads(resp.read())
+        assert resp.status == 404
+        assert "no route" in payload["error"]
+        # Same connection, next request: still in sync.
+        conn.request("GET", "/healthz")
+        resp2 = conn.getresponse()
+        assert resp2.status == 200
+        assert json.loads(resp2.read())["status"] == "ok"
+        conn.close()
+
+
+class TestConcurrentStore:
+    def test_parallel_span_recording_consistent(self, enabled_store):
+        errors = []
+
+        def worker(i):
+            try:
+                for j in range(20):
+                    root = tracing.start_span(f"w{i}-{j}")
+                    tracing.start_span("child", parent=root).end()
+                    root.end(status="ok")
+            except Exception as exc:  # noqa: BLE001 — surfaced below
+                errors.append(exc)
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors
+        # Capacity bound held under concurrency.
+        assert len(enabled_store) <= enabled_store.capacity
+        for trace in enabled_store.traces():
+            assert len(trace["spans"]) <= 2
+
+
+class TestJobLifecycleTraces:
+    """operator/reconciler.py stamps one trace per TPUJob — a span per
+    phase dwelled in, the root at the terminal transition — into the
+    same tail-sampled store the serving path uses (served on the
+    operator's metrics port)."""
+
+    def _run_job(self, kube, controller, namespace="kubeflow-test"):
+        from kubeflow_tpu.operator.kube import RUNNING, SUCCEEDED
+        from kubeflow_tpu.operator.reconciler import (
+            JOB_RUNNING,
+            JOB_SUCCEEDED,
+        )
+
+        cr = kube.list_custom()[0]
+        controller.reconcile_once(cr)
+        for pod in kube.list_pods(namespace):
+            kube.set_pod_phase(namespace, pod["metadata"]["name"],
+                               RUNNING)
+        assert controller.reconcile_once(cr) == JOB_RUNNING
+        for pod in kube.list_pods(namespace):
+            kube.set_pod_phase(namespace, pod["metadata"]["name"],
+                               SUCCEEDED)
+        assert controller.reconcile_once(cr) == JOB_SUCCEEDED
+
+    def test_phase_spans_and_terminal_root(self, enabled_store):
+        from kubeflow_tpu.operator import crd
+        from kubeflow_tpu.operator.gang import GangScheduler
+        from kubeflow_tpu.operator.kube import FakeKube
+        from kubeflow_tpu.operator.reconciler import TPUJobController
+
+        kube = FakeKube()
+        controller = TPUJobController(kube, GangScheduler({"v5e-8": 1}))
+        job = crd.TPUJobSpec(name="traced", namespace="kubeflow-test",
+                             slice_type="v5e-8")
+        kube.create_custom(job.to_custom_resource())
+        self._run_job(kube, controller)
+        traces = [t for t in enabled_store.traces()
+                  if any(s["name"] == "job.lifecycle"
+                         for s in t["spans"])]
+        assert len(traces) == 1
+        spans = {s["name"]: s for s in traces[0]["spans"]}
+        assert {"job.Starting", "job.Running",
+                "job.lifecycle"} <= set(spans)
+        root = spans["job.lifecycle"]
+        assert root["status"] == "ok"
+        assert root["attrs"]["phase"] == "Succeeded"
+        assert spans["job.Running"]["attrs"]["to"] == "Succeeded"
+        assert spans["job.Starting"]["parent_id"] == root["span_id"]
+        # Terminal jobs keep a DONE tombstone (pruned when the CR
+        # vanishes): a later re-stamp of the same terminal phase must
+        # not mint a second trace.
+        tomb = controller._job_traces["kubeflow-test/traced"]
+        assert tomb["done"] is True
+
+    def test_failed_job_always_retained(self):
+        from kubeflow_tpu.operator import crd
+        from kubeflow_tpu.operator.gang import GangScheduler
+        from kubeflow_tpu.operator.kube import FakeKube
+        from kubeflow_tpu.operator.reconciler import TPUJobController
+
+        store = tracing.enable(sample_rate=0.0)
+        try:
+            kube = FakeKube()
+            controller = TPUJobController(kube,
+                                          GangScheduler({"v5e-8": 1}))
+            cr = crd.TPUJobSpec(
+                name="bad", namespace="kubeflow-test",
+                slice_type="v5e-8").to_custom_resource()
+            cr["spec"]["sliceType"] = "not-a-slice"  # InvalidSpec
+            kube.create_custom(cr)
+            controller.reconcile_all()
+            traces = store.traces()
+            assert len(traces) == 1
+            assert traces[0]["retained"] == "error"
+            root = [s for s in traces[0]["spans"]
+                    if s["name"] == "job.lifecycle"][0]
+            assert root["attrs"]["phase"] == "Failed"
+            assert root["attrs"]["reason"] == "InvalidSpec"
+        finally:
+            tracing.disable()
+
+    def test_scheduler_plan_span_recorded(self, enabled_store):
+        from kubeflow_tpu.operator.gang import GangScheduler
+        from kubeflow_tpu.scheduler import ClusterScheduler
+
+        cluster = ClusterScheduler(GangScheduler({"v5e-8": 1}))
+        cluster.plan([])
+        names = [t["root"] for t in enabled_store.traces()]
+        assert "scheduler.plan" in names
+
+
+class TestBatcherSpans:
+    def test_queue_wait_and_dispatch_spans(self, enabled_store):
+        import numpy as np
+
+        from kubeflow_tpu.serving.model_server import MicroBatcher
+
+        batcher = MicroBatcher(
+            lambda inputs: {"y": np.asarray(inputs["x"]) + 1},
+            max_batch_size=2, batch_timeout_s=0.001, name="traced")
+        try:
+            span = tracing.start_span("server.predict")
+            with tracing.use_span(span):
+                out = batcher.submit({"x": np.zeros((1, 2))})
+            span.end()
+        finally:
+            batcher.close()
+        np.testing.assert_allclose(out["y"], 1.0)
+        trace = enabled_store.traces()[0]
+        spans = {s["name"]: s for s in trace["spans"]}
+        assert {"batcher.queue_wait", "batcher.dispatch",
+                "server.predict"} <= set(spans)
+        assert spans["batcher.dispatch"]["attrs"]["batcher"] \
+            == "traced"
+        assert spans["batcher.queue_wait"]["parent_id"] \
+            == spans["server.predict"]["span_id"]
+
+    def test_untraced_submissions_record_nothing(self, enabled_store):
+        import numpy as np
+
+        from kubeflow_tpu.serving.model_server import MicroBatcher
+
+        batcher = MicroBatcher(
+            lambda inputs: {"y": np.asarray(inputs["x"])},
+            max_batch_size=2, batch_timeout_s=0.001, name="quiet")
+        try:
+            # No current span context: entries carry trace=None and no
+            # span site fires, even with the tracer globally enabled.
+            batcher.submit({"x": np.zeros((1, 2))})
+        finally:
+            batcher.close()
+        assert enabled_store.traces() == []
+
+
+class TestReviewRegressions:
+    def test_extract_case_insensitive_on_plain_dicts(
+            self, enabled_store):
+        # HTTP header names are case-insensitive on the wire and
+        # proxies commonly re-case them; the router hands extract() a
+        # plain dict with the sender's casing preserved.
+        header = tracing.format_traceparent("ab" * 16, "cd" * 8)
+        ctx = tracing.extract({"Traceparent": header})
+        assert ctx is not None and ctx.trace_id == "ab" * 16
+
+    def test_slow_windows_are_per_root_name(self):
+        # One store holds heterogeneous trace kinds: a fast kind's
+        # rolling window (e.g. scheduler.plan micro-passes) must not
+        # set the threshold a slow kind (job.lifecycle) is judged
+        # against — that would retain 100% of healthy slow-kind
+        # traces as "slow", defeating the sample-rate knob.
+        store = tracing.TraceStore(sample_rate=0.0,
+                                   min_slow_samples=4)
+        for i in range(32):
+            store.complete(f"{i:032x}", "ok", 0.0001,
+                           name="scheduler.plan")
+        assert store.complete("ab" * 16, "ok", 30.0,
+                              name="job.lifecycle") is None
+        # ...while within ONE name the threshold still works.
+        for i in range(32, 48):
+            store.complete(f"{i:032x}", "ok", 1.0,
+                           name="job.lifecycle")
+        assert store.complete("cd" * 16, "ok", 30.0,
+                              name="job.lifecycle") == "slow"
+
+    def test_router_crash_still_completes_trace_as_error(
+            self, enabled_store, monkeypatch):
+        from kubeflow_tpu.fleet.endpoints import (
+            EndpointRegistry,
+            StaticEndpoints,
+        )
+        from kubeflow_tpu.fleet.router import FleetRouter
+
+        router = FleetRouter(
+            EndpointRegistry(StaticEndpoints.from_urls([])))
+        monkeypatch.setattr(
+            router, "_route",
+            lambda *a, **k: (_ for _ in ()).throw(
+                RuntimeError("boom")))
+        with pytest.raises(RuntimeError):
+            router.handle("POST", "/model/lm:predict", b"{}", {})
+        traces = enabled_store.traces()
+        assert len(traces) == 1
+        assert traces[0]["status"] == "error"
+        assert traces[0]["retained"] == "error"
+
+    def test_deleted_job_trace_state_pruned(self, enabled_store):
+        from kubeflow_tpu.operator import crd
+        from kubeflow_tpu.operator.gang import GangScheduler
+        from kubeflow_tpu.operator.kube import FakeKube
+        from kubeflow_tpu.operator.reconciler import TPUJobController
+
+        kube = FakeKube()
+        controller = TPUJobController(kube, GangScheduler({"v5e-8": 1}))
+        job = crd.TPUJobSpec(name="doomed", namespace="kubeflow-test",
+                             slice_type="v5e-8")
+        kube.create_custom(job.to_custom_resource())
+        controller.reconcile_all()  # Queued/Starting — non-terminal
+        assert "kubeflow-test/doomed" in controller._job_traces
+        # CR deleted mid-run: no terminal transition will ever come.
+        kube.delete_custom("kubeflow-test", "doomed")
+        controller.reconcile_all()
+        assert controller._job_traces == {}
+
+
+class TestSecondReviewRegressions:
+    def test_invalid_cr_stamps_one_trace_not_one_per_sweep(self):
+        # A permanently invalid CR re-enters the Failed path EVERY
+        # reconcile sweep (spec parse fails before the terminal
+        # short-circuit); one bad CR must not LRU-flush the operator
+        # store with a fresh error-retained trace per sweep.
+        from kubeflow_tpu.operator import crd
+        from kubeflow_tpu.operator.gang import GangScheduler
+        from kubeflow_tpu.operator.kube import FakeKube
+        from kubeflow_tpu.operator.reconciler import TPUJobController
+
+        store = tracing.enable(sample_rate=0.0)
+        try:
+            kube = FakeKube()
+            controller = TPUJobController(kube,
+                                          GangScheduler({"v5e-8": 1}))
+            cr = crd.TPUJobSpec(
+                name="bad", namespace="kubeflow-test",
+                slice_type="v5e-8").to_custom_resource()
+            cr["spec"]["sliceType"] = "not-a-slice"
+            kube.create_custom(cr)
+            for _ in range(5):
+                controller.reconcile_all()
+            assert len(store.traces()) == 1, [
+                t["trace_id"] for t in store.traces()]
+        finally:
+            tracing.disable()
+
+    def test_client_fault_statuses_sample_like_ok(self):
+        # 404/400 answers are not incidents: at sample rate 0 they
+        # keep NOTHING, while genuine error statuses still always
+        # keep — a scanner probing junk model names must not evict
+        # incident traces.
+        store = tracing.enable(sample_rate=0.0)
+        try:
+            tracing.start_span("server.predict").end(
+                status="not_found")
+            tracing.start_span("server.predict").end(
+                status="invalid_argument")
+            assert store.traces() == []
+            tracing.start_span("server.predict").end(status="shed")
+            assert [t["retained"] for t in store.traces()] == ["error"]
+        finally:
+            tracing.disable()
+
+    def test_http_unknown_model_trace_not_error_retained(self):
+        import urllib.error
+        import urllib.request
+
+        from kubeflow_tpu.serving.http import make_http_server
+        from kubeflow_tpu.serving.model_server import ModelServer
+
+        server = ModelServer()
+        httpd, _ = make_http_server(server, port=0, host="127.0.0.1")
+        store = tracing.enable(sample_rate=0.0)
+        try:
+            port = httpd.server_address[1]
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/model/nope:predict",
+                data=b'{"instances": [[1]]}')
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(req, timeout=30)
+            assert err.value.code == 404
+            assert store.traces() == [], (
+                "a 404 answer must not ride the always-keep tier")
+        finally:
+            tracing.disable()
+            httpd.shutdown()
+            server.stop()
+
+
+class TestRetentionPolicyRegressions:
+    def test_eviction_prefers_sampled_over_error_traces(self):
+        # Sustained healthy sampled traffic must not flush incident
+        # traces out of the bounded store: on overflow, sampled
+        # traces evict first, error-retained ones only when nothing
+        # else remains.
+        store = tracing.TraceStore(capacity=4, sample_rate=1.0)
+        for i in range(2):
+            store.complete(f"{i:032x}", "deadline_exceeded", 0.01)
+        for i in range(2, 20):
+            store.complete(f"{i:032x}", "ok", 0.01)
+        kept = store.traces()
+        errors = [t for t in kept if t["retained"] == "error"]
+        assert len(kept) == 4
+        assert len(errors) == 2, (
+            f"healthy traffic evicted incident traces: "
+            f"{[(t['trace_id'], t['retained']) for t in kept]}")
+
+    def test_open_trace_age_refreshes_on_new_spans(self):
+        # Aging reaps traces whose root will never complete; a trace
+        # still ACCUMULATING spans is alive and must keep them all.
+        with faults.injected("seed=1") as inj:
+            store = tracing.TraceStore(sample_rate=1.0,
+                                       max_open_age_s=100.0)
+            ctx = tracing.SpanContext("ab" * 16, "cd" * 8)
+            for i in range(5):
+                store.add({"trace_id": ctx.trace_id,
+                           "span_id": f"{i:016x}", "parent_id": None,
+                           "name": f"s{i}", "start_s": 0.0,
+                           "duration_ms": 1.0, "status": "ok",
+                           "attrs": {}})
+                inj.advance_clock(60)  # > age/5 apart, < age total
+            store.complete(ctx.trace_id, "ok", 300.0)
+            assert len(store.traces()[0]["spans"]) == 5
+
+    def test_long_running_job_keeps_all_phase_spans(self):
+        # The reconciler buffers phase spans in controller memory and
+        # stamps the WHOLE trace at the terminal transition, so a job
+        # Running far past the store's open-trace age still shows its
+        # Queued/Starting/Running timeline.
+        from kubeflow_tpu.operator import crd
+        from kubeflow_tpu.operator.gang import GangScheduler
+        from kubeflow_tpu.operator.kube import (
+            RUNNING,
+            SUCCEEDED,
+            FakeKube,
+        )
+        from kubeflow_tpu.operator.reconciler import TPUJobController
+
+        with faults.injected("seed=1") as inj:
+            store = tracing.enable(sample_rate=1.0,
+                                   max_open_age_s=60.0)
+            try:
+                kube = FakeKube()
+                controller = TPUJobController(
+                    kube, GangScheduler({"v5e-8": 1}))
+                job = crd.TPUJobSpec(name="marathon",
+                                     namespace="kubeflow-test",
+                                     slice_type="v5e-8")
+                kube.create_custom(job.to_custom_resource())
+                cr = kube.list_custom()[0]
+                controller.reconcile_once(cr)
+                for pod in kube.list_pods("kubeflow-test"):
+                    kube.set_pod_phase("kubeflow-test",
+                                       pod["metadata"]["name"],
+                                       RUNNING)
+                controller.reconcile_once(cr)
+                # The job runs WAY past the open-trace age (policy
+                # clock; other traffic may sweep the open buffer).
+                inj.advance_clock(7200)
+                store.complete("ff" * 16, "ok", 0.01)  # sweep trigger
+                for pod in kube.list_pods("kubeflow-test"):
+                    kube.set_pod_phase("kubeflow-test",
+                                       pod["metadata"]["name"],
+                                       SUCCEEDED)
+                controller.reconcile_once(cr)
+                trace = next(
+                    t for t in store.traces()
+                    if any(s["name"] == "job.lifecycle"
+                           for s in t["spans"]))
+                names = {s["name"] for s in trace["spans"]}
+                assert {"job.Starting", "job.Running",
+                        "job.lifecycle"} <= names, names
+            finally:
+                tracing.disable()
+
+
+class TestErroredRootUnderDroppedId:
+    def test_error_outranks_drop_memory(self):
+        # A client reusing ONE traceparent across requests: request 1
+        # samples out (trace_id lands in the drop memory), request 2
+        # errors under the same id — the always-keep tier must still
+        # capture it.
+        store = tracing.enable(sample_rate=0.0)
+        try:
+            header = tracing.format_traceparent("ab" * 16, "cd" * 8)
+            ctx = tracing.extract({"traceparent": header})
+            tracing.start_span("server.predict", parent=ctx).end(
+                status="ok")          # dropped (rate 0)
+            assert store.traces() == []
+            tracing.start_span("server.predict", parent=ctx).end(
+                status="deadline_exceeded")
+            kept = store.traces()
+            assert len(kept) == 1
+            assert kept[0]["retained"] == "error"
+            assert kept[0]["trace_id"] == "ab" * 16
+        finally:
+            tracing.disable()
