@@ -37,7 +37,13 @@ POLICY_PREFIXES = ("kubeflow_tpu/serving/", "kubeflow_tpu/fleet/",
                    # other deadline/backoff site.  Exact file, not a
                    # stem prefix: a future tracing_*.py sibling is not
                    # automatically a policy module.
-                   "kubeflow_tpu/runtime/tracing.py")
+                   "kubeflow_tpu/runtime/tracing.py",
+                   # The training supervisor's restart backoff, stall
+                   # thresholds, and heartbeat ages are policy too —
+                   # the skewed-clock stall/backoff tests only mean
+                   # anything if every deadline here reads the policy
+                   # clock.
+                   "kubeflow_tpu/runtime/supervisor.py")
 
 _BANNED = {"monotonic", "time"}
 

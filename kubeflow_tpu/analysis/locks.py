@@ -17,8 +17,9 @@ Mechanics (deliberately flow-insensitive — one AST walk per class):
     (``_take_batch_locked``, ``_prune_locked``, ...);
   * a write is an assignment/augmented assignment to ``self.<attr>``
     (container mutation through method calls is out of scope);
-  * ``__init__``/``__new__`` writes are construction before
-    publication and never count, in either direction.
+  * ``__init__``/``__new__``/``__post_init__`` writes are construction
+    before publication and never count, in either direction (dataclass
+    classes construct in ``__post_init__``).
 
 False positives (a write provably single-threaded at that point, e.g.
 after every worker joined) suppress with ``# kft: allow=lock-guard``
@@ -35,7 +36,7 @@ from kubeflow_tpu.analysis.core import Finding
 
 CHECK = "lock-guard"
 
-_CTOR = {"__init__", "__new__"}
+_CTOR = {"__init__", "__new__", "__post_init__"}
 
 
 def _is_self_lock(expr: ast.expr) -> bool:

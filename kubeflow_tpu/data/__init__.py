@@ -6,6 +6,7 @@ ctypes with a pure-python fallback.
 """
 
 from kubeflow_tpu.data.loader import (
+    DataError,
     RecordDataset,
     RecordWriter,
     decode_example,
@@ -16,6 +17,7 @@ from kubeflow_tpu.data.loader import (
 )
 
 __all__ = [
+    "DataError",
     "RecordDataset",
     "RecordWriter",
     "decode_example",

@@ -22,6 +22,7 @@ import ctypes
 import io
 import logging
 import os
+import random
 import struct
 import subprocess
 import threading
@@ -30,7 +31,17 @@ from typing import Dict, Iterable, Iterator, List, Optional, Sequence
 
 import numpy as np
 
+from kubeflow_tpu.testing import faults
+
 log = logging.getLogger(__name__)
+
+
+class DataError(RuntimeError):
+    """The input pipeline failed past its transient-retry budget.
+
+    The typed signal the training supervisor
+    (runtime/supervisor.py) converts into a supervised restart —
+    distinguishable from a programming error, which propagates raw."""
 
 MAGIC = b"KFTR\x01"
 _NATIVE_SRC = Path(__file__).parent / "native" / "kft_data.cc"
@@ -487,7 +498,8 @@ def count_records(path: str | Path) -> int:
 
 
 class TensorBatches:
-    """Iterator over Trainer-shaped batches with a resume fast-path.
+    """Iterator over Trainer-shaped batches with a resume fast-path
+    and transient-error retry.
 
     ``seek(n_steps)`` (the contract Trainer.fit probes for on resume)
     skips n_steps batches before the first yield.  For an unshuffled
@@ -495,14 +507,39 @@ class TensorBatches:
     shard files (payloads are fseek'd over, epochs wrap); shuffled or
     plain-iterable datasets fall back to draining batches — correct,
     just no faster than the replay Trainer.fit would otherwise do.
+
+    Retry: each batch pull runs behind the ``data.next`` fault hook;
+    transient read errors (IOError/OSError, or an injected fault) are
+    retried with capped jittered backoff on the policy clock, the
+    underlying iterator rebuilt and re-aligned past the batches
+    already yielded.  ``retries`` consecutive failures exhaust the
+    budget and raise :class:`DataError` — the typed signal the
+    training supervisor converts into a supervised restart.
+
+    Rebuild-retry applies ONLY to :class:`RecordDataset` sources —
+    they re-iterate from their files, so a fresh stream plus a
+    count-skip re-aligns exactly (python-order streams; the threaded
+    native core re-aligns by count, its interleaving is not
+    order-deterministic).  A plain one-shot iterable cannot be
+    rebuilt: resuming a half-consumed generator and then skip-
+    draining it would silently DROP data, so for those the error
+    propagates raw and recovery belongs to the supervisor's
+    data_factory (a fresh iterable per attempt).
     """
 
     def __init__(self, dataset, batch_size: int,
-                 drop_remainder: bool = True):
+                 drop_remainder: bool = True, *,
+                 retries: int = 4,
+                 retry_backoff_s: float = 0.5,
+                 retry_backoff_max_s: float = 5.0):
         self._dataset = dataset
         self._batch_size = batch_size
         self._drop = drop_remainder
         self._skip_steps = 0
+        self._retries = retries
+        self._retry_backoff_s = retry_backoff_s
+        self._retry_backoff_max_s = retry_backoff_max_s
+        self._rng = random.Random()
 
     def seek(self, n_steps: int) -> None:
         if n_steps < 0:
@@ -581,18 +618,64 @@ class TensorBatches:
         yield from _stack_payloads(remaining_payloads(),
                                    self._batch_size, self._drop)
 
+    def _iter_from(self, skip: int) -> Iterator[Dict[str, np.ndarray]]:
+        """The pre-retry iteration logic: one batch stream starting
+        ``skip`` batches in (fast header-walk skip when legal)."""
+        if skip and self._fast_skippable():
+            yield from self._fast_skip(skip * self._batch_size)
+            return
+        it = self._batches()
+        for _ in range(skip):
+            next(it, None)
+        yield from it
+
+    def _retry_wait(self, attempt: int) -> None:
+        """Capped jittered exponential backoff, expired on the policy
+        clock (``faults.policy_backoff``) so clock-skew scenarios
+        cover it without wall sleeping."""
+        faults.policy_backoff(attempt, self._retry_backoff_s,
+                              self._retry_backoff_max_s, self._rng,
+                              poll_s=0.02)
+
     def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
         # Lazy: Trainer.fit calls iter() BEFORE seek(); the skip amount
         # is read when the first batch is pulled.
+        retryable = isinstance(self._dataset, RecordDataset)
+
         def run():
-            skip = self._skip_steps
-            if skip and self._fast_skippable():
-                yield from self._fast_skip(skip * self._batch_size)
-                return
-            it = self._batches()
-            for _ in range(skip):
-                next(it, None)
-            yield from it
+            yielded = 0
+            attempts = 0
+            while True:
+                try:
+                    it = self._iter_from(self._skip_steps + yielded)
+                    while True:
+                        # The deterministic transient-fault site: a
+                        # scripted raise here models one failed read.
+                        faults.fire("data.next")
+                        try:
+                            batch = next(it)
+                        except StopIteration:
+                            return
+                        yield batch
+                        yielded += 1
+                        attempts = 0  # budget is CONSECUTIVE failures
+                except DataError:
+                    raise
+                except (IOError, OSError, faults.FaultInjected) as e:
+                    if not retryable:
+                        raise  # one-shot iterable: see class docstring
+                    attempts += 1
+                    if attempts > self._retries:
+                        raise DataError(
+                            f"input pipeline failed {attempts} "
+                            f"consecutive times (retry budget "
+                            f"{self._retries}): {e}") from e
+                    log.warning(
+                        "transient data fault (attempt %d/%d), "
+                        "rebuilding the batch stream at batch %d: %s",
+                        attempts, self._retries,
+                        self._skip_steps + yielded, e)
+                    self._retry_wait(attempts)
 
         return run()
 
@@ -602,6 +685,9 @@ def tensor_batches(
     batch_size: int,
     *,
     drop_remainder: bool = True,
+    retries: int = 4,
+    retry_backoff_s: float = 0.5,
+    retry_backoff_max_s: float = 5.0,
 ) -> TensorBatches:
     """Decode + stack payloads into Trainer-shaped batches.
 
@@ -609,9 +695,14 @@ def tensor_batches(
     (decode + assembly in C++); any other payload iterable uses the
     python decode/stack loop.  The returned iterator supports
     ``seek(n_steps)`` — Trainer.fit's resume fast-path (decode-free
-    header-walk skip for unshuffled record datasets).
+    header-walk skip for unshuffled record datasets) — and retries
+    transient read errors behind the ``data.next`` fault hook (see
+    :class:`TensorBatches`).
     """
-    return TensorBatches(dataset, batch_size, drop_remainder)
+    return TensorBatches(dataset, batch_size, drop_remainder,
+                         retries=retries,
+                         retry_backoff_s=retry_backoff_s,
+                         retry_backoff_max_s=retry_backoff_max_s)
 
 
 def write_example_shards(

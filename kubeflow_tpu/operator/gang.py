@@ -16,7 +16,8 @@ from __future__ import annotations
 
 import dataclasses
 import threading
-from typing import Dict, List, Optional
+from collections import deque
+from typing import Deque, Dict, List, Optional
 
 from kubeflow_tpu.testing import faults
 
@@ -134,3 +135,70 @@ class GangScheduler:
             if not waits:
                 return None
             return waits[len(waits) // 2]
+
+
+class NodeQuarantine:
+    """Failure-domain attribution for gang placement: a node that eats
+    repeated ``WorkerFailed`` pods within a sliding window is
+    quarantined for a cooldown.
+
+    TPU slices are indivisible, so one flapping host kills the WHOLE
+    gang every restart — without attribution the job burns its entire
+    restart budget on the same bad hardware (the failure mode
+    heterogeneity-aware schedulers assume away: Gavel-style policies
+    expect jobs that detect bad nodes and restart cheaply).  The
+    reconciler notes each failed pod's ``spec.nodeName`` here; once a
+    node accumulates ``threshold`` failures inside ``window_s``, it is
+    excluded from placement (node anti-affinity on every pod the
+    reconciler creates) until ``cooldown_s`` elapses.  All timing is
+    on the policy clock (``faults.monotonic``), so flap/cooldown
+    scenarios run in microseconds under seeded skew.
+    """
+
+    def __init__(self, *, threshold: int = 3, window_s: float = 600.0,
+                 cooldown_s: float = 1800.0):
+        self._lock = threading.Lock()
+        self.threshold = threshold
+        self.window_s = window_s
+        self.cooldown_s = cooldown_s
+        self._failures: Dict[str, Deque[float]] = {}
+        self._until: Dict[str, float] = {}
+
+    def note_failure(self, node: str) -> bool:
+        """Record one worker failure attributed to ``node``.  Returns
+        True exactly when this failure TRIPS the quarantine (the
+        caller records the event once, not per failure)."""
+        if not node:
+            return False  # unscheduled/unattributed pod: nothing to blame
+        now = faults.monotonic()
+        with self._lock:
+            if node in self._until and now < self._until[node]:
+                return False  # already quarantined; don't re-trip
+            window = self._failures.setdefault(node, deque())
+            window.append(now)
+            while window and window[0] < now - self.window_s:
+                window.popleft()
+            if len(window) >= self.threshold:
+                self._until[node] = now + self.cooldown_s
+                window.clear()
+                return True
+            return False
+
+    def _prune_locked(self, now: float) -> None:
+        for node in [n for n, t in self._until.items() if now >= t]:
+            del self._until[node]
+
+    def quarantined(self) -> List[str]:
+        """Currently quarantined nodes (cooldown unexpired), sorted —
+        what the reconciler excludes from placement and exports as
+        ``kft_operator_quarantined_nodes``."""
+        now = faults.monotonic()
+        with self._lock:
+            self._prune_locked(now)
+            return sorted(self._until)
+
+    def is_quarantined(self, node: str) -> bool:
+        now = faults.monotonic()
+        with self._lock:
+            self._prune_locked(now)
+            return node in self._until

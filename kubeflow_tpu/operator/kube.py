@@ -103,6 +103,14 @@ class FakeKube:
         with self._lock:
             self.pods[_key(namespace, name)]["status"]["phase"] = phase
 
+    def set_pod_node(self, namespace: str, name: str, node: str) -> None:
+        """Test hook: simulate the k8s scheduler binding a pod to a
+        node (spec.nodeName) — what the reconciler's bad-node
+        quarantine attributes worker failures to."""
+        with self._lock:
+            pod = self.pods[_key(namespace, name)]
+            pod.setdefault("spec", {})["nodeName"] = node
+
     # -- services ---------------------------------------------------------
 
     def create_service(self, svc: ObjectDict) -> ObjectDict:

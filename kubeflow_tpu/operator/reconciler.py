@@ -41,7 +41,7 @@ import time
 from typing import Dict, List, Optional
 
 from kubeflow_tpu.operator import crd
-from kubeflow_tpu.operator.gang import GangScheduler
+from kubeflow_tpu.operator.gang import GangScheduler, NodeQuarantine
 from kubeflow_tpu.operator.kube import (
     FAILED,
     PENDING,
@@ -97,7 +97,8 @@ def build_headless_service(job: crd.TPUJobSpec) -> dict:
     }
 
 
-def build_worker_pod(job: crd.TPUJobSpec, index: int) -> dict:
+def build_worker_pod(job: crd.TPUJobSpec, index: int,
+                     avoid_nodes: Optional[List[str]] = None) -> dict:
     topo = job.topology
     hosts_per_slice = topo.hosts
     slice_id = index // hosts_per_slice
@@ -134,6 +135,26 @@ def build_worker_pod(job: crd.TPUJobSpec, index: int) -> dict:
         container["args"] = list(job.worker.args)
     if job.worker.working_dir:
         container["workingDir"] = job.worker.working_dir
+    spec: dict = {
+        "restartPolicy": "Never",  # gang restart is the operator's job
+        "hostname": worker_name(job.name, index),
+        "subdomain": job.name,  # -> {pod}.{job}.{ns} DNS
+        "nodeSelector": topo.k8s_node_selector(),
+        "containers": [container],
+    }
+    if avoid_nodes:
+        # Quarantined (flapping) nodes: hard anti-affinity, so the
+        # k8s scheduler cannot land a fresh gang on the host that just
+        # ate the previous one's restart budget.
+        spec["affinity"] = {"nodeAffinity": {
+            "requiredDuringSchedulingIgnoredDuringExecution": {
+                "nodeSelectorTerms": [{"matchExpressions": [{
+                    "key": "kubernetes.io/hostname",
+                    "operator": "NotIn",
+                    "values": sorted(avoid_nodes),
+                }]}],
+            },
+        }}
     return {
         "apiVersion": "v1",
         "kind": "Pod",
@@ -145,25 +166,29 @@ def build_worker_pod(job: crd.TPUJobSpec, index: int) -> dict:
                 LABEL_INDEX: str(index),
             },
         },
-        "spec": {
-            "restartPolicy": "Never",  # gang restart is the operator's job
-            "hostname": worker_name(job.name, index),
-            "subdomain": job.name,  # -> {pod}.{job}.{ns} DNS
-            "nodeSelector": topo.k8s_node_selector(),
-            "containers": [container],
-        },
+        "spec": spec,
     }
 
 
 class TPUJobController:
     def __init__(self, kube: FakeKube, scheduler: GangScheduler,
-                 cluster=None):
+                 cluster=None,
+                 quarantine: Optional[NodeQuarantine] = None):
         self.kube = kube
         self.scheduler = scheduler
         # Optional policy layer (scheduler.ClusterScheduler): when set,
         # admission order/quotas/priorities/preemption come from its
         # per-pass Plan instead of gang FIFO.
         self.cluster = cluster
+        # Bad-node attribution: repeated WorkerFailed pods on one node
+        # quarantine it (excluded from placement for a cooldown) so a
+        # flapping host stops eating gangs' restart budgets.
+        self.quarantine = quarantine or NodeQuarantine()
+        # (job, pod, restart-generation) triples already attributed: a
+        # real apiserver keeps listing a Failed pod (deletion grace)
+        # for sweeps after the restart, and re-counting the SAME
+        # failure each sweep would quarantine a node off one incident.
+        self._attributed: Dict[str, set] = {}
         # Transient per-job bookkeeping (admission timestamps for the
         # gang-schedule-to-running metric; restart counts live in status).
         self._admitted_at: Dict[str, float] = {}
@@ -257,6 +282,10 @@ class TPUJobController:
         for phase in (QUEUED, STARTING, JOB_RUNNING, JOB_PREEMPTING,
                       JOB_SUCCEEDED, JOB_FAILED):
             gauge.set(phases.get(phase, 0), phase=phase)
+        REGISTRY.gauge(
+            "kft_operator_quarantined_nodes",
+            "nodes excluded from gang placement for flapping workers",
+        ).set(len(self.quarantine.quarantined()))
 
     # -- single-job reconcile --------------------------------------------
 
@@ -277,6 +306,7 @@ class TPUJobController:
         if phase in TERMINAL:
             self.scheduler.release(key)
             self._preempt_deadline.pop(key, None)
+            self._attributed.pop(key, None)
             if self.cluster is not None:
                 self.cluster.forget(key)
             return phase
@@ -314,6 +344,7 @@ class TPUJobController:
                 # and hand the slices over now.
                 restarts = int(status.get("restarts", 0))
                 self._preempt_deadline.pop(key, None)
+                self._note_worker_failures(job, pods, restarts)
                 self._teardown_pods(job)
                 self.scheduler.release(key)
                 self._admitted_at.pop(key, None)
@@ -461,6 +492,7 @@ class TPUJobController:
                                          labels={LABEL_JOB: job.name})
         }
         restarts = int(status.get("restarts", 0))
+        avoid_nodes = self.quarantine.quarantined()
         for i in range(job.num_workers):
             name = worker_name(job.name, i)
             if name not in existing:
@@ -473,7 +505,8 @@ class TPUJobController:
                         message=f"{name} disappeared while Running",
                     )
                 try:
-                    self.kube.create_pod(build_worker_pod(job, i))
+                    self.kube.create_pod(
+                        build_worker_pod(job, i, avoid_nodes))
                 except Conflict:
                     pass
 
@@ -580,9 +613,43 @@ class TPUJobController:
             extra={"resumable": True})
         return QUEUED
 
+    def _note_worker_failures(self, job: crd.TPUJobSpec,
+                              pods: List[dict],
+                              restarts: int) -> None:
+        """Attribute FAILED pods to their nodes; a node that trips the
+        quarantine threshold gets one NodeQuarantined event and is
+        excluded from placement until its cooldown expires.  Each
+        (pod, restart-generation) counts ONCE — a Failed pod lingering
+        through its deletion grace must not re-count every sweep."""
+        key = f"{job.namespace}/{job.name}"
+        seen = self._attributed.setdefault(key, set())
+        for pod in pods:
+            if (pod.get("status") or {}).get("phase") != FAILED:
+                continue
+            mark = (pod["metadata"]["name"], restarts)
+            if mark in seen:
+                continue
+            seen.add(mark)
+            node = (pod.get("spec") or {}).get("nodeName") or ""
+            if self.quarantine.note_failure(node):
+                self.kube.record_event(
+                    job.namespace, f"node/{node}", "NodeQuarantined",
+                    f"{self.quarantine.threshold} worker failures "
+                    f"within {self.quarantine.window_s:g}s (last: "
+                    f"{pod['metadata']['name']} of {key}); excluded "
+                    f"from gang placement for "
+                    f"{self.quarantine.cooldown_s:g}s",
+                    type_="Warning")
+                self.metrics.append({"event": "node_quarantined",
+                                     "node": node, "job": key})
+
     def _gang_restart(self, cr_obj: dict, job: crd.TPUJobSpec,
                       restarts: int, reason: str, message: str) -> str:
         key = f"{job.namespace}/{job.name}"
+        self._note_worker_failures(
+            job, self.kube.list_pods(job.namespace,
+                                     labels={LABEL_JOB: job.name}),
+            restarts)
         if restarts + 1 > job.restart.max_restarts:
             self._set_phase(cr_obj, JOB_FAILED, reason="MaxRestartsExceeded",
                             message=f"{message}; restarts={restarts}",

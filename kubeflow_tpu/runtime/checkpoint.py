@@ -1,4 +1,4 @@
-"""Checkpoint / resume layer.
+"""Checkpoint / resume layer with per-step integrity manifests.
 
 The reference delegated checkpointing entirely to the frameworks and only
 plumbed credentials and mounts (SURVEY.md §5 "checkpoint/resume": GCS via
@@ -13,29 +13,255 @@ reference's split.
 Async design: device->host transfer happens at ``save()``, serialization
 continues in background threads, so the train loop stalls for the transfer
 only — the HBM-bandwidth-friendly pattern for large states.
+
+Integrity design (the crash-safe resume contract):
+
+  - After each orbax commit a per-step MANIFEST is written NEXT TO the
+    step directory (``kft-manifest-<step>.json``): blake2b digests +
+    sizes of every file the step wrote, plus the leaf tree metadata
+    (key paths, shapes, dtypes) of the state that was saved.  The
+    manifest is committed atomically (tmp + fsync + rename + dir
+    fsync) and LAST — a kill mid-save leaves a step directory with no
+    manifest, which is exactly how ``verify`` detects it.
+  - ``verify(step)`` re-digests the step's files against its manifest;
+    a missing/corrupt manifest or a truncated/bit-rotted leaf file
+    fails verification (counted in
+    ``kft_checkpoint_verify_failures_total``).
+  - ``restore_or_init`` walks BACK from the newest step to the newest
+    VERIFIED step instead of crashing on — or silently trusting — a
+    corrupt/partial latest.  Directories written before manifests
+    existed (no manifest for ANY step) fall back to newest-first
+    restore attempts, so legacy checkpoints still resume.
+  - GC (``max_to_keep``) is first-party: it never deletes the newest
+    verified step, even when newer unverified steps exist — the one
+    checkpoint walk-back can land on must survive.
+  - Background async-save failures no longer vanish until ``close()``:
+    the first ``save()``/``wait()`` after the failure raises
+    :class:`CheckpointError` (counted in
+    ``kft_checkpoint_failures_total``); successful durable saves count
+    in ``kft_checkpoint_saves_total``.
+
+Fault hook sites (testing/faults.py): ``checkpoint.save`` fires in the
+background finalize (between the orbax commit and the manifest write —
+a ``raise`` models a save that died before the manifest, a kill
+mid-save), ``checkpoint.restore`` fires per restore attempt.
 """
 
 from __future__ import annotations
 
+import hashlib
+import json
 import logging
+import os
+import threading
 from pathlib import Path
-from typing import Any, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 import orbax.checkpoint as ocp
 
+from kubeflow_tpu.testing import faults
+
 log = logging.getLogger(__name__)
+
+MANIFEST_FORMAT = 1
+_MANIFEST_GLOB = "kft-manifest-*.json"
+_DIGEST_CHUNK = 1 << 20
+
+
+class CheckpointError(RuntimeError):
+    """A background async checkpoint save failed.  Raised at the next
+    ``save()``/``wait()`` call after the failure (never swallowed until
+    ``close()``), so the training supervisor can restart from the last
+    verified step instead of training on past a dead checkpoint path."""
+
+
+def manifest_path(directory: str | Path, step: int) -> Path:
+    return Path(directory) / f"kft-manifest-{int(step):08d}.json"
+
+
+def _digest_file(path: Path) -> Tuple[int, str]:
+    h = hashlib.blake2b(digest_size=16)
+    size = 0
+    with open(path, "rb") as f:
+        while True:
+            chunk = f.read(_DIGEST_CHUNK)
+            if not chunk:
+                break
+            size += len(chunk)
+            h.update(chunk)
+    return size, h.hexdigest()
+
+
+def _atomic_write_json(path: Path, payload: dict) -> None:
+    """tmp + fsync + rename + directory fsync: the manifest either
+    exists complete or not at all — a kill mid-write can never leave a
+    half manifest that parses."""
+    tmp = path.with_name(path.name + ".tmp")
+    with open(tmp, "w") as f:
+        json.dump(payload, f, indent=1, sort_keys=True)
+        f.write("\n")
+        f.flush()
+        os.fsync(f.fileno())
+    os.rename(tmp, path)
+    dir_fd = os.open(path.parent, os.O_RDONLY)
+    try:
+        os.fsync(dir_fd)
+    finally:
+        os.close(dir_fd)
+
+
+def _is_typed_key(leaf: Any) -> bool:
+    import jax
+
+    dtype = getattr(leaf, "dtype", None)
+    try:
+        return dtype is not None and jax.dtypes.issubdtype(
+            dtype, jax.dtypes.prng_key)
+    except TypeError:
+        return False
+
+
+def _encode_keys(tree: Any) -> Any:
+    """Typed PRNG-key leaves -> raw uint32 key data.  Orbax cannot
+    serialize extended key dtypes on every jax/orbax pairing (the
+    train-state ``rng`` leaf would poison the whole save), so keys go
+    to disk as their underlying integer arrays and are re-wrapped at
+    restore with the caller's impl."""
+    import jax
+
+    return jax.tree_util.tree_map(
+        lambda x: jax.random.key_data(x) if _is_typed_key(x) else x,
+        tree)
+
+
+def _decode_keys(template: Any, restored: Any) -> Any:
+    """Re-wrap raw key data as typed keys wherever ``template`` (the
+    caller's abstract target) carries one."""
+    import jax
+
+    def dec(orig, raw):
+        if _is_typed_key(orig):
+            return jax.random.wrap_key_data(
+                raw, impl=jax.random.key_impl(orig))
+        return raw
+
+    return jax.tree_util.tree_map(dec, template, restored)
+
+
+def _tree_metadata(state: Any) -> List[dict]:
+    """Leaf inventory of the state being saved: key path, shape, dtype.
+    Host-side metadata only (digesting device arrays would force a full
+    device->host sync on the save path); byte integrity comes from the
+    file digests."""
+    import jax
+
+    leaves, _ = jax.tree_util.tree_flatten_with_path(state)
+    out = []
+    for path, leaf in leaves:
+        out.append({
+            "path": jax.tree_util.keystr(path),
+            "shape": list(getattr(leaf, "shape", ()) or ()),
+            "dtype": str(getattr(leaf, "dtype", type(leaf).__name__)),
+        })
+    return out
+
+
+def build_manifest(step_dir: Path, step: int,
+                   tree_meta: Optional[List[dict]] = None) -> dict:
+    files: Dict[str, dict] = {}
+    for f in sorted(p for p in step_dir.rglob("*") if p.is_file()):
+        size, digest = _digest_file(f)
+        files[f.relative_to(step_dir).as_posix()] = {
+            "size": size, "blake2b": digest}
+    return {
+        "format": MANIFEST_FORMAT,
+        "step": int(step),
+        "files": files,
+        "leaves": tree_meta or [],
+    }
+
+
+def verify_step(directory: str | Path, step: int) -> Tuple[bool, str]:
+    """Check one step against its manifest.  Returns (ok, reason);
+    reason explains the first failure ('' when verified).  Extra files
+    in the step directory are tolerated (orbax sidecar files may vary
+    across versions); missing, truncated, or corrupted manifest-listed
+    files are not."""
+    directory = Path(directory)
+    step_dir = directory / str(int(step))
+    mpath = manifest_path(directory, step)
+    if not step_dir.is_dir():
+        return False, "step directory missing"
+    if not mpath.exists():
+        return False, "manifest missing (save died before commit?)"
+    try:
+        with open(mpath) as f:
+            manifest = json.load(f)
+    except (OSError, ValueError) as e:
+        return False, f"manifest unreadable: {e}"
+    if manifest.get("format") != MANIFEST_FORMAT \
+            or manifest.get("step") != int(step) \
+            or not isinstance(manifest.get("files"), dict):
+        return False, "manifest malformed"
+    for rel, want in manifest["files"].items():
+        path = step_dir / rel
+        if not path.is_file():
+            return False, f"file missing: {rel}"
+        try:
+            size, digest = _digest_file(path)
+        except OSError as e:
+            # A file that cannot be READ (bad sector, vanished between
+            # stat and open, flaky mount) is an unverifiable step, not
+            # a crash — this is the degrade-don't-die path resume and
+            # the CLI both lean on.
+            return False, f"file unreadable: {rel}: {e}"
+        if size != want.get("size"):
+            return False, (f"file truncated: {rel} "
+                           f"({size} != {want.get('size')} bytes)")
+        if digest != want.get("blake2b"):
+            return False, f"digest mismatch: {rel}"
+    return True, ""
+
+
+def list_checkpoint_steps(directory: str | Path) -> List[int]:
+    """Step directories under a checkpoint root, sorted ascending —
+    manifest-independent (an unverified step still lists)."""
+    directory = Path(directory)
+    steps = []
+    if directory.is_dir():
+        for child in directory.iterdir():
+            if child.is_dir() and child.name.isdigit():
+                steps.append(int(child.name))
+    return sorted(steps)
+
+
+def _counter(name: str, help_: str):
+    from kubeflow_tpu.runtime.prom import REGISTRY
+
+    return REGISTRY.counter(name, help_)
+
+
+def _count_verify_failure() -> None:
+    _counter("kft_checkpoint_verify_failures_total",
+             "checkpoint steps that failed manifest verification").inc()
 
 
 class CheckpointManager:
-    """Thin policy wrapper over orbax's CheckpointManager.
+    """Policy wrapper over orbax's CheckpointManager.
 
     Policy choices (vs raw orbax):
-      - async save always on;
-      - keeps the last ``max_to_keep`` checkpoints (preemption tolerance
-        needs >=2: a kill mid-save must leave a complete predecessor);
-      - restore requires an abstract target tree so arrays come back with
-        the *caller's* shardings — resuming on a different mesh layout than
-        the one that saved is legal (elastic restarts across slice shapes).
+      - async save always on; each commit is finalized in a background
+        thread that writes the integrity manifest LAST and surfaces
+        failures at the next ``save()``/``wait()``;
+      - keeps the last ``max_to_keep`` checkpoints, but GC never
+        deletes the newest VERIFIED step (preemption tolerance needs a
+        restorable predecessor even when later saves are corrupt);
+      - restore requires an abstract target tree so arrays come back
+        with the *caller's* shardings — resuming on a different mesh
+        layout than the one that saved is legal (elastic restarts
+        across slice shapes);
+      - ``restore_or_init`` resumes from the newest verified step,
+        walking back over corrupt/partial ones.
     """
 
     def __init__(
@@ -46,31 +272,157 @@ class CheckpointManager:
         save_interval_steps: int = 1,
     ):
         self.directory = Path(directory)
+        self.max_to_keep = max_to_keep
         self._mgr = ocp.CheckpointManager(
             self.directory,
             options=ocp.CheckpointManagerOptions(
-                max_to_keep=max_to_keep,
+                # GC is first-party (_gc under the finalize lock): orbax
+                # must not delete steps behind the verified-step policy.
+                max_to_keep=None,
                 save_interval_steps=save_interval_steps,
                 enable_async_checkpointing=True,
             ),
         )
+        self._lock = threading.Lock()
+        self._async_error: Optional[BaseException] = None
+        self._finalize_lock = threading.Lock()
+        self._threads: List[threading.Thread] = []
+
+    # -- save path ---------------------------------------------------------
 
     def save(self, step: int, state: Any, *, force: bool = False) -> bool:
         """Queue an async save; returns False if skipped by save policy.
+
+        Raises :class:`CheckpointError` first if a PREVIOUS async save
+        failed in the background — the failure surfaces here, at the
+        next checkpoint boundary, not at ``close()``.
 
         Saving a step that already exists is a no-op, not an error:
         fit's final forced save can land on the same step a periodic
         save just wrote (num_steps-1 on a checkpoint_every boundary),
         and orbax raises StepAlreadyExistsError for that.
         """
+        self._raise_pending()
         if step in (self._mgr.all_steps() or ()):
             return False
+        state = _encode_keys(state)
+        tree_meta = _tree_metadata(state)
         saved = self._mgr.save(
             step, args=ocp.args.StandardSave(state), force=force
         )
         if saved:
-            log.info("checkpoint save queued at step %d -> %s", step, self.directory)
+            log.info("checkpoint save queued at step %d -> %s", step,
+                     self.directory)
+            thread = threading.Thread(
+                target=self._finalize, args=(int(step), tree_meta),
+                name=f"kft-ckpt-finalize-{step}", daemon=True)
+            with self._lock:
+                self._threads = [t for t in self._threads
+                                 if t.is_alive()]
+                self._threads.append(thread)
+            thread.start()
         return saved
+
+    def _finalize(self, step: int, tree_meta: List[dict]) -> None:
+        """Background: wait for the orbax commit, then write the
+        manifest (the LAST artifact — its absence marks a dead save)
+        and run GC.  Any failure is recorded for the next save()/wait()
+        instead of dying silently with the thread.  GC runs on BOTH
+        outcomes: a persistently failing finalize (ENOSPC is the
+        canonical case) must not also disable retention and let
+        unverified step directories accumulate unbounded."""
+        with self._finalize_lock:
+            certified = False
+            try:
+                self._mgr.wait_until_finished()
+                faults.fire("checkpoint.save")
+                step_dir = self.directory / str(step)
+                if not step_dir.is_dir():
+                    # A newer save's finalize already GC'd this step
+                    # (finalize threads serialize but do not order):
+                    # nothing to certify — writing a manifest now
+                    # would produce an empty-file-map orphan that
+                    # verifies a checkpoint that no longer exists.
+                    log.info("checkpoint step %d reclaimed before "
+                             "finalize; skipping manifest", step)
+                    return
+                _atomic_write_json(
+                    manifest_path(self.directory, step),
+                    build_manifest(step_dir, step, tree_meta))
+                _counter("kft_checkpoint_saves_total",
+                         "checkpoints committed durable + verified"
+                         " manifest").inc()
+                certified = True
+            except BaseException as e:  # surfaced at next save()/wait()
+                log.exception("async checkpoint save of step %d failed",
+                              step)
+                _counter("kft_checkpoint_failures_total",
+                         "async checkpoint saves that failed in the "
+                         "background").inc()
+                with self._lock:
+                    if self._async_error is None:
+                        self._async_error = e
+            finally:
+                try:
+                    self._gc(verified_hint=step if certified else None)
+                except Exception:
+                    log.warning("checkpoint GC pass failed",
+                                exc_info=True)
+
+    def _raise_pending(self) -> None:
+        with self._lock:
+            err, self._async_error = self._async_error, None
+        if err is not None:
+            raise CheckpointError(
+                f"background checkpoint save failed: {err}") from err
+
+    def _gc(self, verified_hint: Optional[int] = None) -> None:
+        """Keep the newest ``max_to_keep`` steps plus, always, the
+        newest verified step.  Called under ``_finalize_lock``.
+
+        ``verified_hint`` is a step the caller JUST verified (the one
+        whose manifest _finalize committed) — the scan stops there
+        instead of re-digesting a multi-GB checkpoint it wrote
+        milliseconds ago."""
+        if not self.max_to_keep or self.max_to_keep < 1:
+            return
+        steps = sorted(self._mgr.all_steps() or ())
+        keep = set(steps[-self.max_to_keep:])
+        newest_verified = None
+        for step in reversed(steps):
+            if step == verified_hint or \
+                    verify_step(self.directory, step)[0]:
+                newest_verified = step
+                break
+        if newest_verified is not None:
+            keep.add(newest_verified)
+        for step in steps:
+            if step in keep:
+                continue
+            try:
+                self._mgr.delete(step)
+            except Exception:
+                log.warning("checkpoint GC of step %d failed", step,
+                            exc_info=True)
+                continue
+            mpath = manifest_path(self.directory, step)
+            if mpath.exists():
+                mpath.unlink()
+        # Orphan sweep: a manifest whose step directory is gone (a
+        # finalize/GC race, or an external delete) must not linger —
+        # nothing can ever verify against it.
+        for mpath in self.directory.glob(_MANIFEST_GLOB):
+            try:
+                mstep = int(mpath.stem.rsplit("-", 1)[1])
+            except (IndexError, ValueError):
+                continue
+            if not (self.directory / str(mstep)).is_dir():
+                try:
+                    mpath.unlink()
+                except OSError:
+                    pass
+
+    # -- restore path ------------------------------------------------------
 
     def restore(self, state_like: Any, step: Optional[int] = None) -> Any:
         """Restore `step` (default: latest) into the shape/shardings of
@@ -78,33 +430,96 @@ class CheckpointManager:
         target = step if step is not None else self.latest_step()
         if target is None:
             raise FileNotFoundError(f"no checkpoints under {self.directory}")
-        return self._mgr.restore(
-            target, args=ocp.args.StandardRestore(state_like)
+        faults.fire("checkpoint.restore")
+        restored = self._mgr.restore(
+            target, args=ocp.args.StandardRestore(_encode_keys(state_like))
         )
+        return _decode_keys(state_like, restored)
+
+    def verify(self, step: int) -> bool:
+        """True iff the step's manifest exists and every listed file
+        digests clean.  Failures count in
+        ``kft_checkpoint_verify_failures_total``."""
+        ok, reason = verify_step(self.directory, step)
+        if not ok:
+            _count_verify_failure()
+            log.warning("checkpoint step %d failed verification: %s",
+                        step, reason)
+        return ok
+
+    def latest_verified_step(self) -> Optional[int]:
+        for step in reversed(self.all_steps()):
+            if self.verify(step):
+                return step
+        return None
 
     def restore_or_init(self, init_state: Any) -> tuple[Any, int]:
-        """The resume contract for preempted gangs: restore the latest
-        checkpoint if one exists, else return the freshly-initialized state.
-        Returns (state, start_step)."""
-        latest = self.latest_step()
-        if latest is None:
+        """The resume contract for preempted gangs: restore the newest
+        VERIFIED checkpoint if one exists, walking back over corrupt or
+        partial steps, else return the freshly-initialized state.
+        Returns (state, start_step).
+
+        Steps WITHOUT a manifest are two different things depending on
+        where they sit: newer than (or equal to) the oldest manifested
+        step means the save died before its manifest — skipped, never
+        trusted.  Older than every manifested step means it predates
+        manifests entirely (a pre-upgrade directory) — those remain
+        restore candidates, so upgrading cannot strand an intact old
+        checkpoint."""
+        steps = self.all_steps()
+        if not steps:
             return init_state, 0
-        log.info("resuming from checkpoint step %d", latest)
-        return self.restore(init_state, latest), latest + 1
+        manifested = [s for s in steps
+                      if manifest_path(self.directory, s).exists()]
+        legacy_below = min(manifested) if manifested else None
+        for step in reversed(steps):
+            if legacy_below is not None and step >= legacy_below \
+                    and not self.verify(step):
+                log.warning(
+                    "skipping unverified checkpoint step %d; "
+                    "walking back", step)
+                continue
+            try:
+                state = self.restore(init_state, step)
+            except Exception:
+                # A verified manifest with an unrestorable payload (or
+                # a legacy step with no manifest at all) walks back too
+                # — resume must degrade to an older step, not crash.
+                _count_verify_failure()
+                log.exception(
+                    "restore of checkpoint step %d failed; walking "
+                    "back", step)
+                continue
+            log.info("resuming from checkpoint step %d", step)
+            return state, step + 1
+        log.error(
+            "no restorable checkpoint under %s (%d step(s), none "
+            "verified); starting from scratch", self.directory,
+            len(steps))
+        return init_state, 0
 
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()
 
     def all_steps(self) -> list[int]:
-        return list(self._mgr.all_steps())
+        return sorted(self._mgr.all_steps())
 
     def wait(self) -> None:
-        """Block until queued async saves are durable (call before exit)."""
+        """Block until queued async saves are durable AND finalized
+        (manifests committed); raises :class:`CheckpointError` if any
+        background save failed."""
+        with self._lock:
+            threads = list(self._threads)
+        for t in threads:
+            t.join()
         self._mgr.wait_until_finished()
+        self._raise_pending()
 
     def close(self) -> None:
-        self.wait()
-        self._mgr.close()
+        try:
+            self.wait()
+        finally:
+            self._mgr.close()
 
     def __enter__(self) -> "CheckpointManager":
         return self

@@ -38,6 +38,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 from kubeflow_tpu.parallel.mesh import DEFAULT_RULES, LogicalRules, batch_sharding
 from kubeflow_tpu.runtime.checkpoint import CheckpointManager
 from kubeflow_tpu.runtime.metrics import MetricsLogger, Timer
+from kubeflow_tpu.testing import faults
 
 log = logging.getLogger(__name__)
 
@@ -358,6 +359,7 @@ class Trainer:
         examples_per_step: int = 0,
         log_every: int = 10,
         steps_per_call: int = 1,
+        on_step: Optional[Callable[[int], None]] = None,
     ) -> TrainState:
         """Run the train loop with metrics + periodic async checkpoints.
 
@@ -389,6 +391,13 @@ class Trainer:
             per-step host overhead is visible next to the device step —
             short steps, busy hosts, or high-latency dispatch paths.
             Logging and checkpoints land on call boundaries.
+
+        Supervision hooks: each loop iteration fires the
+        ``train.step`` fault site BEFORE the dispatch (a scripted
+        ``raise`` models a step fault the supervisor must recover
+        from), and ``on_step(i_next)`` runs at each call boundary —
+        runtime/supervisor.py stamps its heartbeat and stall watchdog
+        there.
         """
         if state is None:
             state = self.create_state()
@@ -421,6 +430,7 @@ class Trainer:
         inflight: Deque[Any] = deque()
         i = start_step
         while i < num_steps:
+            faults.fire("train.step")
             if multi_fn is not None and i + k <= num_steps:
                 chunk = [batch]
                 for _ in range(k - 1):
@@ -440,6 +450,8 @@ class Trainer:
                 # Backpressure: in steady state this result is already
                 # done, so the wait is free — it only paces the host.
                 jax.block_until_ready(inflight.popleft())
+            if on_step is not None:
+                on_step(i_next)
             last = i_next - 1
             if log_every and (i_next // log_every > i // log_every
                               or i_next == num_steps):

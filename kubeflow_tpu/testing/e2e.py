@@ -1172,6 +1172,197 @@ def scheduler_smoke(namespace: str = "kubeflow-test") -> None:
                 apiserver.server_close()
 
 
+def train_resilience_smoke(namespace: str = "kubeflow-test") -> None:
+    """Hermetic crash-safe training scenario — the whole PR-10 stack:
+
+      1. supervised resume — a tiny LM trains under the
+         TrainSupervisor with an injected ``train.step`` raise; the
+         supervisor restarts in process, resumes from a VERIFIED
+         checkpoint (never step 0), the global step stays monotone,
+         and the final params are IDENTICAL to an uninterrupted
+         control run of the same seed (loss-identity);
+      2. walk-back restore — the latest checkpoint is corrupted on
+         disk (truncated leaf file); ``restore_or_init`` skips it and
+         resumes from the newest verified predecessor;
+      3. bad-node quarantine — a TPUJob over the fake apiserver flaps
+         repeatedly on one node; the operator quarantines the node
+         (NodeQuarantined event), excludes it from the re-placed
+         gang's pods via node anti-affinity, and exports
+         ``kft_operator_quarantined_nodes``;
+      4. every outcome lands in kft_train_* / kft_checkpoint_*
+         metrics (asserted as deltas — the registry is shared).
+    """
+    import tempfile
+    from pathlib import Path
+
+    import jax
+    import numpy as np
+    import optax
+
+    from kubeflow_tpu.models.transformer import TransformerConfig, lm_task
+    from kubeflow_tpu.operator import crd
+    from kubeflow_tpu.operator.gang import GangScheduler, NodeQuarantine
+    from kubeflow_tpu.operator.kube import FAILED, RUNNING
+    from kubeflow_tpu.operator.kube_http import HttpKube
+    from kubeflow_tpu.operator.reconciler import TPUJobController
+    from kubeflow_tpu.parallel import MeshSpec
+    from kubeflow_tpu.runtime.checkpoint import CheckpointManager
+    from kubeflow_tpu.runtime.metrics import MetricsLogger
+    from kubeflow_tpu.runtime.prom import (
+        REGISTRY,
+        parse_metrics,
+        sample_value,
+    )
+    from kubeflow_tpu.runtime.supervisor import TrainSupervisor
+    from kubeflow_tpu.runtime.train import Trainer
+    from kubeflow_tpu.testing import faults
+    from kubeflow_tpu.testing.fake_apiserver import make_fake_apiserver
+
+    def metric(parsed, name, **labels):
+        return sample_value(parsed, name, **labels) or 0.0
+
+    before = parse_metrics(REGISTRY.render())
+    mesh = MeshSpec(data=-1).build()
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=16, n_layers=1, n_heads=2, n_kv_heads=2,
+        d_ff=32, head_dim=8, max_seq_len=16, dtype="float32")
+    init_fn, loss_fn = lm_task(cfg, mesh=mesh)
+    batch = 2 * jax.device_count()
+    steps = 8
+
+    def data_factory():
+        rng = np.random.RandomState(0)
+        while True:
+            yield {"tokens": rng.randint(
+                0, cfg.vocab_size, size=(batch, 16)).astype(np.int32)}
+
+    def make_trainer(ckpt_dir):
+        return Trainer(
+            init_fn=init_fn, loss_fn=loss_fn, tx=optax.adamw(1e-3),
+            mesh=mesh,
+            checkpoints=CheckpointManager(ckpt_dir, max_to_keep=3),
+            checkpoint_every=2,
+            metrics=MetricsLogger(stream=open("/dev/null", "w")))
+
+    def leaves(state):
+        return [np.asarray(x) for x in
+                jax.tree_util.tree_leaves(state.params)]
+
+    with faults.injected("seed=20260804") as inj, \
+            tempfile.TemporaryDirectory() as tmp:
+        # -- control: one uninterrupted run ---------------------------
+        control = make_trainer(f"{tmp}/control")
+        control_state = control.run_state = TrainSupervisor(
+            control, max_restarts=0).run(
+                data_factory, steps, examples_per_step=batch,
+                log_every=0)
+        control.checkpoints.close()
+
+        # -- 1. supervised resume from a verified step ----------------
+        trainer = make_trainer(f"{tmp}/victim")
+        sup = TrainSupervisor(trainer, max_restarts=2, backoff_s=5.0)
+        sup.run(data_factory, 4, examples_per_step=batch, log_every=0)
+        assert trainer.checkpoints.latest_verified_step() == 3
+        # Fault the FIRST step of the continuation; the skew entry
+        # expires the restart backoff instantly (no wall sleeping).
+        inj2 = faults.parse("train.step:raise*1;train.step:skew=60")
+        faults.install(inj2)
+        try:
+            final = sup.run(data_factory, steps,
+                            examples_per_step=batch, log_every=0)
+        finally:
+            faults.install(inj)
+        assert sup.restarts == 1, sup.stats()
+        # Monotone global step: every call boundary after the restart
+        # continues PAST the verified step — never back to 0.
+        boundaries = sup.steps_seen
+        assert boundaries == sorted(boundaries), boundaries
+        assert boundaries[-1] == steps
+        assert min(b for b in boundaries if b > 4) == 5, boundaries
+        # Loss identity: the supervised run's params equal the
+        # uninterrupted control's (same seed, replayed stream).
+        for got, want in zip(leaves(final), leaves(control_state)):
+            np.testing.assert_allclose(got, want, rtol=0, atol=0)
+
+        # -- 2. corrupt latest -> walk-back restore -------------------
+        trainer.checkpoints.wait()
+        ckpt_dir = Path(f"{tmp}/victim")
+        all_steps = trainer.checkpoints.all_steps()
+        latest = all_steps[-1]
+        victim_file = max(
+            (p for p in (ckpt_dir / str(latest)).rglob("*")
+             if p.is_file()), key=lambda p: p.stat().st_size)
+        victim_file.write_bytes(victim_file.read_bytes()[:16])
+        fresh = trainer.create_state()
+        restored, start = trainer.checkpoints.restore_or_init(fresh)
+        prev_verified = max(s for s in all_steps if s != latest)
+        assert start == prev_verified + 1, (
+            f"walk-back resumed at {start}, want {prev_verified + 1}")
+        trainer.checkpoints.close()
+
+        # -- 3. node flap -> quarantine + gang re-place ---------------
+        apiserver = None
+        try:
+            apiserver, _, store = make_fake_apiserver()
+            kube = HttpKube(base_url=f"http://127.0.0.1:"
+                                     f"{apiserver.server_address[1]}")
+            ctl = TPUJobController(
+                kube, GangScheduler({"v5e-8": 1}),
+                quarantine=NodeQuarantine(threshold=3, window_s=600,
+                                          cooldown_s=1800))
+            kube.create_custom(crd.TPUJobSpec(
+                name="flappy", namespace=namespace,
+                slice_type="v5e-8").to_custom_resource())
+            for _ in range(3):  # three worker failures on one node
+                ctl.reconcile_all()
+                for p in kube.list_pods(namespace):
+                    store.set_pod_node(namespace,
+                                       p["metadata"]["name"],
+                                       "node-flap")
+                    store.set_pod_phase(namespace,
+                                        p["metadata"]["name"], RUNNING)
+                ctl.reconcile_all()
+                pod = kube.list_pods(namespace)[0]
+                store.set_pod_phase(namespace, pod["metadata"]["name"],
+                                    FAILED)
+                ctl.reconcile_all()
+            assert ctl.quarantine.quarantined() == ["node-flap"]
+            events = [e for e in store.events
+                      if e["reason"] == "NodeQuarantined"]
+            assert len(events) == 1, events
+            # The re-placed gang's pods must EXCLUDE the bad node.
+            ctl.reconcile_all()
+            pods = kube.list_pods(namespace)
+            assert pods, "gang was not re-placed after quarantine"
+            for p in pods:
+                terms = (p["spec"]["affinity"]["nodeAffinity"]
+                         ["requiredDuringSchedulingIgnoredDuring"
+                          "Execution"]["nodeSelectorTerms"])
+                expr = terms[0]["matchExpressions"][0]
+                assert expr["operator"] == "NotIn"
+                assert "node-flap" in expr["values"]
+        finally:
+            if apiserver is not None:
+                apiserver.shutdown()
+                apiserver.server_close()
+
+        # -- 4. outcomes in kft_* metrics (deltas) --------------------
+        parsed = parse_metrics(REGISTRY.render())
+        assert metric(parsed, "kft_train_restarts_total",
+                      reason="step") \
+            - metric(before, "kft_train_restarts_total",
+                     reason="step") >= 1
+        assert metric(parsed, "kft_checkpoint_saves_total") \
+            - metric(before, "kft_checkpoint_saves_total") >= 4
+        assert metric(parsed, "kft_checkpoint_verify_failures_total") \
+            - metric(before,
+                     "kft_checkpoint_verify_failures_total") >= 1
+        assert sample_value(
+            parsed, "kft_operator_quarantined_nodes") == 1
+        assert sample_value(
+            parsed, "kft_train_heartbeat_age_seconds") is not None
+
+
 def train_smoke(namespace: str = "kubeflow-test") -> None:
     """A few real SPMD train steps on whatever devices exist."""
     import subprocess
@@ -1306,6 +1497,7 @@ COMMANDS = {
     "fleet": fleet_smoke,
     "scheduler": scheduler_smoke,
     "train": train_smoke,
+    "train_resilience": train_resilience_smoke,
     "deploy": deploy_real,
     "deploy-crds": deploy_crds,
     "tpujob-real": tpujob_real,

@@ -29,6 +29,17 @@ Hook sites planted in production code (grep for ``faults.fire``):
                       error path must contain it)
     scheduler.preempt each eviction wave the policy commits (before
                       victims are marked)
+    train.step        each Trainer.fit loop iteration, before the
+                      dispatch (raise = step fault the supervisor
+                      restarts from, skew = ages stall/backoff
+                      deadlines)
+    checkpoint.save   background checkpoint finalize, between the
+                      orbax commit and the manifest write (raise =
+                      kill mid-save: step left unverified, error
+                      surfaces at the next save()/wait())
+    checkpoint.restore each CheckpointManager.restore attempt
+    data.next         each TensorBatches batch pull (raise = one
+                      transient read error, retried with backoff)
 
 Clock skips: deadline/backoff code reads :func:`monotonic` instead of
 ``time.monotonic`` — a ``skew`` action (or ``advance_clock`` from a
@@ -207,6 +218,21 @@ def monotonic() -> float:
     """Policy clock for deadline and backoff decisions (skewable)."""
     inj = _ACTIVE
     return inj.monotonic() if inj is not None else time.monotonic()
+
+
+def policy_backoff(attempt: int, base_s: float, cap_s: float,
+                   rng: random.Random, poll_s: float = 0.05) -> None:
+    """The repo's one capped-jittered retry backoff, expired on the
+    POLICY clock: delay = min(base * 2^(attempt-1), cap) jittered to
+    [0.8, 1.2]x, waited by polling :func:`monotonic` in short wall
+    sleeps — a seeded ``skew`` (or ``advance_clock``) expires it in
+    microseconds of wall time.  Shared by the training supervisor's
+    restart backoff and the data loader's transient-read retry."""
+    base = min(base_s * (2 ** (max(attempt, 1) - 1)), cap_s)
+    delay = base * (0.8 + 0.4 * rng.random())
+    deadline = monotonic() + delay
+    while monotonic() < deadline:
+        time.sleep(min(poll_s, max(0.0, delay)))
 
 
 def install(injector: Optional[FaultInjector]) -> None:

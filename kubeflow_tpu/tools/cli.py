@@ -314,6 +314,110 @@ def cmd_trace_show(args: argparse.Namespace) -> int:
     return 0
 
 
+def _checkpoint_rows(directory: str):
+    """(step, status, reason, files, size) per step dir, oldest
+    first — shared by ``checkpoints list`` and ``checkpoints verify``.
+
+    Status mirrors restore_or_init's walk-back exactly: ``verified``
+    (manifest digests clean), ``legacy`` (no manifest AND older than
+    every manifested step — pre-manifest checkpoints stay restore
+    candidates), or ``corrupt`` (failed verification, or a manifest-
+    less step at/after the manifest frontier = a save that died
+    mid-commit)."""
+    from kubeflow_tpu.runtime import checkpoint as ckpt
+
+    steps = ckpt.list_checkpoint_steps(directory)
+    manifested = [s for s in steps
+                  if ckpt.manifest_path(directory, s).exists()]
+    legacy_below = min(manifested) if manifested else None
+    rows = []
+    for step in steps:
+        ok, reason = ckpt.verify_step(directory, step)
+        status = "verified" if ok else "corrupt"
+        if not ok and step not in manifested and (
+                legacy_below is None or step < legacy_below):
+            status, reason = "legacy", "pre-manifest restore candidate"
+        mpath = ckpt.manifest_path(directory, step)
+        files = size = None
+        if mpath.exists():
+            try:
+                with open(mpath) as f:
+                    manifest = json.load(f)
+                listed = manifest.get("files", {})
+                files = len(listed)
+                size = sum(v.get("size", 0) for v in listed.values())
+            except (OSError, ValueError):
+                pass
+        rows.append((step, status, reason, files, size))
+    return rows
+
+
+def _resume_step(rows):
+    """The step restore_or_init would land on: newest verified, else
+    newest legacy candidate (legacy steps are by construction older
+    than every verified one)."""
+    candidates = [s for s, status, *_ in rows
+                  if status in ("verified", "legacy")]
+    return max(candidates, default=None)
+
+
+def cmd_checkpoints_list(args: argparse.Namespace) -> int:
+    """Table of the checkpoint steps under a directory with their
+    verification verdicts — the on-disk analogue of ``queue status``
+    (what would restore_or_init pick, and why)."""
+    rows = _checkpoint_rows(args.directory)
+    if not rows:
+        print(f"no checkpoint steps under {args.directory}")
+        return 0
+    fmt = "{:>10} {:<10} {:>7} {:>9}  {}"
+    print(fmt.format("STEP", "STATUS", "FILES", "SIZE_MB", "DETAIL"))
+    resume = _resume_step(rows)
+    for step, status, reason, files, size in rows:
+        detail = "" if status == "verified" else reason
+        if step == resume:
+            detail = ("<- restore_or_init resumes here"
+                      + (" (legacy, no manifest)"
+                         if status == "legacy" else ""))
+        print(fmt.format(step, status,
+                         files if files is not None else "-",
+                         f"{size / 1e6:.1f}" if size is not None
+                         else "-", detail))
+    return 0
+
+
+def cmd_checkpoints_verify(args: argparse.Namespace) -> int:
+    """Re-digest every step against its manifest.  Exit 0: all steps
+    verified.  Exit 2: some steps unverified but a restore candidate
+    (verified, or legacy pre-manifest) exists — walk-back recovers.
+    Exit 1: nothing restorable."""
+    rows = _checkpoint_rows(args.directory)
+    if not rows:
+        print(f"no checkpoint steps under {args.directory}")
+        return 1
+    for step, status, reason, _, _ in rows:
+        verdict = ("OK" if status == "verified"
+                   else "LEGACY (no manifest; restore would be "
+                        "attempted)" if status == "legacy"
+                   else f"FAIL ({reason})")
+        print(f"step {step}: {verdict}")
+    verified = [s for s, status, *_ in rows if status == "verified"]
+    legacy = [s for s, status, *_ in rows if status == "legacy"]
+    bad = len(rows) - len(verified)
+    if verified:
+        print(f"newest verified step: {max(verified)} "
+              f"({bad} of {len(rows)} step(s) unverified)")
+    elif legacy:
+        print(f"no verified steps; {len(legacy)} legacy "
+              f"(pre-manifest) step(s) remain restore candidates — "
+              f"newest: {max(legacy)}")
+    else:
+        print(f"no restorable steps ({len(rows)} checked) — "
+              f"restore_or_init would start from scratch")
+    if len(verified) == len(rows):
+        return 0
+    return 2 if (verified or legacy) else 1
+
+
 def cmd_version(args: argparse.Namespace) -> int:
     from kubeflow_tpu.version import version_info
 
@@ -427,6 +531,22 @@ def build_parser() -> argparse.ArgumentParser:
                             "(default: %(default)s)")
     tshow.add_argument("--timeout", type=float, default=10.0)
     tshow.set_defaults(func=cmd_trace_show)
+
+    p = sub.add_parser(
+        "checkpoints",
+        help="inspect a training checkpoint directory's integrity "
+             "manifests (runtime/checkpoint.py)")
+    csub = p.add_subparsers(dest="action", required=True)
+    clist = csub.add_parser(
+        "list", help="steps + verification verdicts, oldest first")
+    clist.add_argument("directory",
+                       help="checkpoint root (the CheckpointManager "
+                            "directory)")
+    clist.set_defaults(func=cmd_checkpoints_list)
+    cverify = csub.add_parser(
+        "verify", help="re-digest every step against its manifest")
+    cverify.add_argument("directory")
+    cverify.set_defaults(func=cmd_checkpoints_verify)
 
     p = sub.add_parser("version", help="print version info")
     p.set_defaults(func=cmd_version)

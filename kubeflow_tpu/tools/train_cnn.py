@@ -39,11 +39,27 @@ def main(argv=None) -> int:
     ap.add_argument("--checkpoint-every", type=int, default=100)
     ap.add_argument("--learning-rate", type=float, default=0.1)
     ap.add_argument("--log-every", type=int, default=10)
+    ap.add_argument("--max-restarts", type=int, default=3,
+                    help="in-process supervised restarts from the last "
+                         "verified checkpoint (0 = fail on the first "
+                         "fault)")
+    ap.add_argument("--stall-factor", type=float, default=10.0,
+                    help="flag a stall when the current dispatch age "
+                         "exceeds this multiple of the rolling median "
+                         "step time")
+    ap.add_argument("--heartbeat-s", type=float, default=10.0,
+                    help="stall-watchdog poll period (also the "
+                         "kft_train_heartbeat_age_seconds refresh)")
     args = ap.parse_args(argv)
 
     logging.basicConfig(level=logging.INFO, stream=sys.stderr)
     from kubeflow_tpu.runtime import bootstrap
+    from kubeflow_tpu.testing import faults
 
+    # Honor KFT_FAULTS like serving/main.py: the same scripted chaos
+    # (train.step/checkpoint.*/data.next) drives a deployed training
+    # container, the e2e harness, and in-process tests.
+    faults.install_from_env()
     env = bootstrap.initialize()
 
     import jax
@@ -93,18 +109,21 @@ def main(argv=None) -> int:
         if not files:
             logging.error("no *.kftr shards under %s", args.data_dir)
             return 1
-        ds = RecordDataset(
-            files, num_threads=args.data_threads,
-            shuffle_buffer=args.shuffle_buffer, seed=env.process_id,
-            repeat=-1,  # cycle forever; steps bound the run
-        )
-        if env.num_processes > 1:
-            ds = ds.shard(env.process_id, env.num_processes)
-        data = tensor_batches(ds, host_batch)
-    else:
-        rng = np.random.RandomState(env.process_id)
 
-        def synthetic():
+        def data_factory():
+            ds = RecordDataset(
+                files, num_threads=args.data_threads,
+                shuffle_buffer=args.shuffle_buffer, seed=env.process_id,
+                repeat=-1,  # cycle forever; steps bound the run
+            )
+            if env.num_processes > 1:
+                ds = ds.shard(env.process_id, env.num_processes)
+            return tensor_batches(ds, host_batch)
+    else:
+        def data_factory():
+            # Fresh RNG per attempt: a supervised restart replays the
+            # SAME stream, and fit's resume drain re-aligns it.
+            rng = np.random.RandomState(env.process_id)
             while True:
                 yield {
                     "image": rng.randn(host_batch, size, size, 3).astype(
@@ -113,10 +132,14 @@ def main(argv=None) -> int:
                                          size=(host_batch,)),
                 }
 
-        data = synthetic()
+    from kubeflow_tpu.runtime.supervisor import TrainSupervisor
 
-    trainer.fit(data, num_steps=args.steps,
-                examples_per_step=global_batch, log_every=args.log_every)
+    supervisor = TrainSupervisor(
+        trainer, max_restarts=args.max_restarts,
+        stall_factor=args.stall_factor, heartbeat_s=args.heartbeat_s)
+    supervisor.run(data_factory, args.steps,
+                   examples_per_step=global_batch,
+                   log_every=args.log_every)
     logging.info("training done: %s", trainer._last_metrics)
     return 0
 

@@ -58,11 +58,27 @@ def main(argv=None) -> int:
     ap.add_argument("--checkpoint-dir", default="")
     ap.add_argument("--checkpoint-every", type=int, default=100)
     ap.add_argument("--log-every", type=int, default=10)
+    ap.add_argument("--max-restarts", type=int, default=3,
+                    help="in-process supervised restarts from the last "
+                         "verified checkpoint (0 = fail on the first "
+                         "fault)")
+    ap.add_argument("--stall-factor", type=float, default=10.0,
+                    help="flag a stall when the current dispatch age "
+                         "exceeds this multiple of the rolling median "
+                         "step time")
+    ap.add_argument("--heartbeat-s", type=float, default=10.0,
+                    help="stall-watchdog poll period (also the "
+                         "kft_train_heartbeat_age_seconds refresh)")
     args = ap.parse_args(argv)
 
     logging.basicConfig(level=logging.INFO, stream=sys.stderr)
     from kubeflow_tpu.runtime import bootstrap
+    from kubeflow_tpu.testing import faults
 
+    # Honor KFT_FAULTS like serving/main.py: the same scripted chaos
+    # (train.step/checkpoint.*/data.next) drives a deployed training
+    # container, the e2e harness, and in-process tests.
+    faults.install_from_env()
     env = bootstrap.initialize()
 
     import jax
@@ -144,24 +160,29 @@ def main(argv=None) -> int:
     if args.data_files:
         from kubeflow_tpu.data import RecordDataset, tensor_batches
 
-        ds = RecordDataset(
-            args.data_files, shuffle_buffer=1024, repeat=-1,
-        ).shard(env.process_id, max(env.num_processes, 1))
-        data = tensor_batches(ds, batch)
+        def data_factory():
+            ds = RecordDataset(
+                args.data_files, shuffle_buffer=1024, repeat=-1,
+            ).shard(env.process_id, max(env.num_processes, 1))
+            return tensor_batches(ds, batch)
     else:
-        rng = np.random.RandomState(env.process_id)
-
-        def synthetic():
+        def data_factory():
+            # Fresh RNG per attempt: a supervised restart replays the
+            # SAME stream, and fit's resume drain re-aligns it.
+            rng = np.random.RandomState(env.process_id)
             while True:
                 yield {"tokens": rng.randint(
                     0, args.vocab_size,
                     size=(batch, args.seq_len)).astype(np.int32)}
 
-        data = synthetic()
+    from kubeflow_tpu.runtime.supervisor import TrainSupervisor
 
-    trainer.fit(data, num_steps=args.steps, examples_per_step=batch,
-                log_every=args.log_every,
-                steps_per_call=args.steps_per_call)
+    supervisor = TrainSupervisor(
+        trainer, max_restarts=args.max_restarts,
+        stall_factor=args.stall_factor, heartbeat_s=args.heartbeat_s)
+    supervisor.run(data_factory, args.steps, examples_per_step=batch,
+                   log_every=args.log_every,
+                   steps_per_call=args.steps_per_call)
     logging.info("training done: %s", trainer._last_metrics)
     if args.metrics_out:
         import json as _json

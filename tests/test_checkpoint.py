@@ -1,0 +1,432 @@
+"""Checkpoint integrity: manifests, verification, walk-back, GC,
+async-failure surfacing (runtime/checkpoint.py).
+
+Pure-numpy states keep these fast; the Trainer-integrated resume path
+is covered by test_train.py and the sharded/elastic contract by
+TestElasticRestore here.
+"""
+
+import json
+import threading
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from kubeflow_tpu.runtime.checkpoint import (
+    CheckpointError,
+    CheckpointManager,
+    list_checkpoint_steps,
+    manifest_path,
+    verify_step,
+)
+from kubeflow_tpu.runtime.prom import REGISTRY, parse_metrics, sample_value
+from kubeflow_tpu.testing import faults
+
+
+def state_at(step):
+    return {"step": np.full((), step, np.int32),
+            "w": np.arange(8, dtype=np.float32) + step}
+
+
+def fresh_like():
+    return {"step": np.zeros((), np.int32),
+            "w": np.zeros(8, np.float32)}
+
+
+def save_steps(directory, steps, **kw):
+    with CheckpointManager(directory, **kw) as mgr:
+        for step in steps:
+            assert mgr.save(step, state_at(step))
+
+
+def corrupt_leaf(directory, step, nbytes=8):
+    """Truncate the largest file of a step dir (a serialized leaf)."""
+    step_dir = Path(directory) / str(step)
+    victim = max((p for p in step_dir.rglob("*") if p.is_file()),
+                 key=lambda p: p.stat().st_size)
+    victim.write_bytes(victim.read_bytes()[:nbytes])
+    return victim
+
+
+def counter(name):
+    return sample_value(parse_metrics(REGISTRY.render()), name) or 0.0
+
+
+class TestManifest:
+    def test_every_commit_writes_a_manifest(self, tmp_path):
+        save_steps(tmp_path, [0, 1])
+        for step in (0, 1):
+            assert manifest_path(tmp_path, step).exists()
+            ok, reason = verify_step(tmp_path, step)
+            assert ok, reason
+
+    def test_manifest_lists_files_and_leaves(self, tmp_path):
+        save_steps(tmp_path, [0])
+        with open(manifest_path(tmp_path, 0)) as f:
+            manifest = json.load(f)
+        assert manifest["step"] == 0
+        assert manifest["files"]  # digests of everything the step wrote
+        for entry in manifest["files"].values():
+            assert entry["size"] > 0 and len(entry["blake2b"]) == 32
+        paths = {leaf["path"] for leaf in manifest["leaves"]}
+        assert any("w" in p for p in paths)
+
+    def test_missing_manifest_fails_verification(self, tmp_path):
+        save_steps(tmp_path, [0])
+        manifest_path(tmp_path, 0).unlink()
+        ok, reason = verify_step(tmp_path, 0)
+        assert not ok and "manifest missing" in reason
+
+    def test_corrupt_manifest_fails_verification(self, tmp_path):
+        save_steps(tmp_path, [0])
+        manifest_path(tmp_path, 0).write_text("{not json")
+        ok, reason = verify_step(tmp_path, 0)
+        assert not ok and "unreadable" in reason
+
+    def test_truncated_leaf_fails_verification(self, tmp_path):
+        save_steps(tmp_path, [0])
+        corrupt_leaf(tmp_path, 0)
+        ok, reason = verify_step(tmp_path, 0)
+        assert not ok and ("truncated" in reason or "mismatch" in reason)
+
+    def test_bitrot_fails_verification(self, tmp_path):
+        save_steps(tmp_path, [0])
+        step_dir = Path(tmp_path) / "0"
+        victim = max((p for p in step_dir.rglob("*") if p.is_file()),
+                     key=lambda p: p.stat().st_size)
+        data = bytearray(victim.read_bytes())
+        data[len(data) // 2] ^= 0xFF  # same size, flipped bit
+        victim.write_bytes(bytes(data))
+        ok, reason = verify_step(tmp_path, 0)
+        assert not ok and "digest mismatch" in reason
+
+    def test_extra_files_tolerated(self, tmp_path):
+        save_steps(tmp_path, [0])
+        (Path(tmp_path) / "0" / "sidecar.txt").write_text("x")
+        ok, reason = verify_step(tmp_path, 0)
+        assert ok, reason
+
+    def test_list_checkpoint_steps(self, tmp_path):
+        save_steps(tmp_path, [0, 2, 5])
+        assert list_checkpoint_steps(tmp_path) == [0, 2, 5]
+        assert list_checkpoint_steps(tmp_path / "nope") == []
+
+
+class TestWalkBack:
+    def test_kill_mid_save_resumes_from_verified_predecessor(
+            self, tmp_path):
+        """The acceptance scenario: an injected checkpoint.save fault
+        kills the save between the orbax commit and the manifest —
+        restore_or_init must land on the predecessor, never step 0,
+        never the unverified latest."""
+        with faults.injected("checkpoint.save:raise*1"):
+            mgr = CheckpointManager(tmp_path)
+            mgr.save(0, state_at(0))  # dies before its manifest
+            with pytest.raises(CheckpointError):
+                mgr.wait()
+            mgr.save(1, state_at(1))
+            mgr.save(2, state_at(2))
+            mgr.wait()
+            assert not manifest_path(tmp_path, 0).exists()
+            # Kill the newest too: now 1 is the verified frontier.
+            manifest_path(tmp_path, 2).unlink()
+            restored, start = mgr.restore_or_init(fresh_like())
+            assert start == 2
+            np.testing.assert_allclose(restored["w"],
+                                       state_at(1)["w"])
+            mgr._mgr.close()
+
+    def test_corrupt_latest_walks_back(self, tmp_path):
+        save_steps(tmp_path, [0, 1, 2])
+        corrupt_leaf(tmp_path, 2)
+        before = counter("kft_checkpoint_verify_failures_total")
+        with CheckpointManager(tmp_path) as mgr:
+            restored, start = mgr.restore_or_init(fresh_like())
+        assert start == 2
+        np.testing.assert_allclose(restored["w"], state_at(1)["w"])
+        assert counter("kft_checkpoint_verify_failures_total") > before
+
+    def test_corrupt_manifest_walks_back(self, tmp_path):
+        save_steps(tmp_path, [0, 1])
+        manifest_path(tmp_path, 1).write_text("garbage")
+        with CheckpointManager(tmp_path) as mgr:
+            restored, start = mgr.restore_or_init(fresh_like())
+        assert start == 1
+        np.testing.assert_allclose(restored["w"], state_at(0)["w"])
+
+    def test_everything_corrupt_starts_from_scratch(self, tmp_path):
+        save_steps(tmp_path, [0, 1])
+        corrupt_leaf(tmp_path, 0)
+        corrupt_leaf(tmp_path, 1)
+        with CheckpointManager(tmp_path) as mgr:
+            state, start = mgr.restore_or_init(fresh_like())
+        assert start == 0
+        np.testing.assert_allclose(state["w"], np.zeros(8))
+
+    def test_legacy_dir_without_manifests_still_resumes(self, tmp_path):
+        """Pre-manifest checkpoint dirs (no manifest for ANY step)
+        restore newest-first instead of being thrown away."""
+        save_steps(tmp_path, [0, 1])
+        for step in (0, 1):
+            manifest_path(tmp_path, step).unlink()
+        with CheckpointManager(tmp_path) as mgr:
+            restored, start = mgr.restore_or_init(fresh_like())
+        assert start == 2
+        np.testing.assert_allclose(restored["w"], state_at(1)["w"])
+
+    def test_latest_verified_step(self, tmp_path):
+        save_steps(tmp_path, [0, 1, 2], max_to_keep=5)
+        manifest_path(tmp_path, 2).unlink()
+        with CheckpointManager(tmp_path, max_to_keep=5) as mgr:
+            assert mgr.latest_step() == 2
+            assert mgr.latest_verified_step() == 1
+
+
+class TestGC:
+    def test_keeps_max_to_keep(self, tmp_path):
+        save_steps(tmp_path, [0, 1, 2, 3, 4], max_to_keep=2)
+        assert list_checkpoint_steps(tmp_path) == [3, 4]
+        # Manifests of deleted steps are gone too.
+        assert not manifest_path(tmp_path, 0).exists()
+
+    def test_never_deletes_last_verified_step(self, tmp_path):
+        """Newer UNVERIFIED steps must not push the only restorable
+        checkpoint out of the retention window."""
+        mgr = CheckpointManager(tmp_path, max_to_keep=2)
+        mgr.save(0, state_at(0))
+        mgr.save(1, state_at(1))
+        mgr.wait()
+        with faults.injected("checkpoint.save:raise"):
+            # Every further save dies pre-manifest.
+            for step in (2, 3, 4):
+                mgr.save(step, state_at(step))
+                with pytest.raises(CheckpointError):
+                    mgr.wait()
+        steps = mgr.all_steps()
+        assert 1 in steps, steps  # the verified survivor
+        restored, start = mgr.restore_or_init(fresh_like())
+        assert start == 2
+        np.testing.assert_allclose(restored["w"], state_at(1)["w"])
+        mgr._mgr.close()
+
+
+class TestAsyncFailureSurfacing:
+    def test_failure_surfaces_at_next_save(self, tmp_path):
+        before = counter("kft_checkpoint_failures_total")
+        with faults.injected("checkpoint.save:raise*1"):
+            mgr = CheckpointManager(tmp_path)
+            mgr.save(0, state_at(0))
+            for t in list(mgr._threads):  # background finalize done
+                t.join()
+            with pytest.raises(CheckpointError):
+                mgr.save(1, state_at(1))
+            # Error consumed: the retry goes through and verifies.
+            assert mgr.save(1, state_at(1))
+            mgr.wait()
+            assert verify_step(tmp_path, 1)[0]
+            mgr._mgr.close()
+        assert counter("kft_checkpoint_failures_total") == before + 1
+
+    def test_failure_surfaces_at_wait(self, tmp_path):
+        with faults.injected("checkpoint.save:raise*1"):
+            mgr = CheckpointManager(tmp_path)
+            mgr.save(0, state_at(0))
+            with pytest.raises(CheckpointError):
+                mgr.wait()
+            mgr.wait()  # consumed: second wait is clean
+            mgr._mgr.close()
+
+    def test_saves_counted(self, tmp_path):
+        before = counter("kft_checkpoint_saves_total")
+        save_steps(tmp_path, [0, 1])
+        assert counter("kft_checkpoint_saves_total") == before + 2
+
+    def test_restore_hook_fires(self, tmp_path):
+        save_steps(tmp_path, [0])
+        with faults.injected("seed=0") as inj:
+            with CheckpointManager(tmp_path) as mgr:
+                mgr.restore_or_init(fresh_like())
+            assert inj.fired("checkpoint.restore") == 1
+
+    def test_concurrent_saves_all_finalize(self, tmp_path):
+        """Finalize threads serialize on one lock; hammering saves
+        from the main thread still yields a manifest per step."""
+        with CheckpointManager(tmp_path, max_to_keep=10) as mgr:
+            for step in range(6):
+                mgr.save(step, state_at(step))
+        for step in range(6):
+            assert verify_step(tmp_path, step)[0], step
+
+
+class TestElasticRestore:
+    """Resuming on a different mesh layout than the one that saved —
+    the abstract-target contract restore() has always promised."""
+
+    def test_restore_across_mesh_layouts(self, tmp_path, devices):
+        import jax
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+        mesh_a = Mesh(np.array(devices).reshape(8), ("data",))
+        sharded = jax.device_put(
+            np.arange(16, dtype=np.float32),
+            NamedSharding(mesh_a, PartitionSpec("data")))
+        save_steps_state = {"w": sharded,
+                            "step": np.full((), 7, np.int32)}
+        with CheckpointManager(tmp_path) as mgr:
+            mgr.save(0, save_steps_state)
+
+        # A "different slice shape": 2x4 mesh, w sharded over model.
+        mesh_b = Mesh(np.array(devices).reshape(2, 4),
+                      ("data", "model"))
+        target = {
+            "w": jax.ShapeDtypeStruct(
+                (16,), np.float32,
+                sharding=NamedSharding(mesh_b,
+                                       PartitionSpec("model"))),
+            "step": jax.ShapeDtypeStruct((), np.int32),
+        }
+        with CheckpointManager(tmp_path) as mgr2:
+            assert mgr2.verify(0)
+            restored = mgr2.restore(target, 0)
+        np.testing.assert_allclose(np.asarray(restored["w"]),
+                                   np.arange(16))
+        assert restored["w"].sharding.mesh.shape == {"data": 2,
+                                                     "model": 4}
+        assert int(restored["step"]) == 7
+
+    def test_typed_prng_keys_roundtrip(self, tmp_path):
+        """The TrainState.rng leaf: typed keys are stored as raw key
+        data and re-wrapped at restore (orbax cannot serialize
+        extended key dtypes on every jax pairing)."""
+        import jax
+
+        key = jax.random.key(123)
+        with CheckpointManager(tmp_path) as mgr:
+            mgr.save(0, {"rng": key, "w": np.ones(4, np.float32)})
+        with CheckpointManager(tmp_path) as mgr2:
+            restored, start = mgr2.restore_or_init(
+                {"rng": jax.random.key(0),
+                 "w": np.zeros(4, np.float32)})
+        assert start == 1
+        assert jax.dtypes.issubdtype(restored["rng"].dtype,
+                                     jax.dtypes.prng_key)
+        np.testing.assert_array_equal(
+            jax.random.key_data(restored["rng"]),
+            jax.random.key_data(key))
+
+
+class TestWaitSemantics:
+    def test_wait_blocks_until_manifest_durable(self, tmp_path):
+        mgr = CheckpointManager(tmp_path)
+        mgr.save(0, state_at(0))
+        mgr.wait()
+        assert verify_step(tmp_path, 0)[0]
+        mgr.close()
+
+    def test_close_is_idempotent_under_threads(self, tmp_path):
+        mgr = CheckpointManager(tmp_path)
+        mgr.save(0, state_at(0))
+        done = []
+        t = threading.Thread(target=lambda: done.append(mgr.wait()))
+        t.start()
+        mgr.wait()
+        t.join()
+        mgr.close()
+
+
+class TestReviewRegressions:
+    def test_unreadable_file_fails_verification_not_crashes(
+            self, tmp_path, monkeypatch):
+        """An OSError while digesting a manifest-listed file is an
+        unverifiable step, not a crash of the resume path."""
+        import kubeflow_tpu.runtime.checkpoint as ckpt
+
+        save_steps(tmp_path, [0])
+
+        def boom(path):
+            raise OSError("I/O error (bad sector)")
+
+        monkeypatch.setattr(ckpt, "_digest_file", boom)
+        ok, reason = verify_step(tmp_path, 0)
+        assert not ok and "unreadable" in reason
+
+    def test_intact_legacy_step_survives_manifested_corruption(
+            self, tmp_path):
+        """Upgrade scenario: legacy (manifest-less) steps OLDER than
+        every manifested step stay restore candidates — a verified-
+        but-unrestorable newest step walks back onto them instead of
+        restarting from scratch."""
+        from kubeflow_tpu.runtime.checkpoint import (
+            _atomic_write_json,
+            build_manifest,
+        )
+
+        save_steps(tmp_path, [0, 1, 2], max_to_keep=5)
+        for step in (0, 1):  # pre-upgrade steps: no manifests
+            manifest_path(tmp_path, step).unlink()
+        # Newest step: payload rots AFTER the manifest is recomputed,
+        # so verify passes but restore raises.
+        corrupt_leaf(tmp_path, 2)
+        _atomic_write_json(
+            manifest_path(tmp_path, 2),
+            build_manifest(Path(tmp_path) / "2", 2))
+        with CheckpointManager(tmp_path, max_to_keep=5) as mgr:
+            assert mgr.verify(2)  # manifest matches the rotten bytes
+            restored, start = mgr.restore_or_init(fresh_like())
+        assert start == 2, "legacy step 1 should have been restored"
+        np.testing.assert_allclose(restored["w"], state_at(1)["w"])
+
+    def test_died_mid_save_step_still_never_trusted(self, tmp_path):
+        """The legacy carve-out must not weaken the kill-mid-save
+        rule: a manifest-less step NEWER than a manifested one is a
+        dead save, skipped."""
+        save_steps(tmp_path, [0, 1], max_to_keep=5)
+        manifest_path(tmp_path, 1).unlink()  # died before its manifest
+        with CheckpointManager(tmp_path, max_to_keep=5) as mgr:
+            restored, start = mgr.restore_or_init(fresh_like())
+        assert start == 1
+        np.testing.assert_allclose(restored["w"], state_at(0)["w"])
+
+    def test_gc_runs_even_when_finalize_fails(self, tmp_path):
+        """Persistent finalize failure (ENOSPC-class) must not also
+        disable retention: step directories stay bounded at
+        max_to_keep + the newest verified survivor."""
+        mgr = CheckpointManager(tmp_path, max_to_keep=2)
+        mgr.save(0, state_at(0))
+        mgr.save(1, state_at(1))
+        mgr.wait()
+        with faults.injected("checkpoint.save:raise"):
+            for step in range(2, 7):
+                mgr.save(step, state_at(step))
+                with pytest.raises(CheckpointError):
+                    mgr.wait()
+        steps = mgr.all_steps()
+        assert len(steps) <= 3, steps  # newest 2 + verified survivor
+        assert 1 in steps
+        mgr._mgr.close()
+
+    def test_finalize_skips_step_reclaimed_by_newer_gc(self, tmp_path):
+        """A finalize that loses the race to a newer save's GC must
+        not certify a vanished step (empty-file-map orphan manifest)."""
+        import shutil
+
+        before = counter("kft_checkpoint_saves_total")
+        mgr = CheckpointManager(tmp_path, max_to_keep=2)
+        mgr.save(0, state_at(0))
+        mgr.wait()
+        shutil.rmtree(Path(tmp_path) / "0")
+        manifest_path(tmp_path, 0).unlink()
+        mgr._finalize(0, [])  # the late, raced finalize
+        assert not manifest_path(tmp_path, 0).exists()
+        mgr.wait()  # no async error recorded either
+        assert counter("kft_checkpoint_saves_total") == before + 1
+        mgr._mgr.close()
+
+    def test_gc_sweeps_orphan_manifests(self, tmp_path):
+        save_steps(tmp_path, [0], max_to_keep=2)
+        orphan = manifest_path(tmp_path, 9)
+        orphan.write_text("{}")
+        with CheckpointManager(tmp_path, max_to_keep=2) as mgr:
+            mgr.save(1, state_at(1))
+        assert not orphan.exists()

@@ -317,3 +317,104 @@ def test_trace_show_unknown_id_errors(capsys):
         assert "no retained trace" in capsys.readouterr().err
     finally:
         httpd.shutdown()
+
+
+@pytest.fixture(scope="module")
+def checkpoint_dir(tmp_path_factory):
+    """Three saved steps with the newest one corrupted on disk."""
+    import numpy as np
+
+    from kubeflow_tpu.runtime.checkpoint import CheckpointManager
+
+    d = tmp_path_factory.mktemp("ckpt")
+    with CheckpointManager(d, max_to_keep=5) as mgr:
+        for step in range(3):
+            mgr.save(step, {"w": np.arange(4, dtype=np.float32) + step})
+    victim = max((p for p in (d / "2").rglob("*") if p.is_file()),
+                 key=lambda p: p.stat().st_size)
+    victim.write_bytes(victim.read_bytes()[:4])
+    return d
+
+
+def test_checkpoints_list_renders_verdicts(checkpoint_dir, capsys):
+    rc = cli.main(["checkpoints", "list", str(checkpoint_dir)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    lines = out.splitlines()
+    assert lines[0].split() == ["STEP", "STATUS", "FILES", "SIZE_MB",
+                                "DETAIL"]
+    by_step = {ln.split()[0]: ln for ln in lines[1:]}
+    assert "verified" in by_step["0"]
+    assert "verified" in by_step["1"]
+    assert "resumes here" in by_step["1"]  # newest verified marked
+    assert "corrupt" in by_step["2"]
+
+
+def test_checkpoints_verify_exit_codes(checkpoint_dir, capsys):
+    # Mixed: some steps corrupt but walk-back recovers -> exit 2.
+    rc = cli.main(["checkpoints", "verify", str(checkpoint_dir)])
+    out = capsys.readouterr().out
+    assert rc == 2
+    assert "step 2: FAIL" in out
+    assert "newest verified step: 1" in out
+
+
+def test_checkpoints_verify_all_clean(tmp_path, capsys):
+    import numpy as np
+
+    from kubeflow_tpu.runtime.checkpoint import CheckpointManager
+
+    with CheckpointManager(tmp_path / "ok") as mgr:
+        mgr.save(0, {"w": np.ones(2, np.float32)})
+    rc = cli.main(["checkpoints", "verify", str(tmp_path / "ok")])
+    out = capsys.readouterr().out
+    assert rc == 0 and "step 0: OK" in out
+
+
+def test_checkpoints_verify_nothing_restorable(tmp_path, capsys):
+    import numpy as np
+
+    from kubeflow_tpu.runtime.checkpoint import CheckpointManager
+
+    with CheckpointManager(tmp_path / "bad") as mgr:
+        mgr.save(0, {"w": np.ones(2, np.float32)})
+    # Manifested but corrupt: walk-back skips it, nothing else exists.
+    victim = max((p for p in (tmp_path / "bad" / "0").rglob("*")
+                  if p.is_file()), key=lambda p: p.stat().st_size)
+    victim.write_bytes(victim.read_bytes()[:4])
+    rc = cli.main(["checkpoints", "verify", str(tmp_path / "bad")])
+    out = capsys.readouterr().out
+    assert rc == 1 and "no restorable steps" in out
+
+
+def test_checkpoints_legacy_dir_is_a_restore_candidate(tmp_path,
+                                                       capsys):
+    """A pre-manifest directory is what restore_or_init says it is:
+    restorable — the CLI must not tell the operator to throw it away
+    (rc 1); it reports legacy candidates and exits 2."""
+    import numpy as np
+
+    from kubeflow_tpu.runtime.checkpoint import (
+        CheckpointManager,
+        manifest_path,
+    )
+
+    with CheckpointManager(tmp_path / "old", max_to_keep=5) as mgr:
+        for step in range(2):
+            mgr.save(step, {"w": np.ones(2, np.float32)})
+    for step in range(2):
+        manifest_path(tmp_path / "old", step).unlink()
+    rc = cli.main(["checkpoints", "verify", str(tmp_path / "old")])
+    out = capsys.readouterr().out
+    assert rc == 2, out
+    assert "legacy" in out and "newest: 1" in out
+    rc = cli.main(["checkpoints", "list", str(tmp_path / "old")])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "resumes here (legacy, no manifest)" in out
+
+
+def test_checkpoints_list_empty_dir(tmp_path, capsys):
+    rc = cli.main(["checkpoints", "list", str(tmp_path)])
+    assert rc == 0
+    assert "no checkpoint steps" in capsys.readouterr().out

@@ -441,3 +441,103 @@ class TestSeekResume:
         t2 = make_trainer()
         t2.fit(data, num_steps=8, log_every=0)
         assert seeks == [4], seeks
+
+
+class TestTransientRetry:
+    """data.next hook: transient read errors retry with capped jittered
+    backoff on the policy clock; budget exhaustion raises DataError."""
+
+    def test_injected_faults_retried_to_success(self, shard_dir):
+        from kubeflow_tpu.data.loader import DataError  # noqa: F401
+        from kubeflow_tpu.testing import faults
+
+        _, paths = shard_dir
+        ds = RecordDataset(paths, force_python=True)
+        want = [b["y"].tolist() for b in tensor_batches(ds, 10)]
+        with faults.injected(
+                "data.next:raise*3;data.next:skew=100"):
+            got = [b["y"].tolist()
+                   for b in tensor_batches(ds, 10, retries=4)]
+        assert got == want  # stream re-aligned past yielded batches
+
+    def test_mid_stream_fault_does_not_duplicate_batches(
+            self, shard_dir):
+        from kubeflow_tpu.testing import faults
+
+        _, paths = shard_dir
+        ds = RecordDataset(paths, force_python=True)
+        want = [b["y"].tolist() for b in tensor_batches(ds, 10)]
+        # Fault fires on the 4th pull only (3 clean encounters first,
+        # via times-bounded skew entries consuming nothing).
+        with faults.injected("seed=1;data.next:raise=0*1@0.35;"
+                             "data.next:skew=100"):
+            got = [b["y"].tolist()
+                   for b in tensor_batches(ds, 10, retries=4)]
+        assert got == want
+
+    def test_budget_exhaustion_raises_typed_error(self, shard_dir):
+        from kubeflow_tpu.data.loader import DataError
+        from kubeflow_tpu.testing import faults
+
+        _, paths = shard_dir
+        ds = RecordDataset(paths, force_python=True)
+        with faults.injected("data.next:raise;data.next:skew=100"):
+            with pytest.raises(DataError) as exc:
+                list(tensor_batches(ds, 10, retries=2))
+        assert isinstance(exc.value.__cause__, faults.FaultInjected)
+
+    def test_real_io_error_is_transient(self, tmp_path):
+        """A shard that becomes readable between attempts (flaky
+        mount) recovers without DataError."""
+        from kubeflow_tpu.testing import faults
+
+        examples = [{"x": np.full((2,), i, np.int32)}
+                    for i in range(8)]
+        paths = write_example_shards(examples, tmp_path,
+                                     examples_per_shard=8)
+        good = paths[0].read_bytes()
+        paths[0].write_bytes(good[:9])  # truncated: IOError on read
+        ds = RecordDataset(paths, force_python=True)
+        tb = tensor_batches(ds, 4, retries=3)
+        orig_wait = tb._retry_wait
+
+        def heal_then_wait(attempt):
+            paths[0].write_bytes(good)  # the mount comes back
+            orig_wait(attempt)
+
+        tb._retry_wait = heal_then_wait
+        with faults.injected("data.next:skew=100"):
+            out = list(tb)
+        total = sum(b["x"].shape[0] for b in out)
+        assert total == 8
+        assert [b["x"][0, 0] for b in out] == [0, 4]  # no duplicates
+
+    def test_retry_budget_is_consecutive(self, shard_dir):
+        """A success resets the budget: N scattered faults with budget
+        < N still complete."""
+        from kubeflow_tpu.testing import faults
+
+        _, paths = shard_dir
+        ds = RecordDataset(paths, force_python=True)
+        want = [b["y"].tolist() for b in tensor_batches(ds, 10)]
+        with faults.injected("seed=3;data.next:raise@0.3;"
+                             "data.next:skew=100"):
+            got = [b["y"].tolist()
+                   for b in tensor_batches(ds, 10, retries=2)]
+        assert got == want
+
+    def test_one_shot_iterable_propagates_raw(self, shard_dir):
+        """A plain generator dataset cannot be rebuilt+realigned —
+        the fault propagates unretried (no silent batch drops); the
+        supervisor's per-attempt data_factory owns recovery there."""
+        from kubeflow_tpu.testing import faults
+
+        _, paths = shard_dir
+        payloads = list(RecordDataset(paths, force_python=True))
+
+        def gen():
+            yield from payloads
+
+        with faults.injected("data.next:raise*1"):
+            with pytest.raises(faults.FaultInjected):
+                list(tensor_batches(gen(), 10, retries=5))

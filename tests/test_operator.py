@@ -251,3 +251,175 @@ class TestUnsatisfiableJobs:
         # Second pass: with the head gone, "ok" is admitted and starts.
         ctl.reconcile_all()
         assert crs["ok"]["status"]["phase"] == STARTING
+
+
+class TestNodeQuarantine:
+    """Bad-node attribution: repeated WorkerFailed pods on one node
+    quarantine it — excluded from gang placement (anti-affinity on
+    re-placed pods), event recorded, gauge exported, cooldown on the
+    skewable policy clock."""
+
+    def make_controller(self, threshold=2, window_s=600,
+                        cooldown_s=1800):
+        from kubeflow_tpu.operator.gang import NodeQuarantine
+
+        kube = FakeKube()
+        ctl = TPUJobController(
+            kube, GangScheduler({"v5e-16": 2}),
+            quarantine=NodeQuarantine(threshold=threshold,
+                                      window_s=window_s,
+                                      cooldown_s=cooldown_s))
+        kube.create_custom(make_cr())
+        return kube, ctl, kube.list_custom()[0]
+
+    def flap_once(self, kube, ctl, cr, node="node-bad"):
+        ctl.reconcile_once(cr)
+        for pod in kube.list_pods("kubeflow"):
+            kube.set_pod_node("kubeflow", pod["metadata"]["name"],
+                              node)
+            kube.set_pod_phase("kubeflow", pod["metadata"]["name"],
+                               RUNNING)
+        ctl.reconcile_once(cr)
+        victim = kube.list_pods("kubeflow")[0]["metadata"]["name"]
+        kube.set_pod_phase("kubeflow", victim, FAILED)
+        ctl.reconcile_once(cr)  # gang restart, failure attributed
+
+    def test_threshold_failures_quarantine_node(self):
+        from kubeflow_tpu.testing import faults
+
+        with faults.injected("seed=0"):
+            kube, ctl, cr = self.make_controller(threshold=2)
+            self.flap_once(kube, ctl, cr)
+            assert ctl.quarantine.quarantined() == []
+            self.flap_once(kube, ctl, cr)
+            assert ctl.quarantine.quarantined() == ["node-bad"]
+            events = [e for e in kube.events
+                      if e["reason"] == "NodeQuarantined"]
+            assert len(events) == 1
+            assert "node-bad" in events[0]["involvedObject"]
+
+    def test_replaced_gang_excludes_quarantined_node(self):
+        from kubeflow_tpu.testing import faults
+
+        with faults.injected("seed=0"):
+            kube, ctl, cr = self.make_controller(threshold=2)
+            self.flap_once(kube, ctl, cr)
+            self.flap_once(kube, ctl, cr)
+            ctl.reconcile_once(cr)  # re-place the gang
+            pods = kube.list_pods("kubeflow")
+            assert pods
+            for pod in pods:
+                terms = (pod["spec"]["affinity"]["nodeAffinity"]
+                         ["requiredDuringSchedulingIgnoredDuring"
+                          "Execution"]["nodeSelectorTerms"])
+                expr = terms[0]["matchExpressions"][0]
+                assert expr == {"key": "kubernetes.io/hostname",
+                                "operator": "NotIn",
+                                "values": ["node-bad"]}
+
+    def test_healthy_placement_has_no_affinity(self):
+        kube = FakeKube()
+        ctl = TPUJobController(kube, GangScheduler({"v5e-16": 2}))
+        kube.create_custom(make_cr())
+        ctl.reconcile_once(kube.list_custom()[0])
+        for pod in kube.list_pods("kubeflow"):
+            assert "affinity" not in pod["spec"]
+
+    def test_cooldown_expires_on_policy_clock(self):
+        from kubeflow_tpu.testing import faults
+
+        with faults.injected("seed=0") as inj:
+            kube, ctl, cr = self.make_controller(threshold=2,
+                                                 cooldown_s=300)
+            self.flap_once(kube, ctl, cr)
+            self.flap_once(kube, ctl, cr)
+            assert ctl.quarantine.is_quarantined("node-bad")
+            inj.advance_clock(301)
+            assert not ctl.quarantine.is_quarantined("node-bad")
+            ctl.reconcile_once(cr)
+            for pod in kube.list_pods("kubeflow"):
+                assert "affinity" not in pod["spec"]
+
+    def test_window_prunes_stale_failures(self):
+        from kubeflow_tpu.testing import faults
+
+        with faults.injected("seed=0") as inj:
+            kube, ctl, cr = self.make_controller(threshold=2,
+                                                 window_s=60)
+            self.flap_once(kube, ctl, cr)
+            inj.advance_clock(120)  # first failure ages out
+            self.flap_once(kube, ctl, cr)
+            assert ctl.quarantine.quarantined() == []
+
+    def test_unattributed_failures_never_quarantine(self):
+        """Pods without spec.nodeName (unscheduled) blame nobody."""
+        from kubeflow_tpu.testing import faults
+
+        with faults.injected("seed=0"):
+            kube = FakeKube()
+            ctl = TPUJobController(kube, GangScheduler({"v5e-16": 2}))
+            kube.create_custom(make_cr())
+            cr = kube.list_custom()[0]
+            for _ in range(4):
+                ctl.reconcile_once(cr)
+                set_all_pods(kube, "kubeflow", RUNNING)
+                ctl.reconcile_once(cr)
+                pod = kube.list_pods("kubeflow")[0]
+                kube.set_pod_phase("kubeflow",
+                                   pod["metadata"]["name"], FAILED)
+                ctl.reconcile_once(cr)
+            assert ctl.quarantine.quarantined() == []
+
+    def test_gauge_exported_on_sweep(self):
+        from kubeflow_tpu.runtime.prom import (
+            REGISTRY,
+            parse_metrics,
+            sample_value,
+        )
+        from kubeflow_tpu.testing import faults
+
+        with faults.injected("seed=0"):
+            kube, ctl, cr = self.make_controller(threshold=2)
+            self.flap_once(kube, ctl, cr)
+            self.flap_once(kube, ctl, cr)
+            ctl.reconcile_all()
+            parsed = parse_metrics(REGISTRY.render())
+            assert sample_value(
+                parsed, "kft_operator_quarantined_nodes") == 1
+
+    def test_quarantine_counts_once_not_per_failure(self):
+        from kubeflow_tpu.testing import faults
+
+        with faults.injected("seed=0"):
+            kube, ctl, cr = self.make_controller(threshold=2)
+            for _ in range(4):  # keep flapping past the trip point
+                self.flap_once(kube, ctl, cr)
+            events = [e for e in kube.events
+                      if e["reason"] == "NodeQuarantined"]
+            assert len(events) == 1
+
+    def test_lingering_failed_pod_attributes_once_per_generation(self):
+        """A real apiserver keeps listing a Failed pod through its
+        deletion grace: repeated sweeps over the SAME failure must
+        count once toward quarantine, not once per sweep."""
+        from kubeflow_tpu.operator.gang import NodeQuarantine
+        from kubeflow_tpu.testing import faults
+
+        with faults.injected("seed=0"):
+            kube = FakeKube()
+            ctl = TPUJobController(
+                kube, GangScheduler({"v5e-16": 2}),
+                quarantine=NodeQuarantine(threshold=3))
+            kube.create_custom(make_cr())
+            job = crd.TPUJobSpec.from_custom_resource(
+                kube.list_custom()[0])
+            pod = {"metadata": {"name": "train-worker-0"},
+                   "spec": {"nodeName": "node-x"},
+                   "status": {"phase": FAILED}}
+            for _ in range(5):  # the same incident, sweep after sweep
+                ctl._note_worker_failures(job, [pod], restarts=0)
+            assert ctl.quarantine.quarantined() == []
+            # A NEW generation (post-restart failure) counts again.
+            ctl._note_worker_failures(job, [pod], restarts=1)
+            ctl._note_worker_failures(job, [pod], restarts=2)
+            assert ctl.quarantine.quarantined() == ["node-x"]

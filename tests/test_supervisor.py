@@ -1,0 +1,373 @@
+"""Training supervisor: restart-with-backoff, heartbeat, stall
+watchdog (runtime/supervisor.py) + Trainer.fit's supervision hooks.
+
+Fake trainers drive the policy paths (budget, stall, clock skew) in
+microseconds; one real tiny SPMD trainer proves the loss-identity
+contract — a supervised run with a mid-run fault ends bit-identical to
+an uninterrupted run of the same seed.
+"""
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from kubeflow_tpu.data.loader import DataError
+from kubeflow_tpu.parallel import MeshSpec
+from kubeflow_tpu.runtime.checkpoint import CheckpointError, CheckpointManager
+from kubeflow_tpu.runtime.metrics import MetricsLogger
+from kubeflow_tpu.runtime.prom import REGISTRY, parse_metrics, sample_value
+from kubeflow_tpu.runtime.supervisor import (
+    RESTARTABLE,
+    RestartBudgetExceeded,
+    StallDetected,
+    TrainSupervisor,
+)
+from kubeflow_tpu.runtime.train import Trainer
+from kubeflow_tpu.testing import faults
+
+
+def counter(name, **labels):
+    return sample_value(parse_metrics(REGISTRY.render()),
+                        name, **labels) or 0.0
+
+
+class FakeTrainer:
+    """fit() that walks the step counter and obeys injected faults —
+    the supervisor only sees the Trainer.fit contract (on_step +
+    exceptions), so this is a faithful stand-in for policy tests."""
+
+    def __init__(self, resume_at=0, raise_once=None):
+        self.calls = 0
+        self.resume_at = resume_at  # "restored checkpoint" step
+        self.raise_once = raise_once
+
+    def fit(self, data, num_steps, on_step=None, **kw):
+        self.calls += 1
+        start = 0 if self.calls == 1 else self.resume_at
+        for i in range(start, num_steps):
+            faults.fire("train.step")
+            if self.raise_once is not None:
+                exc, self.raise_once = self.raise_once, None
+                raise exc
+            if on_step is not None:
+                on_step(i + 1)
+        return "final-state"
+
+
+class TestRestartPolicy:
+    def test_step_fault_restarts_and_counts(self):
+        before = counter("kft_train_restarts_total", reason="step")
+        with faults.injected("train.step:raise*1;train.step:skew=60"):
+            tr = FakeTrainer(resume_at=2)
+            sup = TrainSupervisor(tr, max_restarts=2, backoff_s=5.0)
+            out = sup.run(lambda: None, 5)
+        assert out == "final-state"
+        assert sup.restarts == 1 and tr.calls == 2
+        assert counter("kft_train_restarts_total",
+                       reason="step") == before + 1
+
+    def test_budget_exceeded_raises_with_cause(self):
+        with faults.injected("train.step:raise;train.step:skew=60"):
+            sup = TrainSupervisor(FakeTrainer(), max_restarts=1,
+                                  backoff_s=1.0)
+            with pytest.raises(RestartBudgetExceeded) as exc:
+                sup.run(lambda: None, 3)
+        assert isinstance(exc.value.__cause__, faults.FaultInjected)
+        assert sup.restarts == 2  # the budget-breaking attempt counted
+
+    def test_zero_budget_means_fail_fast(self):
+        with faults.injected("train.step:raise*1"):
+            sup = TrainSupervisor(FakeTrainer(), max_restarts=0)
+            with pytest.raises(RestartBudgetExceeded):
+                sup.run(lambda: None, 3)
+
+    def test_data_error_is_restartable(self):
+        with faults.injected("seed=0"):
+            tr = FakeTrainer(resume_at=1,
+                             raise_once=DataError("retry budget spent"))
+            sup = TrainSupervisor(tr, max_restarts=1, backoff_s=0.0)
+            assert sup.run(lambda: None, 3) == "final-state"
+        assert sup.restarts == 1
+
+    def test_checkpoint_error_is_restartable(self):
+        with faults.injected("seed=0"):
+            tr = FakeTrainer(resume_at=1,
+                             raise_once=CheckpointError("async died"))
+            sup = TrainSupervisor(tr, max_restarts=1, backoff_s=0.0)
+            assert sup.run(lambda: None, 3) == "final-state"
+        assert sup.restarts == 1
+
+    def test_non_restartable_propagates_unwrapped(self):
+        tr = FakeTrainer(raise_once=ValueError("a real bug"))
+        sup = TrainSupervisor(tr, max_restarts=3, backoff_s=0.0)
+        with pytest.raises(ValueError):
+            sup.run(lambda: None, 3)
+        assert sup.restarts == 0
+
+    def test_fresh_data_iterable_per_attempt(self):
+        factories = []
+        with faults.injected("train.step:raise*1;train.step:skew=60"):
+            sup = TrainSupervisor(FakeTrainer(resume_at=1),
+                                  max_restarts=1, backoff_s=1.0)
+            sup.run(lambda: factories.append(1) or iter(()), 3)
+        assert len(factories) == 2  # one fresh iterable per attempt
+
+    def test_restartable_set_is_typed(self):
+        assert faults.FaultInjected in RESTARTABLE
+        assert DataError in RESTARTABLE
+        assert CheckpointError in RESTARTABLE
+        assert StallDetected in RESTARTABLE
+        assert ValueError not in RESTARTABLE
+
+
+class TestBackoff:
+    def test_backoff_waits_on_the_policy_clock(self):
+        """A 100s backoff must expire from clock skew alone — no wall
+        sleeping (the clock-discipline contract)."""
+        with faults.injected("seed=0") as inj:
+            sup = TrainSupervisor(FakeTrainer(), backoff_s=100.0,
+                                  backoff_max_s=100.0)
+            done = threading.Event()
+
+            def waiter():
+                sup._backoff(1)
+                done.set()
+
+            t = threading.Thread(target=waiter, daemon=True)
+            t.start()
+            assert not done.wait(0.2), "backoff returned early"
+            inj.advance_clock(1000)
+            assert done.wait(5.0), "skewed clock did not expire backoff"
+            t.join()
+
+    def test_backoff_is_capped(self):
+        with faults.injected("seed=0") as inj:
+            sup = TrainSupervisor(FakeTrainer(), backoff_s=1.0,
+                                  backoff_max_s=2.0)
+            inj.advance_clock(0)  # injector installed for the clock
+            t0 = time.perf_counter()
+            done = threading.Event()
+            t = threading.Thread(
+                target=lambda: (sup._backoff(10), done.set()),
+                daemon=True)
+            t.start()
+            inj.advance_clock(3.0)  # > cap x max jitter
+            assert done.wait(5.0)
+            t.join()
+            assert time.perf_counter() - t0 < 5.0
+
+
+class TestStallWatchdog:
+    def test_skewed_clock_flags_stall_and_restarts(self):
+        """The acceptance scenario: a dispatch that takes 500 policy-
+        seconds against a millisecond rolling window is a stall; the
+        next call boundary raises and the supervisor restarts."""
+
+        class StallingTrainer(FakeTrainer):
+            def fit(self, data, num_steps, on_step=None, **kw):
+                self.calls += 1
+                inj = faults.active()
+                start = 0 if self.calls == 1 else self.resume_at
+                for i in range(start, num_steps):
+                    if self.calls == 1 and i == 6:
+                        inj.advance_clock(500)  # the wedged dispatch
+                    if on_step is not None:
+                        on_step(i + 1)
+                return "final-state"
+
+        before = counter("kft_train_restarts_total", reason="stall")
+        with faults.injected("seed=0") as inj:
+            tr = StallingTrainer(resume_at=6)
+            sup = TrainSupervisor(tr, max_restarts=1, backoff_s=50.0,
+                                  min_stall_s=0.5, stall_factor=5.0,
+                                  min_window=3)
+            skewer = threading.Timer(0.2,
+                                     lambda: inj.advance_clock(1000))
+            skewer.start()  # expires the restart backoff, not walls
+            try:
+                assert sup.run(lambda: None, 8) == "final-state"
+            finally:
+                skewer.cancel()
+        assert sup.restarts == 1
+        assert counter("kft_train_restarts_total",
+                       reason="stall") == before + 1
+
+    def test_watchdog_pins_gauge_during_wedged_dispatch(self):
+        """A dispatch that never returns cannot be restarted in
+        process — but the watchdog thread must pin kft_train_stalled
+        at 1 so external liveness machinery sees it."""
+        release = threading.Event()
+        stalled_seen = threading.Event()
+
+        class WedgedTrainer:
+            calls = 0
+
+            def fit(self, data, num_steps, on_step=None, **kw):
+                self.calls += 1
+                if self.calls > 1:  # post-restart attempt: healthy
+                    for i in range(3, num_steps):
+                        on_step(i + 1)
+                    return "final-state"
+                for i in range(3):  # establish the rolling window
+                    on_step(i + 1)
+                faults.active().advance_clock(500)
+                release.wait(10.0)
+                on_step(4)  # boundary AFTER the stall -> StallDetected
+                return "unreachable"
+
+        with faults.injected("seed=0"):
+            sup = TrainSupervisor(WedgedTrainer(), max_restarts=1,
+                                  backoff_s=0.0, min_stall_s=0.5,
+                                  stall_factor=5.0, min_window=2,
+                                  heartbeat_s=0.02)
+
+            def watch_gauge():
+                deadline = time.perf_counter() + 5.0
+                while time.perf_counter() < deadline:
+                    g = sample_value(
+                        parse_metrics(REGISTRY.render()),
+                        "kft_train_stalled")
+                    if g == 1.0:
+                        stalled_seen.set()
+                        release.set()
+                        return
+                    time.sleep(0.01)
+                release.set()
+
+            t = threading.Thread(target=watch_gauge, daemon=True)
+            t.start()
+            out = sup.run(lambda: None, 6)
+            t.join()
+        assert stalled_seen.is_set(), (
+            "watchdog never exported kft_train_stalled=1")
+        assert out == "final-state" and sup.restarts == 1
+
+    def test_no_stall_verdict_before_min_window(self):
+        with faults.injected("seed=0") as inj:
+            calls = {"n": 0}
+
+            class SlowFirstSteps(FakeTrainer):
+                def fit(self, data, num_steps, on_step=None, **kw):
+                    calls["n"] += 1
+                    for i in range(num_steps):
+                        inj.advance_clock(100)  # every "step" is slow
+                        on_step(i + 1)
+                    return "final-state"
+
+            sup = TrainSupervisor(SlowFirstSteps(), max_restarts=0,
+                                  min_window=100)
+            # Window never fills -> no threshold -> no stall raise.
+            assert sup.run(lambda: None, 5) == "final-state"
+
+    def test_heartbeat_age_reads_policy_clock(self):
+        with faults.injected("seed=0") as inj:
+            sup = TrainSupervisor(FakeTrainer(), max_restarts=0)
+            sup.run(lambda: None, 3)
+            inj.advance_clock(50)
+            assert sup.stats()["heartbeat_age_s"] >= 50
+
+    def test_user_on_step_chains(self):
+        seen = []
+        sup = TrainSupervisor(FakeTrainer(), max_restarts=0)
+        sup.run(lambda: None, 4, on_step=seen.append)
+        assert seen == [1, 2, 3, 4]
+        assert sup.steps_seen == seen
+
+
+def tiny_task():
+    def init_fn(rng):
+        return {"w": jax.random.normal(rng, (4,))}, {}
+
+    def loss_fn(params, mutable, batch, rng):
+        pred = batch["x"] @ params["w"]
+        loss = jnp.mean((pred - batch["y"]) ** 2)
+        return loss, ({}, mutable)
+
+    return init_fn, loss_fn
+
+
+def tiny_data():
+    rng = np.random.RandomState(0)
+    while True:
+        x = rng.randn(16, 4).astype(np.float32)
+        yield {"x": x, "y": (x @ np.array([1, -1, 2, 0.5],
+                                          np.float32))}
+
+
+class TestSupervisedTrainerIdentity:
+    """The real thing: Trainer.fit under the supervisor, fault-injected
+    mid-run, must finish with params identical to an uninterrupted run
+    of the same seed — resume replays from the verified checkpoint and
+    the data stream re-aligns."""
+
+    def make_trainer(self, devices, ckpt_dir):
+        init_fn, loss_fn = tiny_task()
+        return Trainer(
+            init_fn=init_fn, loss_fn=loss_fn, tx=optax.sgd(0.1),
+            mesh=MeshSpec(data=8).build(devices),
+            checkpoints=CheckpointManager(ckpt_dir, max_to_keep=3),
+            checkpoint_every=2,
+            metrics=MetricsLogger(stream=open("/dev/null", "w")))
+
+    def test_fault_mid_run_params_identical(self, devices, tmp_path):
+        control = self.make_trainer(devices, tmp_path / "control")
+        control_state = TrainSupervisor(control, max_restarts=0).run(
+            tiny_data, 6, log_every=0)
+        control.checkpoints.close()
+
+        trainer = self.make_trainer(devices, tmp_path / "victim")
+        sup = TrainSupervisor(trainer, max_restarts=2, backoff_s=5.0)
+        # Warm 4 steps (checkpoints land at 1 and 3), then fault the
+        # continuation's first step; skew expires the backoff.
+        sup.run(tiny_data, 4, log_every=0)
+        assert trainer.checkpoints.latest_verified_step() == 3
+        with faults.injected("train.step:raise*1;train.step:skew=60"):
+            final = sup.run(tiny_data, 6, log_every=0)
+        trainer.checkpoints.close()
+        assert sup.restarts == 1
+        boundaries = sup.steps_seen
+        assert boundaries == sorted(boundaries)  # monotone, never 0
+        assert boundaries[-1] == 6
+        np.testing.assert_array_equal(
+            np.asarray(final.params["w"]),
+            np.asarray(control_state.params["w"]))
+        assert int(final.step) == int(control_state.step) == 6
+
+    def test_train_step_hook_fires_per_loop_iteration(self, devices,
+                                                      tmp_path):
+        trainer = self.make_trainer(devices, tmp_path / "hook")
+        with faults.injected("seed=0") as inj:
+            trainer.fit(tiny_data(), 3, log_every=0)
+            assert inj.fired("train.step") == 3
+        trainer.checkpoints.close()
+
+
+class TestReviewRegressions:
+    def test_backoff_window_does_not_read_stale_heartbeat(self):
+        """The failed attempt's heartbeat/window are cleared BEFORE
+        the backoff wait — the watchdog must not pin
+        kft_train_stalled=1 against a stale beat during a healthy
+        supervised restart."""
+        observed = {}
+        with faults.injected("train.step:raise*1;train.step:skew=60"):
+            sup = TrainSupervisor(FakeTrainer(resume_at=1),
+                                  max_restarts=1, backoff_s=5.0)
+            orig = sup._backoff
+
+            def spy(attempt):
+                observed["beat"] = sup.stats()["heartbeat_age_s"]
+                observed["stalled_gauge"] = sample_value(
+                    parse_metrics(REGISTRY.render()),
+                    "kft_train_stalled")
+                orig(attempt)
+
+            sup._backoff = spy
+            sup.run(lambda: None, 4)
+        assert observed["beat"] is None, (
+            "stale heartbeat survived into the backoff window")
+        assert observed["stalled_gauge"] == 0.0

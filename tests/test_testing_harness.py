@@ -11,6 +11,7 @@ from kubeflow_tpu.testing.e2e import (
     scheduler_smoke,
     serving_smoke,
     tpujob_smoke,
+    train_resilience_smoke,
 )
 from kubeflow_tpu.testing.junit import JUnitSuite
 from kubeflow_tpu.testing.workflow import Step, default_e2e
@@ -100,6 +101,17 @@ class TestE2EDrivers:
         # drain-aware rolling restart with zero lost accepted
         # requests (see kubeflow_tpu/testing/e2e.py fleet_smoke).
         fleet_smoke()
+
+    def test_train_resilience_smoke(self):
+        # The ci/e2e_config.yaml hermetic `train_resilience` step:
+        # supervised in-process resume from a VERIFIED checkpoint
+        # after an injected train.step fault (params identical to an
+        # uninterrupted control run), corrupt-latest walk-back
+        # restore, and node-flap -> quarantine + anti-affinity gang
+        # re-place over the fake apiserver, with kft_train_* /
+        # kft_checkpoint_* metric deltas asserted (see
+        # kubeflow_tpu/testing/e2e.py train_resilience_smoke).
+        train_resilience_smoke()
 
 
 class _FakeKubectl:
