@@ -121,6 +121,11 @@ def main(argv=None) -> int:
     ap.add_argument("--deep", action="store_true",
                     help="also run the semantic analyzer "
                          "(python -m kubeflow_tpu.analysis)")
+    ap.add_argument("--base", default=None, metavar="REF",
+                    help="with --deep: analyze only files changed vs "
+                         "REF (--changed-only); cross-module checks "
+                         "still run in full.  CI's default stays the "
+                         "full run")
     args = ap.parse_args(argv)
     root = pathlib.Path(args.root).resolve()
     n, problems = run(root)
@@ -138,7 +143,10 @@ def main(argv=None) -> int:
                               .parent.parent))
         from kubeflow_tpu.analysis.__main__ import main as deep_main
 
-        rc = max(rc, deep_main(["--root", str(root)]))
+        deep_args = ["--root", str(root)]
+        if args.base:
+            deep_args += ["--changed-only", "--base", args.base]
+        rc = max(rc, deep_main(deep_args))
     return rc
 
 

@@ -24,8 +24,37 @@ review cycle since PR 2 has caught by hand:
                     and one metric name keeps ONE label set across
                     every call site
 
+Four more checkers are FLOW-SENSITIVE, built on the intraprocedural
+CFG + forward dataflow core in ``cfg.py`` (exception edges from every
+statement in a protected body, ``with`` enter/exit, escapes routed
+through ``finally``):
+
+  blocking-under-lock  no ``time.sleep``/backoff/socket I/O/
+                       subprocess/``Future.result``/blocking queue
+                       get/HTTP round-trip on any path where a
+                       ``self.*lock*`` is held — the router/engine/
+                       scheduler stall class
+  span-discipline      a live span (``x = tracing.start_span(...)``)
+                       ends on EVERY path out of the function,
+                       exception edges included; hot-loop modules
+                       (serving/engine.py, models/generate.py) stamp
+                       with drain-time ``record_span`` only; span
+                       names are unique per module
+  atomic-write         durable-state modules (runtime/checkpoint.py,
+                       operator/*) commit files tmp + fsync +
+                       ``os.replace`` — a bare write of a final path
+                       or a rename without fsync is the PR-10
+                       kill-mid-save bug
+  fault-site-registry  every literal ``faults.fire("<site>")`` in
+                       code appears in the testing/faults.py
+                       docstring registry AND the user-guide §5.5
+                       list, and vice versa — no phantom or
+                       undocumented KFT_FAULTS sites
+
 Run ``python -m kubeflow_tpu.analysis`` (or ``python ci/lint.py
---deep``).  Per-line suppressions use ``# kft: allow=<check>``; known
+--deep``).  ``--changed-only [--base REF]`` restricts per-module
+checkers to files changed vs REF while cross-module checks still run
+in full.  Per-line suppressions use ``# kft: allow=<check>``; known
 pre-existing findings live in the shrink-only baseline
 ``ci/analysis_baseline.json`` (see ``core.py``).  Stdlib-only.
 """

@@ -32,13 +32,33 @@ def main(argv=None) -> int:
                          "findings (the diff should only shrink)")
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="machine-readable findings on stdout")
+    ap.add_argument("--changed-only", action="store_true",
+                    help="analyze only files changed vs --base "
+                         "(git diff + untracked); cross-module "
+                         "checkers (metric label sets, fault-site "
+                         "registry) still run over the full tree")
+    ap.add_argument("--base", default="HEAD",
+                    help="base ref for --changed-only "
+                         "(default: HEAD)")
     args = ap.parse_args(argv)
 
     root = pathlib.Path(args.root).resolve()
     baseline_path = (pathlib.Path(args.baseline) if args.baseline
                      else root / DEFAULT_BASELINE)
     baseline = core.load_baseline(baseline_path)
-    report = core.run(root, baseline=baseline)
+    only = None
+    if args.changed_only:
+        if args.write_baseline:
+            print("analysis: --write-baseline needs the full run "
+                  "(a --changed-only pass sees a partial tree)",
+                  file=sys.stderr)
+            return 2
+        try:
+            only = core.changed_files(root, args.base)
+        except RuntimeError as e:
+            print(f"analysis: --changed-only: {e}", file=sys.stderr)
+            return 2
+    report = core.run(root, baseline=baseline, only=only)
 
     if args.write_baseline:
         core.write_baseline(baseline_path,

@@ -87,6 +87,8 @@ class _Visitor(ast.NodeVisitor):
 
 
 class ClockDiscipline:
+    name = CHECK
+
     def visit_module(self, rel: str, tree: ast.Module,
                      text: str) -> List[Finding]:
         if not rel.startswith(POLICY_PREFIXES):

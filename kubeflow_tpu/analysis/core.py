@@ -21,16 +21,23 @@ The moving parts, in the order a run uses them:
 Fingerprints deliberately omit line numbers — ``check::path::symbol``
 where ``symbol`` names the construct (qualified function, attribute,
 metric name), so unrelated edits above a grandfathered finding don't
-churn the baseline.
+churn the baseline.  When one (check, path, symbol) fires more than
+once, each instance is disambiguated by a short content hash of its
+own source line (``#a1b2c3d4``) instead of an ordinal — fixing the
+first of three findings must not renumber the other two and
+invalidate their baseline entries.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import json
 import pathlib
 import re
-from typing import Dict, Iterator, List, Optional, Set, Tuple
+import subprocess
+from typing import (Callable, Dict, Iterator, List, Optional, Set,
+                    Tuple)
 
 import ast
 
@@ -73,19 +80,51 @@ class Finding:
                 "fingerprint": self.fingerprint()}
 
 
-def dedupe_symbols(findings: List[Finding]) -> List[Finding]:
-    """Disambiguate repeated (check, path, symbol) triples with a #n
-    suffix so every fingerprint in a run is unique (two bare
-    ``time.time()`` calls in one function must not collapse into one
-    baseline entry)."""
-    seen: Dict[str, int] = {}
-    out: List[Finding] = []
+def _line_hash(line: str) -> str:
+    return hashlib.blake2b(line.strip().encode("utf-8"),
+                           digest_size=4).hexdigest()
+
+
+def dedupe_symbols(findings: List[Finding],
+                   line_of: Optional[Callable[[Finding], str]] = None
+                   ) -> List[Finding]:
+    """Disambiguate repeated (check, path, symbol) triples so every
+    fingerprint in a run is unique (two bare ``time.time()`` calls in
+    one function must not collapse into one baseline entry).
+
+    The disambiguator is a STABLE content hash of each finding's own
+    source line (``#<8 hex>``), not an ordinal: fixing finding #1 of a
+    group leaves every other member's fingerprint unchanged, so
+    unrelated baseline entries survive the fix.  Identical source
+    lines inside one group (the only case a content hash can't split)
+    fall back to an ordinal *within that content* (``#<hash>.2``).
+    Singleton groups keep the bare symbol.  Without ``line_of``
+    (legacy callers) the old pure-ordinal ``#n`` scheme applies."""
+    by_fp: Dict[str, int] = {}
     for f in findings:
-        n = seen.get(f.fingerprint(), 0)
-        seen[f.fingerprint()] = n + 1
-        if n:
-            f = dataclasses.replace(f, symbol=f"{f.symbol}#{n + 1}")
-        out.append(f)
+        by_fp[f.fingerprint()] = by_fp.get(f.fingerprint(), 0) + 1
+    out: List[Finding] = []
+    ordinal: Dict[str, int] = {}
+    content_seen: Dict[Tuple[str, str], int] = {}
+    for f in findings:
+        fp = f.fingerprint()
+        if by_fp[fp] < 2:
+            out.append(f)
+            continue
+        n = ordinal.get(fp, 0)
+        ordinal[fp] = n + 1
+        if line_of is None:
+            if n:
+                f = dataclasses.replace(f, symbol=f"{f.symbol}#{n + 1}")
+            out.append(f)
+            continue
+        suffix = _line_hash(line_of(f))
+        dup = content_seen.get((fp, suffix), 0)
+        content_seen[(fp, suffix)] = dup + 1
+        if dup:
+            suffix = f"{suffix}.{dup + 1}"
+        out.append(dataclasses.replace(
+            f, symbol=f"{f.symbol}#{suffix}"))
     return out
 
 
@@ -111,6 +150,31 @@ def suppressions(text: str) -> Dict[int, Set[str]]:
             out.setdefault(lineno, set()).update(pending)
             pending = set()
     return out
+
+
+def expand_decorator_suppressions(tree: ast.Module,
+                                  supp: Dict[int, Set[str]]
+                                  ) -> Dict[int, Set[str]]:
+    """Resolve suppressions against decorator-inclusive def spans.
+
+    A directive on the comment line above ``@decorator`` lands on the
+    decorator's line (the next code line) — but findings that anchor
+    to the ``def`` itself (``node.lineno`` of a FunctionDef excludes
+    its decorators) would miss it.  Any suppression attached to a line
+    in ``[first decorator, def]`` also covers the def line."""
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+            continue
+        if not node.decorator_list:
+            continue
+        first = min(d.lineno for d in node.decorator_list)
+        gathered: Set[str] = set()
+        for line in range(first, node.lineno + 1):
+            gathered |= supp.get(line, set())
+        if gathered:
+            supp.setdefault(node.lineno, set()).update(gathered)
+    return supp
 
 
 def apply_suppressions(findings: List[Finding],
@@ -182,12 +246,39 @@ def py_files(root: pathlib.Path) -> Iterator[pathlib.Path]:
 
 
 def default_checkers() -> List[object]:
+    from kubeflow_tpu.analysis.atomicwrite import AtomicWrite
+    from kubeflow_tpu.analysis.blocking import BlockingUnderLock
     from kubeflow_tpu.analysis.clock import ClockDiscipline
+    from kubeflow_tpu.analysis.faultsites import FaultSiteRegistry
     from kubeflow_tpu.analysis.jitpurity import JitPurity
     from kubeflow_tpu.analysis.locks import LockGuard
     from kubeflow_tpu.analysis.metrics import MetricHygiene
+    from kubeflow_tpu.analysis.spans import SpanDiscipline
 
-    return [ClockDiscipline(), LockGuard(), JitPurity(), MetricHygiene()]
+    return [ClockDiscipline(), LockGuard(), JitPurity(),
+            MetricHygiene(), BlockingUnderLock(), SpanDiscipline(),
+            AtomicWrite(), FaultSiteRegistry()]
+
+
+def changed_files(root: pathlib.Path, base: str) -> Set[str]:
+    """Repo-relative paths touched vs ``base``: committed + staged +
+    working-tree changes (``git diff base``) plus untracked files.
+    Raises RuntimeError when git can't answer (not a repo, bad ref)."""
+
+    def git(*args: str) -> str:
+        proc = subprocess.run(
+            ["git", *args], cwd=str(root), capture_output=True,
+            text=True)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"git {' '.join(args)} failed: "
+                f"{proc.stderr.strip() or proc.returncode}")
+        return proc.stdout
+
+    out = set(git("diff", "--name-only", base, "--").splitlines())
+    out |= set(git("ls-files", "--others",
+                   "--exclude-standard").splitlines())
+    return {p for p in out if p}
 
 
 @dataclasses.dataclass
@@ -203,11 +294,34 @@ class Report:
         return not self.findings and not self.stale
 
 
+def _line_lookup(texts: Dict[str, List[str]]
+                 ) -> Callable[[Finding], str]:
+    def line_of(f: Finding) -> str:
+        lines = texts.get(f.path, ())
+        return lines[f.line - 1] if 0 < f.line <= len(lines) else ""
+
+    return line_of
+
+
 def run(root: pathlib.Path,
         baseline: Optional[List[str]] = None,
-        checkers: Optional[List[object]] = None) -> Report:
+        checkers: Optional[List[object]] = None,
+        only: Optional[Set[str]] = None) -> Report:
+    """Full-tree analysis, or — with ``only`` (a set of repo-relative
+    paths, the ``--changed-only`` mode) — per-module checkers
+    restricted to those files while ``cross_module`` checkers (label
+    sets, fault-site registry) still see the whole tree; their
+    ``finish()`` verdicts are kept regardless of path.  Stale-baseline
+    enforcement in restricted runs covers only entries the run could
+    have re-fired (changed paths + cross-module checks)."""
     checkers = default_checkers() if checkers is None else checkers
+    for checker in checkers:
+        if hasattr(checker, "set_root"):
+            checker.set_root(root)
+    cross = [c for c in checkers
+             if getattr(c, "cross_module", False)]
     per_file: Dict[str, Dict[int, Set[str]]] = {}
+    texts: Dict[str, List[str]] = {}
     findings: List[Finding] = []
     files = 0
     for path in py_files(root):
@@ -218,15 +332,23 @@ def run(root: pathlib.Path,
         except SyntaxError:
             continue  # ci/lint.py owns the parse gate
         files += 1
-        per_file[rel] = suppressions(text)
-        for checker in checkers:
+        per_file[rel] = expand_decorator_suppressions(
+            tree, suppressions(text))
+        texts[rel] = text.splitlines()
+        active = (checkers if only is None or rel in only else cross)
+        for checker in active:
             findings.extend(checker.visit_module(rel, tree, text))
     for checker in checkers:
         findings.extend(checker.finish())
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.check))
-    findings = dedupe_symbols(findings)
+    findings = dedupe_symbols(findings, _line_lookup(texts))
     findings, suppressed = apply_suppressions(findings, per_file)
     new, old, stale = split_by_baseline(findings, baseline or [])
+    if only is not None:
+        cross_names = {getattr(c, "name", "") for c in cross}
+        stale = [fp for fp in stale
+                 if fp.split("::")[0] in cross_names
+                 or (fp.split("::") + ["", ""])[1] in only]
     return Report(findings=new, baselined=old, stale=stale,
                   suppressed=suppressed, files=files)
 
@@ -244,6 +366,9 @@ def analyze_source(text: str, rel: str = "kubeflow_tpu/mod.py",
     for checker in checkers:
         findings.extend(checker.finish())
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.check))
-    findings = dedupe_symbols(findings)
-    findings, _ = apply_suppressions(findings, {rel: suppressions(text)})
+    lines = text.splitlines()
+    findings = dedupe_symbols(findings, _line_lookup({rel: lines}))
+    findings, _ = apply_suppressions(
+        findings, {rel: expand_decorator_suppressions(
+            tree, suppressions(text))})
     return findings

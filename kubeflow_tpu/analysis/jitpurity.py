@@ -72,6 +72,8 @@ def _decorated_jitted(fn) -> bool:
 
 
 class JitPurity:
+    name = CHECK
+
     def visit_module(self, rel: str, tree: ast.Module,
                      text: str) -> List[Finding]:
         defs: Dict[str, ast.AST] = {}

@@ -102,6 +102,8 @@ class _MethodWalk:
 
 
 class LockGuard:
+    name = CHECK
+
     def visit_module(self, rel: str, tree: ast.Module,
                      text: str) -> List[Finding]:
         findings: List[Finding] = []

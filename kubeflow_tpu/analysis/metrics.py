@@ -41,6 +41,11 @@ _AMBIGUOUS = object()
 
 
 class MetricHygiene:
+    name = CHECK
+    # Label-set consistency is a repo-wide property: --changed-only
+    # runs still feed this checker every module.
+    cross_module = True
+
     def __init__(self):
         # name constants seen anywhere: identifier -> value|_AMBIGUOUS
         self._consts: Dict[str, object] = {}

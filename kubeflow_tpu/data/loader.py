@@ -119,6 +119,11 @@ def _native_lib():
                     or so_path.stat().st_mtime < _NATIVE_SRC.stat().st_mtime):
                 cmd = ["g++", "-O3", "-shared", "-fPIC", "-pthread",
                        "-std=c++17", str(_NATIVE_SRC), "-o", str(so_path)]
+                # Serializing the one-time native build IS the point
+                # of _build_lock: racing compilers would clobber the
+                # shared .so; every later call hits the cached fast
+                # path without blocking.
+                # kft: allow=blocking-under-lock
                 subprocess.run(cmd, check=True, capture_output=True)
                 log.info("built native data core -> %s", so_path)
             lib = ctypes.CDLL(str(so_path))

@@ -511,22 +511,25 @@ class ModelServer:
                 self._inflight_by_model[name] = \
                     self._inflight_by_model.get(name, 0) + 1
         except Overloaded:
-            if ctx is not None:
-                tracing.record_span(
-                    "server.admission", ctx, t_adm,
-                    time.perf_counter(), status="shed",
-                    attrs={"model": name})
+            self._record_admission(ctx, name, t_adm, status="shed")
             raise
-        if ctx is not None:
-            tracing.record_span(
-                "server.admission", ctx, t_adm, time.perf_counter(),
-                attrs={"model": name})
+        self._record_admission(ctx, name, t_adm)
         try:
             return self._predict(name, inputs, version, deadline)
         finally:
             with self._lock:
                 self._inflight -= 1
                 self._inflight_by_model[name] -= 1
+
+    def _record_admission(self, ctx, name: str, t_adm: float,
+                          status: str = "ok") -> None:
+        """The one server.admission stamping site (span names are
+        unique per module — span-discipline): shed and admitted
+        verdicts both land here."""
+        if ctx is not None:
+            tracing.record_span(
+                "server.admission", ctx, t_adm, time.perf_counter(),
+                status=status, attrs={"model": name})
 
     def _predict(
         self, name: str, inputs: Dict[str, Any],
@@ -899,6 +902,21 @@ class MicroBatcher:
         self._pending_total -= len(batch)
         return batch
 
+    def _record_queue_wait(self, entries: List[dict],
+                           status: str = "ok") -> None:
+        """The one batcher.queue_wait stamping site (span names are
+        unique per module — span-discipline): dispatched and
+        deadline-expired entries both land here."""
+        if not any(e["trace"] is not None for e in entries):
+            return
+        now_perf = time.perf_counter()
+        for e in entries:
+            if e["trace"] is not None:
+                tracing.record_span(
+                    "batcher.queue_wait", e["trace"], e["t_perf"],
+                    now_perf, status=status,
+                    attrs={"batcher": self._metric_name})
+
     def _run(self) -> None:
         while True:
             expired: List[dict] = []
@@ -944,26 +962,14 @@ class MicroBatcher:
                 err = DeadlineExceeded(
                     f"deadline expired in batcher "
                     f"{self._metric_name!r} queue")
-                now_perf = time.perf_counter()
+                self._record_queue_wait(expired,
+                                        status="deadline_expired")
                 for e in expired:
-                    if e["trace"] is not None:
-                        tracing.record_span(
-                            "batcher.queue_wait", e["trace"],
-                            e["t_perf"], now_perf,
-                            status="deadline_expired",
-                            attrs={"batcher": self._metric_name})
                     e["err"] = err
                     e["event"].set()
             if batch is None:
                 continue
-            if any(e["trace"] is not None for e in batch):
-                now_perf = time.perf_counter()
-                for e in batch:
-                    if e["trace"] is not None:
-                        tracing.record_span(
-                            "batcher.queue_wait", e["trace"],
-                            e["t_perf"], now_perf,
-                            attrs={"batcher": self._metric_name})
+            self._record_queue_wait(batch)
             try:
                 self._process(batch)
             finally:

@@ -29,13 +29,17 @@ jax.config.update("jax_platforms", "cpu")
 
 # Lock-order sanitizer (KFT_LOCKCHECK=1): the serving/fleet suites
 # construct the heavily-threaded objects (engine, batchers, registry,
-# router), so they run with threading.Lock instrumented.  The
-# sanitizer installs ONCE and the acquisition graph accumulates
-# across tests — an inconsistent nesting order between two different
-# tests still closes a cycle, and the test that closed it fails with
-# both paths spelled out.  Off by default: instrumentation taxes
-# every acquire, and the tier-1 budget is tight.
-_LOCKCHECK_MODULES = {"test_serving", "test_fleet"}
+# router), and the scheduler/supervisor suites are the most
+# lock-heavy ones added since (policy + queue + rate-limiter locks;
+# supervisor heartbeat/watchdog state), so all four run with
+# threading.Lock instrumented.  The sanitizer installs ONCE and the
+# acquisition graph accumulates across tests — an inconsistent
+# nesting order between two different tests still closes a cycle,
+# and the test that closed it fails with both paths spelled out.
+# Off by default: instrumentation taxes every acquire, and the
+# tier-1 budget is tight.
+_LOCKCHECK_MODULES = {"test_serving", "test_fleet", "test_scheduler",
+                      "test_supervisor"}
 
 
 @pytest.fixture(autouse=True)

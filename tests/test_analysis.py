@@ -382,6 +382,582 @@ class TestCLI:
         assert _analyze(root).returncode == 0
 
 
+# -- the four flow-sensitive checkers (analysis/cfg.py dataflow) ------------
+#
+# Each seeded-mutation test pairs a faithful copy of REAL repo code
+# (which must stay clean) with a minimally-broken variant (which must
+# produce exactly the expected finding) — the checker is proven on the
+# code shapes it exists to guard, not on toy fixtures.
+
+# Mirrors testing/faults.py FaultInjector.fire: the sleep runs OUTSIDE
+# the lock by design.
+FIRE_CLEAN = """
+    import threading
+    import time
+
+
+    class FaultInjector:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._fired = {}
+            self._specs = {}
+
+        def fire(self, site):
+            sleep_s = 0.0
+            with self._lock:
+                self._fired[site] = self._fired.get(site, 0) + 1
+                for s in self._specs.get(site, ()):
+                    sleep_s += s.value
+            if sleep_s:
+                time.sleep(sleep_s)
+"""
+
+# Minimal mutation: the sleep moved INSIDE the locked region.
+FIRE_MUTATED = """
+    import threading
+    import time
+
+
+    class FaultInjector:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._fired = {}
+            self._specs = {}
+
+        def fire(self, site):
+            sleep_s = 0.0
+            with self._lock:
+                self._fired[site] = self._fired.get(site, 0) + 1
+                for s in self._specs.get(site, ()):
+                    sleep_s += s.value
+                if sleep_s:
+                    time.sleep(sleep_s)
+"""
+
+
+class TestBlockingUnderLock:
+    def test_real_fire_shape_is_clean(self):
+        assert analyze_source(_src(FIRE_CLEAN), rel=POLICY) == []
+
+    def test_sleep_under_registry_lock_fires(self):
+        found = analyze_source(_src(FIRE_MUTATED), rel=POLICY)
+        assert [f.check for f in found] == ["blocking-under-lock"]
+        assert "time.sleep" in found[0].message
+        assert "self._lock" in found[0].message
+        assert found[0].symbol == "time.sleep@FaultInjector.fire"
+
+    def test_released_before_sleep_is_clean(self):
+        found = analyze_source(_src("""
+            import time
+
+
+            class C:
+                def wait(self):
+                    self._lock.acquire()
+                    n = self._n
+                    self._lock.release()
+                    time.sleep(n)
+        """), rel=POLICY)
+        assert found == []
+
+    def test_locked_suffix_method_counts_as_held(self):
+        found = analyze_source(_src("""
+            import time
+
+
+            class C:
+                def _sweep_locked(self):
+                    time.sleep(0.1)
+        """), rel=POLICY)
+        assert len(found) == 1
+        assert "caller-held" in found[0].message
+
+    def test_exception_path_releases_lock(self):
+        # A raise inside the with block exits the lock before the
+        # handler runs: the handler's sleep is NOT under the lock.
+        found = analyze_source(_src("""
+            import time
+
+
+            class C:
+                def step(self):
+                    try:
+                        with self._lock:
+                            self._n += 1
+                            raise ValueError("x")
+                    except ValueError:
+                        time.sleep(0.1)
+        """), rel=POLICY)
+        assert found == []
+
+    def test_future_result_and_blocking_get_fire(self):
+        found = analyze_source(_src("""
+            class C:
+                def drain(self):
+                    with self._lock:
+                        item = self._queue.get(block=True)
+                        return self._future.result()
+        """), rel=POLICY)
+        assert sorted(f.symbol for f in found) == [
+            "Future.result@C.drain",
+            "queue-get(block=True)@C.drain"]
+
+    def test_suppression_honored(self):
+        found = analyze_source(_src("""
+            import time
+
+
+            class C:
+                def build(self):
+                    with self._build_lock:
+                        # serializing the one-time build is the point
+                        # kft: allow=blocking-under-lock
+                        time.sleep(0.1)
+        """), rel=POLICY)
+        assert found == []
+
+
+# Mirrors scheduler/queue.py ClusterScheduler.plan: the except path
+# ends the span before re-raising.
+PLAN_CLEAN = """
+    from kubeflow_tpu.runtime import tracing
+
+
+    class ClusterScheduler:
+        def plan(self, cr_objs):
+            span = tracing.start_span("scheduler.plan")
+            try:
+                plan = self._plan_inner(cr_objs)
+            except BaseException:
+                span.end(status="error")
+                raise
+            span.end(status="ok")
+            return plan
+"""
+
+# Minimal mutation: the except path re-raises without ending the span.
+PLAN_MUTATED = PLAN_CLEAN.replace(
+    '            span.end(status="error")\n', "")
+
+
+class TestSpanDiscipline:
+    def test_real_plan_shape_is_clean(self):
+        assert analyze_source(_src(PLAN_CLEAN), rel=POLICY) == []
+
+    def test_span_leak_on_exception_edge_fires(self):
+        found = analyze_source(_src(PLAN_MUTATED), rel=POLICY)
+        assert [f.check for f in found] == ["span-discipline"]
+        assert found[0].symbol == "leak:span@ClusterScheduler.plan"
+        # Anchored at the start_span line, where the fix begins.
+        assert "started here" in found[0].message
+
+    def test_end_in_finally_is_clean(self):
+        found = analyze_source(_src("""
+            from kubeflow_tpu.runtime import tracing
+
+
+            def handle(req):
+                span = tracing.start_span("server.handle")
+                try:
+                    return work(req)
+                finally:
+                    span.end()
+        """), rel=POLICY)
+        assert found == []
+
+    def test_ownership_transfer_not_a_leak(self):
+        found = analyze_source(_src("""
+            from kubeflow_tpu.runtime import tracing
+
+
+            def begin(name):
+                span = tracing.start_span(name)
+                return span
+        """), rel=POLICY)
+        assert found == []
+
+    def test_rebind_while_live_fires(self):
+        found = analyze_source(_src("""
+            from kubeflow_tpu.runtime import tracing
+
+
+            def loop(items):
+                for item in items:
+                    span = tracing.start_span("hop")
+                    work(item)
+        """), rel=POLICY)
+        checks = {f.symbol.split(":")[0] for f in found}
+        assert "leak" in checks  # alive at exit too
+        assert "rebind" in checks
+
+    def test_hot_loop_module_must_record_span(self):
+        found = analyze_source(_src("""
+            from kubeflow_tpu.runtime import tracing
+
+
+            def _drain(self):
+                span = tracing.start_span("engine.decode")
+                span.end()
+        """), rel="kubeflow_tpu/serving/engine.py")
+        assert [f.symbol for f in found] == ["hot-start-span"]
+
+    def test_duplicate_span_name_fires(self):
+        found = analyze_source(_src("""
+            from kubeflow_tpu.runtime import tracing
+
+
+            def a(ctx, t0, t1):
+                tracing.record_span("batcher.queue_wait", ctx, t0, t1)
+
+
+            def b(ctx, t0, t1):
+                tracing.record_span("batcher.queue_wait", ctx, t0, t1)
+        """), rel=POLICY)
+        assert [f.symbol for f in found] == [
+            "dup-name:batcher.queue_wait"]
+
+    def test_suppression_honored(self):
+        found = analyze_source(_src("""
+            from kubeflow_tpu.runtime import tracing
+
+
+            def fire_and_forget(name):
+                # ownership handed to the store's aging sweep
+                # kft: allow=span-discipline
+                span = tracing.start_span(name)
+                poke(span)
+        """), rel=POLICY)
+        assert found == []
+
+
+CKPT = "kubeflow_tpu/runtime/checkpoint.py"
+
+# Mirrors runtime/checkpoint.py _atomic_write_json.
+ATOMIC_CLEAN = """
+    import json
+    import os
+
+
+    def _atomic_write_json(path, payload):
+        tmp = path.with_name(path.name + ".tmp")
+        with open(tmp, "w") as f:
+            json.dump(payload, f, indent=1, sort_keys=True)
+            f.write("\\n")
+            f.flush()
+            os.fsync(f.fileno())
+        os.rename(tmp, path)
+"""
+
+
+class TestAtomicWrite:
+    def test_real_atomic_write_is_clean(self):
+        assert analyze_source(_src(ATOMIC_CLEAN), rel=CKPT) == []
+
+    def test_rename_without_fsync_fires(self):
+        mutated = ATOMIC_CLEAN.replace(
+            "            os.fsync(f.fileno())\n", "")
+        found = analyze_source(_src(mutated), rel=CKPT)
+        assert [f.check for f in found] == ["atomic-write"]
+        assert found[0].symbol == \
+            "rename-no-fsync:tmp@_atomic_write_json"
+
+    def test_bare_write_of_manifest_path_fires(self):
+        found = analyze_source(_src("""
+            import json
+
+
+            def write_manifest(path, payload):
+                with open(path, "w") as f:
+                    json.dump(payload, f)
+        """), rel=CKPT)
+        assert [f.check for f in found] == ["atomic-write"]
+        assert found[0].symbol == "bare-write:path@write_manifest"
+
+    def test_write_text_in_durable_module_fires(self):
+        found = analyze_source(_src("""
+            def stamp(path):
+                path.write_text("done")
+        """), rel="kubeflow_tpu/operator/status.py")
+        assert [f.symbol for f in found] == ["bare-write-text@stamp"]
+
+    def test_exception_path_abandoning_tmp_is_fine(self):
+        # A raise between write and rename leaves only the .tmp — the
+        # missing rename IS the detectable-dead-save protocol.
+        mutated = ATOMIC_CLEAN.replace(
+            "            f.flush()\n",
+            "            maybe_raise()\n            f.flush()\n")
+        assert analyze_source(_src(mutated), rel=CKPT) == []
+
+    def test_non_durable_module_out_of_scope(self):
+        found = analyze_source(_src("""
+            def scratch(path):
+                with open(path, "w") as f:
+                    f.write("tmp")
+        """), rel=POLICY)
+        assert found == []
+
+    def test_suppression_honored(self):
+        found = analyze_source(_src("""
+            def debug_dump(path, text):
+                # scratch diagnostics, not durable state
+                # kft: allow=atomic-write
+                with open(path, "w") as f:
+                    f.write(text)
+        """), rel=CKPT)
+        assert found == []
+
+
+FAULTS_REL = "kubeflow_tpu/testing/faults.py"
+
+FAULTS_DOC = '''"""Fault harness.
+
+Hook sites planted in production code (grep for ``faults.fire``):
+
+    engine.step       before each step-program call
+    loader.load       before each load attempt
+"""
+'''
+
+PRODUCER = '''"""m."""
+from kubeflow_tpu.testing import faults
+
+
+def go():
+    faults.fire("engine.step")
+    faults.fire("loader.load")
+'''
+
+
+class TestFaultSiteRegistry:
+    def _finish(self, faults_text, producer_text, root=None):
+        import ast as _ast
+
+        from kubeflow_tpu.analysis.faultsites import FaultSiteRegistry
+
+        checker = FaultSiteRegistry(root)
+        checker.visit_module(FAULTS_REL, _ast.parse(faults_text),
+                             faults_text)
+        checker.visit_module("kubeflow_tpu/serving/mod.py",
+                             _ast.parse(producer_text), producer_text)
+        return checker.finish()
+
+    def test_registry_and_code_in_lockstep_is_clean(self):
+        assert self._finish(FAULTS_DOC, PRODUCER) == []
+
+    def test_unregistered_site_fires(self):
+        mutated = PRODUCER + '    faults.fire("engine.warp")\n'
+        found = self._finish(FAULTS_DOC, mutated)
+        assert [f.symbol for f in found] == ["unregistered:engine.warp"]
+        assert found[0].path == "kubeflow_tpu/serving/mod.py"
+
+    def test_phantom_registry_entry_fires(self):
+        mutated = PRODUCER.replace(
+            '    faults.fire("loader.load")\n', "")
+        found = self._finish(FAULTS_DOC, mutated)
+        assert [f.symbol for f in found] == ["phantom:loader.load"]
+        assert found[0].path == FAULTS_REL
+        assert found[0].line > 1  # anchored at the registry row
+
+    def test_docs_side_checked_when_root_given(self, tmp_path):
+        docs = tmp_path / "docs"
+        docs.mkdir()
+        (docs / "user_guide.md").write_text(
+            "### 5.5 Failure semantics\n\n"
+            "**Fault injection.**  Hook sites `engine.step` and\n"
+            "`engine.vanished` fire scripted faults.\n\n"
+            "```bash\nKFT_FAULTS=...\n```\n")
+        found = self._finish(FAULTS_DOC, PRODUCER, root=tmp_path)
+        assert sorted(f.symbol for f in found) == [
+            "phantom-doc:engine.vanished",
+            "undocumented:loader.load"]
+
+    def test_repo_registries_in_lockstep(self):
+        # The real tree: code, faults.py docstring, and user-guide
+        # §5.5 must agree exactly (the full-run clean test covers
+        # this too; this one isolates the checker).
+        import ast as _ast
+
+        from kubeflow_tpu.analysis.faultsites import FaultSiteRegistry
+
+        checker = FaultSiteRegistry(REPO)
+        for path in core.py_files(REPO):
+            rel = path.relative_to(REPO).as_posix()
+            text = path.read_text(encoding="utf-8")
+            checker.visit_module(rel, _ast.parse(text), text)
+        assert checker.finish() == []
+
+
+class TestFingerprintStability:
+    THREE = """
+        import time
+
+        A = time.time() + 1
+        B = time.time() + 2
+        C = time.time() + 3
+    """
+
+    def test_content_hash_disambiguates(self):
+        found = analyze_source(_src(self.THREE), rel=POLICY)
+        assert len(found) == 3
+        fps = [f.fingerprint() for f in found]
+        assert len(set(fps)) == 3
+        assert all("#" in fp for fp in fps)
+
+    def test_fixing_first_leaves_others_unchanged(self):
+        before = analyze_source(_src(self.THREE), rel=POLICY)
+        fixed = self.THREE.replace("        A = time.time() + 1\n", "")
+        after = analyze_source(_src(fixed), rel=POLICY)
+        assert len(after) == 2
+        before_fps = {f.fingerprint() for f in before}
+        after_fps = {f.fingerprint() for f in after}
+        # The survivors keep their exact fingerprints: no renumbering,
+        # no invalidated baseline entries.
+        assert after_fps < before_fps
+
+    def test_identical_lines_still_unique(self):
+        found = analyze_source(_src("""
+            import time
+
+
+            def f():
+                probe(time.time(), time.time())
+        """), rel=POLICY)
+        fps = [f.fingerprint() for f in found]
+        assert len(fps) == 2 and len(set(fps)) == 2
+
+    def test_singleton_keeps_bare_symbol(self):
+        found = analyze_source(_src("""
+            import time
+
+            D = time.monotonic() + 1
+        """), rel=POLICY)
+        assert found[0].symbol == "time.monotonic@<module>"
+
+
+class _DefAnchored:
+    """Test-only checker anchoring findings at the ``def`` line —
+    the decorated-def suppression regression needs one."""
+
+    name = "def-anchored"
+
+    def visit_module(self, rel, tree, text):
+        import ast as _ast
+
+        return [core.Finding(
+            check="def-anchored", path=rel, line=node.lineno,
+            col=node.col_offset, message="m",
+            symbol=f"def:{node.name}")
+            for node in _ast.walk(tree)
+            if isinstance(node, (_ast.FunctionDef,
+                                 _ast.AsyncFunctionDef))]
+
+    def finish(self):
+        return []
+
+
+class TestDecoratedDefSuppression:
+    DECORATED = """
+        import functools
+
+
+        # {directive}
+        @functools.cache
+        def f():
+            return 1
+    """
+
+    def test_directive_above_decorator_covers_the_def(self):
+        src = _src(self.DECORATED.format(
+            directive="kft: allow=def-anchored"))
+        found = analyze_source(src, rel=POLICY,
+                               checkers=[_DefAnchored()])
+        assert found == []
+
+    def test_without_directive_still_fires(self):
+        src = _src(self.DECORATED.format(directive="plain comment"))
+        found = analyze_source(src, rel=POLICY,
+                               checkers=[_DefAnchored()])
+        assert [f.symbol for f in found] == ["def:f"]
+
+    def test_directive_on_decorator_line_covers_the_def(self):
+        found = analyze_source(_src("""
+            import functools
+
+
+            @functools.cache  # kft: allow=def-anchored
+            def f():
+                return 1
+        """), rel=POLICY, checkers=[_DefAnchored()])
+        assert found == []
+
+
+def _git(root, *args):
+    proc = subprocess.run(
+        ["git", "-c", "user.email=t@t", "-c", "user.name=t", *args],
+        cwd=str(root), capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stderr
+    return proc.stdout
+
+
+class TestChangedOnly:
+    def _repo(self, tmp_path):
+        pkg = tmp_path / "kubeflow_tpu" / "serving"
+        pkg.mkdir(parents=True)
+        (tmp_path / "ci").mkdir()
+        (pkg / "a.py").write_text(
+            '"""a."""\nimport time\nD = time.monotonic() + 1\n')
+        (pkg / "b.py").write_text('"""b."""\n')
+        _git(tmp_path, "init", "-q")
+        _git(tmp_path, "add", ".")
+        _git(tmp_path, "commit", "-qm", "seed")
+        return pkg
+
+    def test_only_changed_files_analyzed(self, tmp_path):
+        pkg = self._repo(tmp_path)
+        (pkg / "b.py").write_text(
+            '"""b."""\nimport time\nE = time.monotonic() + 1\n')
+        proc = _analyze(tmp_path, "--changed-only", "--base", "HEAD")
+        assert proc.returncode == 1
+        assert "b.py" in proc.stdout
+        # a.py's pre-existing finding is out of scope for this diff.
+        assert "a.py" not in proc.stdout
+        full = _analyze(tmp_path)
+        assert "a.py" in full.stdout and "b.py" in full.stdout
+
+    def test_cross_module_checks_still_run_in_full(self, tmp_path):
+        pkg = self._repo(tmp_path)
+        (pkg / "a.py").write_text(
+            '"""a."""\n'
+            'C = REGISTRY.counter("kft_req_total", "h")\n'
+            'C.inc(model="m")\n')
+        _git(tmp_path, "add", ".")
+        _git(tmp_path, "commit", "-qm", "metrics")
+        # Change ONLY b.py — but its new label set conflicts with the
+        # unchanged a.py registration, which the full-tree
+        # cross-module pass must still see.
+        (pkg / "b.py").write_text(
+            '"""b."""\n'
+            'REGISTRY.counter("kft_req_total", "h").inc(endpoint="e")\n')
+        proc = _analyze(tmp_path, "--changed-only", "--base", "HEAD")
+        assert proc.returncode == 1
+        assert "one name, one label set" in proc.stdout
+
+    def test_untouched_clean_tree_passes(self, tmp_path):
+        self._repo(tmp_path)
+        # a.py's violation predates the diff: a no-change run is green
+        # in changed-only mode (and red in full mode).
+        proc = _analyze(tmp_path, "--changed-only", "--base", "HEAD")
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert _analyze(tmp_path).returncode == 1
+
+    def test_write_baseline_refused(self, tmp_path):
+        self._repo(tmp_path)
+        proc = _analyze(tmp_path, "--changed-only",
+                        "--write-baseline")
+        assert proc.returncode == 2
+        assert "full run" in proc.stderr
+
+
 # The runtime half of the lock story: the static lock-guard checker
 # proves writes hold the lock; the sanitizer proves locks NEST in one
 # global order (tests/conftest.py enables it for the serving/fleet

@@ -1,0 +1,299 @@
+"""Golden-edge suite for the analysis CFG + dataflow core.
+
+Each test pins the EDGES the acceptance criteria name: try/finally
+with return in both bodies, while/else (break bypasses else), nested
+with exception routing, bare-raise re-raise in except handlers, and
+generator functions (whose bodies must not inherit definition-site
+lock state).  A wrong edge here silently corrupts every flow-sensitive
+checker built on top, so the graph shape itself is the contract."""
+
+import ast
+import textwrap
+
+from kubeflow_tpu.analysis import analyze_source, cfg
+
+
+def _graph(src: str, name: str = None):
+    tree = ast.parse(textwrap.dedent(src))
+    fns = list(cfg.top_level_functions(tree))
+    if name is not None:
+        fns = [(q, f) for q, f in fns if q == name]
+    graph = cfg.build_cfg(fns[0][1])
+    assert graph is not None
+    return graph
+
+
+def _node(graph, line, kind=None, exceptional=None):
+    hits = [n for n in graph.nodes
+            if n.lineno == line
+            and (kind is None or n.kind == kind)
+            and (exceptional is None or n.exceptional == exceptional)]
+    assert hits, f"no node at line {line} kind={kind}"
+    return hits[0]
+
+
+def _reaches(src_node, dst_node) -> bool:
+    seen, stack = set(), [src_node]
+    while stack:
+        node = stack.pop()
+        if node is dst_node:
+            return True
+        if node in seen:
+            continue
+        seen.add(node)
+        stack.extend(succ for succ, _ in node.succs)
+    return False
+
+
+class TestTryFinally:
+    SRC = """
+    def f():
+        try:
+            return 1
+        finally:
+            return 2
+    """
+
+    def test_return_routes_through_finally(self):
+        graph = _graph(self.SRC)
+        ret1 = _node(graph, 4)
+        # The try-body return must NOT reach exit directly: its only
+        # normal successor is a finally copy whose own return wins.
+        assert all(succ.kind == "finally"
+                   for succ, kind in ret1.succs if kind == cfg.NORMAL)
+        assert _reaches(ret1, graph.exit)
+
+    def test_finally_return_overrides(self):
+        graph = _graph(self.SRC)
+        # Every path into exit comes from the finally's `return 2`.
+        preds = [n for n in graph.nodes
+                 if any(s is graph.exit for s, _ in n.succs)]
+        assert preds and all(p.lineno == 6 for p in preds)
+
+    def test_exception_copy_also_built(self):
+        graph = _graph(self.SRC)
+        ret1 = _node(graph, 4)
+        exc_targets = [s for s, kind in ret1.succs
+                       if kind == cfg.EXCEPTION]
+        assert exc_targets and all(t.kind == "finally"
+                                   for t in exc_targets)
+
+
+class TestWhileElse:
+    SRC = """
+    def g():
+        n = 5
+        while n:
+            if n == 1:
+                break
+            n -= 1
+        else:
+            n = 99
+        return n
+    """
+
+    def test_false_edge_enters_else(self):
+        graph = _graph(self.SRC)
+        test = _node(graph, 4, kind="loop-test")
+        else_stmt = _node(graph, 9)
+        assert any(s is else_stmt for s, _ in test.succs)
+
+    def test_break_bypasses_else(self):
+        graph = _graph(self.SRC)
+        brk = _node(graph, 6)
+        else_stmt = _node(graph, 9)
+        ret = _node(graph, 10)
+        assert not _reaches(brk, else_stmt)
+        assert _reaches(brk, ret)
+
+    def test_back_edge(self):
+        graph = _graph(self.SRC)
+        body_tail = _node(graph, 7)
+        test = _node(graph, 4, kind="loop-test")
+        assert any(s is test for s, _ in body_tail.succs)
+
+
+class TestNestedWith:
+    SRC = """
+    def h(self):
+        with self._lock:
+            with self._inner_lock:
+                work()
+        tail()
+    """
+
+    def test_exception_unwinds_both_exits(self):
+        graph = _graph(self.SRC)
+        work = _node(graph, 5)
+        inner_exc = _node(graph, 4, kind="with-exit",
+                          exceptional=True)
+        outer_exc = _node(graph, 3, kind="with-exit",
+                          exceptional=True)
+        assert any(s is inner_exc and k == cfg.EXCEPTION
+                   for s, k in work.succs)
+        assert any(s is outer_exc for s, _ in inner_exc.succs)
+        assert any(s is graph.raise_exit for s, _ in outer_exc.succs)
+
+    def test_normal_path_exits_in_order(self):
+        graph = _graph(self.SRC)
+        work = _node(graph, 5)
+        inner_ok = _node(graph, 4, kind="with-exit",
+                         exceptional=False)
+        outer_ok = _node(graph, 3, kind="with-exit",
+                         exceptional=False)
+        tail = _node(graph, 6)
+        assert any(s is inner_ok for s, _ in work.succs)
+        assert any(s is outer_ok for s, _ in inner_ok.succs)
+        assert any(s is tail for s, _ in outer_ok.succs)
+
+    def test_lock_tokens_scope_to_with_blocks(self):
+        graph = _graph(self.SRC)
+
+        def transfer(node, state):
+            if node.kind == "with-acquire":
+                return state | {node.lineno}
+            if node.kind == "with-exit":
+                return state - {node.lineno}
+            return state
+
+        ins = cfg.fixpoint(graph, frozenset(), transfer)
+        assert ins[_node(graph, 5)] == {3, 4}       # both held
+        assert ins[_node(graph, 6)] == frozenset()  # both released
+        # The exception path released them too (with-exit! nodes ran
+        # before raise-exit, and a raising __enter__ never acquired):
+        # nothing leaks into the raise state.
+        assert ins.get(graph.raise_exit, frozenset()) == frozenset()
+
+
+class TestBareRaiseReRaise:
+    SRC = """
+    def k():
+        try:
+            work()
+        except ValueError:
+            cleanup()
+            raise
+        return 1
+    """
+
+    def test_protected_body_has_exception_edge(self):
+        graph = _graph(self.SRC)
+        work = _node(graph, 4)
+        assert any(s.kind == "except-dispatch" and k == cfg.EXCEPTION
+                   for s, k in work.succs)
+
+    def test_bare_raise_reaches_raise_exit(self):
+        graph = _graph(self.SRC)
+        re_raise = _node(graph, 7)
+        assert any(s is graph.raise_exit and k == cfg.EXCEPTION
+                   for s, k in re_raise.succs)
+
+    def test_unmatched_exception_propagates(self):
+        graph = _graph(self.SRC)
+        dispatch = _node(graph, 3, kind="except-dispatch")
+        assert any(s is graph.raise_exit for s, _ in dispatch.succs)
+
+    def test_baseexception_handler_swallows_dispatch_escape(self):
+        graph = _graph("""
+        def f():
+            try:
+                work()
+            except BaseException:
+                recover()
+        """)
+        dispatch = _node(graph, 3, kind="except-dispatch")
+        assert not any(s is graph.raise_exit
+                       for s, _ in dispatch.succs)
+
+
+class TestGenerators:
+    def test_is_generator_own_body_only(self):
+        tree = ast.parse(textwrap.dedent("""
+        def gen():
+            yield 1
+
+        def host():
+            def inner():
+                yield 2
+            return inner
+        """))
+        fns = dict(cfg.top_level_functions(tree))
+        assert cfg.is_generator(fns["gen"])
+        assert not cfg.is_generator(fns["host"])
+
+    def test_generator_body_not_lock_held(self):
+        # The checker-level contract: a generator defined under a
+        # lock runs at ITERATION time, after the with exited — its
+        # body must not merge the definition site's lock state, while
+        # an ordinary nested helper must.
+        found = analyze_source(
+            '"""m."""\n' + textwrap.dedent("""
+            import time
+
+
+            class C:
+                def as_generator(self):
+                    with self._lock:
+                        def rows():
+                            yield 1
+                            time.sleep(0.1)
+                        self._rows = rows()
+
+                def as_helper(self):
+                    with self._lock:
+                        def slow():
+                            time.sleep(0.1)
+                        slow()
+            """), rel="kubeflow_tpu/serving/mod.py")
+        blocking = [f for f in found
+                    if f.check == "blocking-under-lock"]
+        assert len(blocking) == 1
+        assert "as_helper.slow" in blocking[0].symbol
+
+    def test_yield_keeps_state_within_frame(self):
+        # Dataflow still flows THROUGH a yield in the same frame: a
+        # lock held across a yield is still held at the next stmt.
+        graph = _graph("""
+        def gen(self):
+            with self._lock:
+                yield 1
+                after()
+            tail()
+        """)
+
+        def transfer(node, state):
+            if node.kind == "with-enter":
+                return state | {"L"}
+            if node.kind == "with-exit":
+                return state - {"L"}
+            return state
+
+        ins = cfg.fixpoint(graph, frozenset(), transfer)
+        yield_node = _node(graph, 4)
+        assert yield_node.is_yield
+        assert ins[_node(graph, 5)] == {"L"}
+        assert ins[_node(graph, 6)] == frozenset()
+
+
+class TestBudget:
+    def test_finally_duplication_stays_linear(self):
+        # Lazy per-escape-kind finally copies are CACHED: 64 nested
+        # try/finally levels must cost O(levels), not 2^levels.
+        depth = 64
+        body = "x = 1\n"
+        for _ in range(depth):
+            body = ("try:\n"
+                    + textwrap.indent(body, "    ")
+                    + "finally:\n    y = 2\n")
+        src = "def f():\n" + textwrap.indent(body, "    ")
+        fn = ast.parse(src).body[0]
+        graph = cfg.build_cfg(fn)
+        assert graph is not None
+        assert len(graph.nodes) < 20 * depth
+
+    def test_oversized_function_skipped_not_mis_analyzed(self):
+        # Past the node budget build_cfg must give up loudly (None),
+        # never truncate the graph.
+        src = "def f():\n" + "    x = 1\n" * (cfg.MAX_NODES + 10)
+        fn = ast.parse(src).body[0]
+        assert cfg.build_cfg(fn) is None
