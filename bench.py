@@ -1069,11 +1069,11 @@ def _bench_shared_prefix(spec, rng, cfg, on_tpu, DecodeEngine):
 
     if on_tpu:
         shared_len, suffix_len, n_clients = 64, 16, 32
-        prefill, chunk, block, pool, probe_new = 256, 32, 16, 4, 8
+        prefill, chunk, block, probe_new = 256, 32, 16, 8
         workers = 4
     else:
         shared_len, suffix_len, n_clients = 64, 8, 24
-        prefill, chunk, block, pool, probe_new = 80, 8, 16, 2, 4
+        prefill, chunk, block, probe_new = 80, 8, 16, 4
         workers = 2
     shared = rng.randint(1, cfg.vocab_size,
                          size=(shared_len,)).astype(np.int32)
@@ -1083,12 +1083,12 @@ def _bench_shared_prefix(spec, rng, cfg, on_tpu, DecodeEngine):
     warm = rng.randint(1, cfg.vocab_size,
                        size=(1, shared_len + suffix_len)).astype(np.int32)
 
-    def run(pool_blocks):
+    def run(caching):
         engine = DecodeEngine(
             spec["cfg"], spec["params"], spec["decode"], slots=4,
             prefill_len=prefill, prefill_chunk_tokens=chunk,
-            prefix_pool_blocks=pool_blocks, prefix_block_tokens=block,
-            name=f"bench-prefix-{pool_blocks}")
+            kv_block_tokens=block, prefix_caching=caching,
+            name=f"bench-prefix-{int(caching)}")
         try:
             # Compile all three programs on an UNRELATED prompt so the
             # first shared-prefix client is the real cache miss.
@@ -1116,8 +1116,8 @@ def _bench_shared_prefix(spec, rng, cfg, on_tpu, DecodeEngine):
         finally:
             engine.close()
 
-    on_ttfts, on_stats = run(pool_blocks=pool)
-    off_ttfts, off_stats = run(pool_blocks=0)
+    on_ttfts, on_stats = run(caching=True)
+    off_ttfts, off_stats = run(caching=False)
     on_p50, off_p50 = _pct_ms(on_ttfts, 0.5), _pct_ms(off_ttfts, 0.5)
     speedup = off_p50 / on_p50 if on_p50 else 0.0
     print(f"shared-prefix: TTFT p50 cache ON {on_p50:.2f} ms vs OFF "
@@ -1129,7 +1129,7 @@ def _bench_shared_prefix(spec, rng, cfg, on_tpu, DecodeEngine):
         "suffix_tokens": suffix_len,
         "clients": n_clients,
         "prefill_chunk_tokens": chunk,
-        "prefix_pool_blocks": pool,
+        "kv_block_tokens": block,
         "ttft_p50_ms_cache_on": on_p50,
         "ttft_p99_ms_cache_on": _pct_ms(on_ttfts, 0.99),
         "ttft_p50_ms_cache_off": off_p50,
@@ -1149,6 +1149,170 @@ def _bench_shared_prefix(spec, rng, cfg, on_tpu, DecodeEngine):
         "inter_token_gap_max_ms_cache_off":
             off_stats["inter_token_gap_max_ms"],
         "prefill_chunks_cache_off": off_stats["prefill_chunks"],
+    }
+
+
+def _bench_paged_kv(spec, rng, cfg, on_tpu, DecodeEngine):
+    """Paged-KV capacity probe: how many mixed-length requests fit the
+    SAME device KV token budget once capacity is bounded by tokens
+    resident instead of slots x max_len.
+
+    Two engines over one fixed block budget (the pool a slot-reserved
+    cache of ``baseline_slots`` worst-case rows would occupy):
+
+      * baseline — ``slots = budget // blocks_per_max_len``: admission
+        is bounded by slot count at worst-case parity, which IS the
+        old slot-reserved capacity model (every admission costs a full
+        max_len row no matter how short the request);
+      * paged — many slots, same pool: each admission reserves only
+        ceil((prompt + budget) / block) pages, so short requests
+        co-reside where the baseline would make them queue.
+
+    One open-loop mixed-length workload (short/medium/long prompts
+    interleaved, seeded arrivals) runs on both; a sampler thread
+    records the PEAK concurrent resident requests and the window
+    records delivered tok/s.  Windows interleave with alternating
+    order and the max window is the capability estimate, as
+    everywhere else in this bench.  Acceptance: paged holds >= 1.5x
+    the baseline's peak concurrency at the same token budget, with
+    delivered throughput no worse."""
+    import threading
+
+    import numpy as np
+
+    if on_tpu:
+        # ISSUE geometry: lengths 64/256/1024-class against a
+        # max_len-1024 config (prompt capped at the prefill width).
+        lens = [64, 256, 832]
+        prefill, probe_new, block = 896, 128, 16
+        n_requests, spread_s, baseline_slots, windows = 48, 0.05, 4, 2
+    else:
+        # Same shape scaled to the hermetic CPU model (max_seq_len
+        # 128): lengths 8/32/96 against a max_len-128 config.
+        lens = [8, 32, 96]
+        prefill, probe_new, block = 96, 16, 16
+        n_requests, spread_s, baseline_slots, windows = 48, 0.002, 4, 3
+    max_len = prefill + probe_new
+    table_blocks = -(-max_len // block)
+    budget_blocks = baseline_slots * table_blocks
+    paged_slots = 4 * baseline_slots
+    reqs = [
+        (rng.randint(1, cfg.vocab_size,
+                     size=(lens[i % len(lens)],)).astype(np.int32),
+         rng.uniform(0.0, spread_s))
+        for i in range(n_requests)
+    ]
+
+    def make_engine(slots, label):
+        engine = DecodeEngine(
+            spec["cfg"], spec["params"], spec["decode"], slots=slots,
+            prefill_len=prefill, max_len=max_len,
+            kv_block_tokens=block, kv_pool_blocks=budget_blocks,
+            prefix_caching=False, name=f"bench-paged-{label}")
+        engine.submit({"tokens": reqs[0][0][:4],
+                       "max_new_tokens": 2})  # warm both programs
+        return engine
+
+    def window(engine):
+        stop = threading.Event()
+        # Peak CONCURRENT RESIDENT requests = peak active slots
+        # (sequences simultaneously holding KV — the capacity number
+        # the pool bounds).  in_flight_requests would overcount here:
+        # deterministic retirement frees a slot at dispatch while the
+        # request stays in flight until its lagged delivery.
+        peak = {"resident": 0, "kv_util": 0.0}
+
+        def sampler():
+            while not stop.is_set():
+                st = engine.stats()
+                peak["resident"] = max(peak["resident"],
+                                       st["active_slots"])
+                peak["kv_util"] = max(st["kv_utilization"],
+                                      peak["kv_util"])
+                time.sleep(0.002)
+
+        failures = []
+
+        def client(prompt, delay):
+            time.sleep(delay)
+            try:
+                engine.submit({"tokens": prompt,
+                               "max_new_tokens": probe_new})
+            except Exception as exc:  # noqa: BLE001 — recorded
+                failures.append(exc)
+
+        sam = threading.Thread(target=sampler, daemon=True)
+        sam.start()
+        threads = [threading.Thread(target=client, args=r)
+                   for r in reqs]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        stop.set()
+        sam.join(timeout=5)
+        ok = n_requests - len(failures)
+        return {
+            "peak_in_flight": peak["resident"],
+            "peak_kv_utilization": round(peak["kv_util"], 4),
+            "tokens_per_sec": round(ok * probe_new / wall, 1),
+            "failed_requests": len(failures),
+        }
+
+    base_engine = make_engine(baseline_slots, "slotres")
+    paged_engine = make_engine(paged_slots, "paged")
+    base_ws, paged_ws = [], []
+    try:
+        for w in range(windows):
+            if w % 2 == 0:
+                base_ws.append(window(base_engine))
+                paged_ws.append(window(paged_engine))
+            else:
+                paged_ws.append(window(paged_engine))
+                base_ws.append(window(base_engine))
+    finally:
+        base_engine.close()
+        paged_engine.close()
+
+    def best(ws):
+        out = max(ws, key=lambda w: w["tokens_per_sec"])
+        return {**out,
+                "peak_in_flight": max(w["peak_in_flight"] for w in ws),
+                "failed_requests": sum(w["failed_requests"]
+                                       for w in ws)}
+
+    base, paged = best(base_ws), best(paged_ws)
+    conc = (paged["peak_in_flight"] / base["peak_in_flight"]
+            if base["peak_in_flight"] else 0.0)
+    print(f"paged-kv: peak resident {paged['peak_in_flight']} vs "
+          f"slot-reserved {base['peak_in_flight']} ({conc:.2f}x) at "
+          f"{budget_blocks} blocks; delivered "
+          f"{paged['tokens_per_sec']} vs {base['tokens_per_sec']} "
+          "tok/s", file=sys.stderr)
+    return {
+        "kv_pool_blocks": budget_blocks,
+        "kv_block_tokens": block,
+        "token_budget": budget_blocks * block,
+        "max_len": max_len,
+        "prompt_lens": lens,
+        "probe_new_tokens": probe_new,
+        "requests": n_requests,
+        "baseline_slots": baseline_slots,
+        "paged_slots": paged_slots,
+        "slot_reserved": base,
+        "paged": paged,
+        "concurrency_ratio": round(conc, 3),
+        "tokens_per_sec_ratio": round(
+            paged["tokens_per_sec"] / base["tokens_per_sec"], 3)
+        if base["tokens_per_sec"] else 0.0,
+        # On the CPU smoke box a decode step's cost is ~linear in
+        # batch width (compute-bound), so the extra co-residency buys
+        # concurrency but not throughput; decode on TPU is HBM-bound
+        # (BENCH_r02 roofline) and the same co-residency multiplies
+        # delivered tok/s there.
+        **({} if on_tpu else {"cpu_compute_bound_note": True}),
     }
 
 
@@ -1324,12 +1488,12 @@ def _bench_speculative(spec, rng, cfg, on_tpu, DecodeEngine):
         engine = DecodeEngine(
             spec["cfg"], spec["params"], decode, slots=slots,
             prefill_len=prefill, prefill_chunk_tokens=prefill,
-            prefix_pool_blocks=0, sync_lag=0,
+            prefix_caching=False, sync_lag=0,
             speculative_tokens=spec_tokens,
             name=f"bench-spec-{label}")
         # Warm every program OUTSIDE the timed windows: one repetitive
-        # prompt drafts (chunked prefill + copy + verify), one random
-        # prompt decodes (step).
+        # prompt drafts (chunked prefill + verify), one random prompt
+        # decodes (step).
         engine.submit({"tokens": np.tile(
             rng.randint(1, cfg.vocab_size, size=(pat_w,)),
             reps).astype(np.int32), "max_new_tokens": 12})
@@ -1616,6 +1780,14 @@ def bench_lm_engine(args, devices, n_chips, on_tpu):
         speculative = _bench_speculative(
             spec, rng, cfg, on_tpu, DecodeEngine)
 
+        # --- paged-KV capacity probe: mixed-length open loop at one
+        # fixed block budget, tokens-resident admission vs the
+        # slot-reserved capacity model.  Acceptance: >= 1.5x peak
+        # concurrent in-flight at the same KV token budget, delivered
+        # tok/s no worse.
+        paged_kv = _bench_paged_kv(
+            spec, rng, cfg, on_tpu, DecodeEngine)
+
         # --- tracing overhead probe: the distributed-tracing spine
         # (runtime/tracing.py) disabled vs enabled-and-traced on the
         # same workload.  Disabled must be free (the headline windows
@@ -1674,6 +1846,7 @@ def bench_lm_engine(args, devices, n_chips, on_tpu):
             "cached_token_ratio": engine_stats["cached_token_ratio"],
             "shared_prefix": shared_prefix,
             "speculative": speculative,
+            "paged_kv": paged_kv,
             "tracing_overhead": tracing_overhead,
             "mean_slot_occupancy": engine_stats["mean_occupancy"],
             "slots": slots,

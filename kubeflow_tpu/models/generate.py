@@ -68,11 +68,14 @@ class DecodeConfig:
 
 
 def _layer_step(cfg: TransformerConfig, layer_params, x, cache_kv,
-                cache_len, positions, pad_amount=None, write_cols=None):
+                cache_len, positions, pad_amount=None, write_cols=None,
+                tables=None):
     """One decoder block against the KV cache.
 
     x: [b, t, e] new activations (t = prompt len at prefill, 1 at decode);
-    cache_kv: (k, v) each [b, max_len, hkv, d];
+    cache_kv: (k, v) each [b, max_len, hkv, d] — or, when ``tables`` is
+    given, a paged block POOL [num_blocks, block_tokens, hkv, d] shared
+    by every slot;
     cache_len: number of valid cache positions before this call — a
     scalar (whole batch at one length, the generate() path) or a [b]
     array (per-row lengths, the slot-based decode_step / verify_step
@@ -86,6 +89,15 @@ def _layer_step(cfg: TransformerConfig, layer_params, x, cache_kv,
     is per-row (defaults to cache_len); rows that must not write this
     step (retired slots) pass an out-of-range column — the scatter
     drops it.
+    tables: [b, max_blocks] int32 per-row block tables mapping each
+    row's LOGICAL block index (position // block_tokens) to a physical
+    pool block.  Fresh k/v scatter straight into the pool at their
+    (block, offset) coordinates — a logical index past the table span,
+    or a table entry holding the sentinel ``num_blocks`` (unallocated),
+    drops the write — and attention runs over the row's gathered
+    [max_blocks * block_tokens] view of the pool (sentinel entries
+    clamp onto an arbitrary block whose columns all sit beyond the
+    causal frontier, so the garbage they contribute is masked).
     Mirrors models/transformer.py Block but with explicit cache state.
     """
     from kubeflow_tpu.models.transformer import MLP, RMSNorm
@@ -111,7 +123,57 @@ def _layer_step(cfg: TransformerConfig, layer_params, x, cache_kv,
     ck, cv = cache_kv
     t = x.shape[1]
     per_row = not isinstance(cache_len, int) and cache_len.ndim == 1
-    if per_row:
+    if tables is not None:
+        vals = ck.values if isinstance(ck, QTensor) else ck
+        nb, bt = vals.shape[0], vals.shape[1]
+        mb = tables.shape[1]
+        if per_row:
+            base = cache_len if write_cols is None else write_cols
+            pos = base[:, None] + jnp.arange(t)[None, :]
+        else:
+            pos = cache_len + jnp.arange(t)[None, :]
+            pos = jnp.broadcast_to(pos, (x.shape[0], t))
+        blk_slot = pos // bt
+        # Physical block per position: sentinel table entries (== nb)
+        # and logical indices past the table both park the write out
+        # of the pool's range — the scatter drops them.
+        blk = jnp.take_along_axis(
+            tables, jnp.clip(blk_slot, 0, mb - 1), axis=1)
+        blk = jnp.where(blk_slot < mb, blk, nb)
+        off = pos % bt
+
+        def store(c, new):  # new: [b, t, hk, d]
+            if isinstance(c, QTensor):
+                qvals, s = quantize_array(new, (-1,))
+                return QTensor(
+                    c.values.at[blk, off].set(qvals, mode="drop"),
+                    c.scale.at[blk, off].set(s, mode="drop"),
+                    c.axes,
+                )
+            return c.at[blk, off].set(new.astype(c.dtype), mode="drop")
+
+        ck = store(ck, k)
+        cv = store(cv, v)
+
+        def paged_view(c):
+            # Row view of the (just-updated) pool: OOB sentinel
+            # entries clamp, contributing finite garbage that the
+            # kv_offset mask discards.
+            def gather(p):
+                g = p[tables]
+                return g.reshape(
+                    (tables.shape[0], mb * bt) + p.shape[2:])
+
+            if isinstance(c, QTensor):
+                return QTensor(gather(c.values), gather(c.scale),
+                               c.axes)
+            return gather(c)
+
+        out = dot_product_attention(
+            q, paged_view(ck), paged_view(cv), causal=True,
+            kv_offset=cache_len, kv_valid_start=pad_amount,
+        )
+    elif per_row:
         # Slot-based decode/verify: t new tokens per row, scattered to
         # each row's own columns [base, base + t).  mode="drop" makes
         # an out-of-range column a no-op — that is how retired slots
@@ -170,7 +232,8 @@ def _layer_step(cfg: TransformerConfig, layer_params, x, cache_kv,
     # freshly quantized cache, and serving goldens pin that rounding).
     # cache_len is a static python 0 at prefill and a TRACED scalar in
     # the decode scan — the gate must only ever inspect the static case.
-    static_prefill = isinstance(cache_len, int) and cache_len == 0
+    static_prefill = (tables is None and isinstance(cache_len, int)
+                      and cache_len == 0)
     if (cfg.attention == "flash" and t > 1 and static_prefill
             and not isinstance(ck, QTensor)):
         from kubeflow_tpu.ops.flash import flash_attention
@@ -180,7 +243,7 @@ def _layer_step(cfg: TransformerConfig, layer_params, x, cache_kv,
             block_q=cfg.flash_block_q, block_k=cfg.flash_block_k,
             kv_valid_start=pad_amount,
         )
-    else:
+    elif tables is None:
         out = dot_product_attention(
             q, ck, cv, causal=True, kv_offset=cache_len,
             kv_valid_start=pad_amount,
@@ -197,7 +260,8 @@ def _layer_step(cfg: TransformerConfig, layer_params, x, cache_kv,
 
 
 def _forward_with_cache(cfg: TransformerConfig, params, tokens, cache,
-                        cache_len, pad_amount=None, write_cols=None):
+                        cache_len, pad_amount=None, write_cols=None,
+                        tables=None):
     """tokens [b, t] -> (logits [b, t, v], new cache).
 
     cache_len scalar: the whole batch sits at one length (generate()).
@@ -206,6 +270,9 @@ def _forward_with_cache(cfg: TransformerConfig, params, tokens, cache,
     [len, len + t), writes its own cache columns (write_cols,
     defaulting to cache_len), and attends under its own causal
     frontier (t = 1 at decode, k+1 at speculative verify).
+    tables: per-row block tables for the paged block-pool cache (the
+    serving engine's unified KV store — see _layer_step); None keeps
+    the contiguous per-row layout generate() uses.
     """
     from flax import linen as nn
 
@@ -240,6 +307,7 @@ def _forward_with_cache(cfg: TransformerConfig, params, tokens, cache,
         x, (ck, cv) = _layer_step(
             cfg, layer_params, x, (ck, cv), cache_len, positions,
             pad_amount=pad_amount, write_cols=write_cols,
+            tables=tables,
         )
         return x, (ck, cv)
 
@@ -394,28 +462,36 @@ def generate(
 
 # ---------------------------------------------------------------------------
 # Continuous-batching slot engine: jitted programs over a PERSISTENT
-# slot-based KV cache (serving/engine.py drives them).
+# PAGED KV block pool (serving/engine.py drives them).
 #
 # generate() is one program per (batch, bucket) that owns its rows from
 # prefill to the last token — a row admitted mid-generation waits for the
 # whole program, and every row pays the batch bucket's padded KV span.
 # These entry points split that lifecycle so a serving loop can interleave
-# admission with decode:
+# admission with decode.  The unified KV store is a device-side BLOCK
+# POOL — [layers, num_blocks, block_tokens, hkv, d], fp or int8 QTensor
+# alike — and every program takes the current per-slot block tables as
+# a plain argument: which pool block backs which logical block of which
+# slot is HOST bookkeeping (serving/prefix_cache.py BlockManager), so
+# capacity is bounded by TOKENS RESIDENT rather than slots x max_len,
+# and sharing a cached prefix between slots is a refcounted table edit
+# (zero device copies; no copy program exists).
 #
 #   prefill_chunk_into_slot  EXTEND a slot's KV by a static chunk width
 #                            starting at a traced offset — the serving
 #                            loop splits long prompts into chunks and
 #                            schedules them BETWEEN decode steps, so an
 #                            arriving prompt can never stall in-flight
-#                            decode for longer than one chunk
-#   copy_prefix_into_slot    copy the first k cached columns from a
-#                            donor prefix-pool entry into a slot on
-#                            device (shared-prefix KV reuse) and freeze
-#                            the slot until chunked prefill finishes
+#                            decode for longer than one chunk.  Also
+#                            FREEZES the slot (done=True) until the
+#                            final chunk arms it — the engine dispatches
+#                            the first chunk at claim time, which is
+#                            what makes reusing a deadline-expired
+#                            slot safe
 #   decode_step              ALL live slots advance one token, each at
 #                            its OWN length (per-row rope position,
-#                            per-row causal frontier, per-row cache
-#                            column scatter)
+#                            per-row causal frontier, per-row block-
+#                            scatter through its table)
 #   verify_step              speculative decoding: score k host-drafted
 #                            candidate tokens per slot in ONE forward
 #                            pass at each slot's frontier, accept the
@@ -425,31 +501,37 @@ def generate(
 #                            over them — the cache_len-gated attention
 #                            masks stale columns past the frontier, so
 #                            rollback is a length reset, not a scatter-
-#                            erase
+#                            erase (the engine additionally returns the
+#                            rejected tail's blocks to the pool)
 #
 # Static shapes throughout: slot count, chunk width, pool geometry,
-# draft width, and max_len are fixed at engine construction, so the
-# whole serving lifetime compiles at most four programs (chunked
-# prefill, prefix copy, step, verify — the fourth only when
+# draft width, and the per-slot table span are fixed at engine
+# construction, so the whole serving lifetime compiles at most THREE
+# programs (chunked prefill, step, verify — the third only when
 # speculation is enabled).  Retirement is a device-side `done` flag (a
 # slot that hits its stop length or EOS stops advancing and drops its
-# cache writes), so freeing + reusing a slot needs no extra program —
-# the next admission's copy_prefix_into_slot freezes and overwrites it.
+# block writes), so freeing + reusing a slot needs no extra program —
+# the next admission's first chunk freezes and overwrites it.
 # ---------------------------------------------------------------------------
 
 
-def init_slot_state(cfg: TransformerConfig, slots: int, max_len: int,
-                    kv_cache_dtype: str = "model"):
-    """Fresh engine state: every slot retired, caches zeroed.
+def init_paged_state(cfg: TransformerConfig, slots: int,
+                     num_blocks: int, block_tokens: int,
+                     kv_cache_dtype: str = "model"):
+    """Fresh paged engine state: every slot retired, block pool zeroed.
 
-    The state dict is the carry both jitted entry points thread (and
-    donate): the [layers, slots, max_len, hkv, d] KV cache plus per-slot
-    scalars — lengths (valid cache columns), stop_len (length at which
-    the slot stops sampling), last_token (sampled but not yet in cache),
-    done, and a per-slot PRNG key (uint32[2]) so temperature sampling is
-    per-REQUEST deterministic regardless of co-batched slots.
+    The state dict is the carry the jitted entry points thread (and
+    donate): the [layers, num_blocks, block_tokens, hkv, d] KV block
+    pool plus per-slot scalars — lengths (valid cache positions),
+    stop_len (length at which the slot stops sampling), last_token
+    (sampled but not yet in cache), done, and a per-slot PRNG key
+    (uint32[2]) so temperature sampling is per-REQUEST deterministic
+    regardless of co-batched slots.  Block tables are NOT device state:
+    the host owns them and passes the current snapshot into every
+    program call.
     """
-    cache_k, cache_v = init_cache(cfg, slots, max_len, kv_cache_dtype)
+    cache_k, cache_v = init_cache(cfg, num_blocks, block_tokens,
+                                  kv_cache_dtype)
     return {
         "cache_k": cache_k,
         "cache_v": cache_v,
@@ -461,17 +543,25 @@ def init_slot_state(cfg: TransformerConfig, slots: int, max_len: int,
     }
 
 
+def _pool_block_tokens(cache) -> int:
+    """Static block width of a paged pool array ([L, NB, bt, ...])."""
+    vals = cache.values if isinstance(cache, QTensor) else cache
+    return vals.shape[2]
+
+
 @partial(jax.jit, static_argnums=(0, 3, 4), donate_argnums=(2,))
 def decode_step(cfg: TransformerConfig, params, state,
-                decode: DecodeConfig, steps: int = 1):
+                decode: DecodeConfig, steps: int, tables: jax.Array):
     """Advance every live slot; returns (state, sampled [steps, S]).
 
     One batched forward at t=1 per step: each slot ropes at its own
-    length, attends under its own causal frontier (vector kv_offset),
-    and scatters its new k/v to its own cache column.  Retired slots
-    ride along with dropped writes and zero emissions — the static
-    shape never changes, so this is the engine's single step program
-    for its whole lifetime.
+    length, attends under its own causal frontier (vector kv_offset)
+    over its block-table-gathered view of the pool, and scatters its
+    new k/v to its own (block, offset) through ``tables``
+    ([S, max_blocks] int32, host-owned).  Retired slots ride along
+    with dropped writes and zero emissions — the static shape never
+    changes, so this is the engine's single step program for its
+    whole lifetime.
 
     ``steps`` (static) fuses that many steps into one program via scan:
     per-call dispatch and runtime overhead amortize over k tokens at
@@ -479,17 +569,18 @@ def decode_step(cfg: TransformerConfig, params, state,
     freeze via `done` on device, so at most k-1 slot-steps idle).  One
     engine uses ONE value, so the three-program guarantee holds.
     """
+    park = tables.shape[1] * _pool_block_tokens(state["cache_k"])
+
     def one(state, _):
         lengths, done = state["lengths"], state["done"]
-        max_len = state["cache_k"].shape[2]
         advance = ~done
-        # Retired slots park their write out of range; the scatter
-        # drops it.
-        write_cols = jnp.where(advance, lengths, max_len)
+        # Retired slots park their write past the table span; the
+        # block scatter drops it.
+        write_cols = jnp.where(advance, lengths, park)
         logits, (ck, cv) = _forward_with_cache(
             cfg, params, state["last_token"][:, None],
             (state["cache_k"], state["cache_v"]), lengths,
-            write_cols=write_cols)
+            write_cols=write_cols, tables=tables)
         last = logits[:, -1]
         if decode.temperature <= 0.0:
             nxt = jnp.argmax(last, axis=-1)
@@ -525,7 +616,7 @@ def decode_step(cfg: TransformerConfig, params, state,
 @partial(jax.jit, static_argnums=(0, 3, 4), donate_argnums=(2,))
 def verify_step(cfg: TransformerConfig, params, state,
                 decode: DecodeConfig, k: int, draft: jax.Array,
-                draft_len: jax.Array):
+                draft_len: jax.Array, tables: jax.Array):
     """Speculative verify: score up to ``k`` host-drafted tokens per
     slot in ONE forward pass; returns (state, tokens [S, k+1],
     emitted [S]).
@@ -550,24 +641,26 @@ def verify_step(cfg: TransformerConfig, params, state,
     accept) — clipped to the slot's remaining budget and cut at EOS.
 
     Rollback is DEVICE-SIDE and free: the k+1 fresh k/v columns were
-    written at [len, len + k] as the forward ran, but ``lengths``
-    advances only over the emitted prefix.  Columns past the new
-    frontier hold rejected-draft garbage that the cache_len-gated
-    attention masks out of every later call, and the next step's
-    write window starts at the new frontier and overwrites them
-    before its own attention runs — a length reset, never a
-    scatter-erase.  Retired slots park their writes out of range and
-    emit 0 tokens, exactly like decode_step.
+    written at [len, len + k] as the forward ran (through each slot's
+    block table), but ``lengths`` advances only over the emitted
+    prefix.  Columns past the new frontier hold rejected-draft garbage
+    that the cache_len-gated attention masks out of every later call,
+    and the next step's write window starts at the new frontier and
+    overwrites them before its own attention runs — a length reset,
+    never a scatter-erase (the engine additionally trims whole
+    rejected-tail BLOCKS back to the pool host-side).  Retired slots
+    park their writes out of range and emit 0 tokens, exactly like
+    decode_step.
     """
     lengths, done = state["lengths"], state["done"]
-    max_len = state["cache_k"].shape[2]
+    park = tables.shape[1] * _pool_block_tokens(state["cache_k"])
     advance = ~done
-    write_cols = jnp.where(advance, lengths, max_len)
+    write_cols = jnp.where(advance, lengths, park)
     tokens = jnp.concatenate(
         [state["last_token"][:, None], draft.astype(jnp.int32)], axis=1)
     logits, (ck, cv) = _forward_with_cache(
         cfg, params, tokens, (state["cache_k"], state["cache_v"]),
-        lengths, write_cols=write_cols)
+        lengths, write_cols=write_cols, tables=tables)
     targets = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [S, k+1]
     # Longest accepted draft prefix (positions beyond draft_len never
     # match), then +1 free token, clipped to the per-slot budget: a
@@ -605,145 +698,61 @@ def verify_step(cfg: TransformerConfig, params, state,
     return state, out, emit.astype(jnp.int32)
 
 
-def init_prefix_pool(cfg: TransformerConfig, blocks: int, pool_len: int,
-                     kv_cache_dtype: str = "model"):
-    """Donor KV pool for shared-prefix reuse: ``blocks`` rows of
-    ``pool_len`` cache columns each, same layout and dtype as the slot
-    cache.  A row is filled as a side effect of chunked prefill (the
-    chunk program dual-writes its fresh k/v) and copied into new slots
-    by ``copy_prefix_into_slot``; which row holds which token-prefix is
-    host-side bookkeeping (serving/prefix_cache.py)."""
-    return init_cache(cfg, blocks, pool_len, kv_cache_dtype)
-
-
-def _slot_row(c, slot):
-    """Slice row ``slot`` (traced) of a [L, rows, cols, ...] cache as a
-    [L, 1, cols, ...] batch (QTensor-aware)."""
-    def take(b):
-        return jax.lax.dynamic_slice_in_dim(b, slot, 1, axis=1)
-
-    if isinstance(c, QTensor):
-        return QTensor(take(c.values), take(c.scale), c.axes)
-    return take(c)
-
-
-def _put_slot_row(big, small, slot):
-    """Write a [L, 1, cols, ...] batch back into row ``slot`` (traced)
-    of the big cache (QTensor-aware).  ``slot`` is always in range on
-    this path — the engine never chunk-prefills an out-of-range slot."""
-    def put(b, s):
-        return jax.lax.dynamic_update_slice_in_dim(
-            b, s.astype(b.dtype), slot, axis=1)
-
-    if isinstance(big, QTensor):
-        return QTensor(put(big.values, small.values),
-                       put(big.scale, small.scale), big.axes)
-    return put(big, small)
-
-
-def _masked_prefix_copy(big, pool_c, entry, slot, k):
-    """big[:, slot, col] = pool_c[:, entry, col] for col < k (traced k;
-    k = 0 copies nothing).  ``entry`` may be any value when k = 0 — the
-    gather clamps and the mask discards whatever it read."""
-    def one(b, p):
-        row = jax.lax.dynamic_slice_in_dim(p, entry, 1, axis=1)
-        pool_len = row.shape[2]
-        cur = jax.lax.dynamic_slice(
-            b, (0, slot) + (0,) * (b.ndim - 2),
-            (b.shape[0], 1, pool_len) + b.shape[3:])
-        mask = (jnp.arange(pool_len) < k).reshape(
-            (1, 1, pool_len) + (1,) * (b.ndim - 3))
-        new = jnp.where(mask, row.astype(b.dtype), cur)
-        return jax.lax.dynamic_update_slice(
-            b, new, (0, slot) + (0,) * (b.ndim - 2))
-
-    if isinstance(big, QTensor):
-        return QTensor(one(big.values, pool_c.values),
-                       one(big.scale, pool_c.scale), big.axes)
-    return one(big, pool_c)
-
-
-@partial(jax.jit, donate_argnums=(0,))
-def copy_prefix_into_slot(state, pool, entry, slot, k):
-    """Resume-from-cached-prefix admission, step 1 of 2: copy the first
-    ``k`` cache columns of donor pool row ``entry`` into slot ``slot``
-    and FREEZE the slot (``done`` = True) until chunked prefill
-    completes it.
-
-    The freeze is load-bearing even at k = 0 (no cached prefix): a slot
-    freed by mid-generation deadline expiry still has ``done`` = False
-    on device, so without this write the interleaved decode_step would
-    keep advancing the dead occupant and scatter garbage into columns
-    the chunked prefill is about to own.  The engine therefore
-    dispatches this program for EVERY admission, cached prefix or not —
-    claim, freeze, and copy are one device call.
-
-    Columns in [k, pool_len) of the slot keep whatever they held; they
-    sit beyond the resumed causal frontier, so every later attention
-    masks them until chunk writes overtake them column by column —
-    the same argument that makes right-padded one-shot prefill sound.
-    """
-    state = dict(state)
-    pool_k, pool_v = pool
-    state["cache_k"] = _masked_prefix_copy(
-        state["cache_k"], pool_k, entry, slot, k)
-    state["cache_v"] = _masked_prefix_copy(
-        state["cache_v"], pool_v, entry, slot, k)
-    state["done"] = state["done"].at[slot].set(True)
-    return state
-
-
-@partial(jax.jit, static_argnums=(0, 3), donate_argnums=(2, 4))
+@partial(jax.jit, static_argnums=(0, 3), donate_argnums=(2,))
 def prefill_chunk_into_slot(
     cfg: TransformerConfig,
     params,
     state,
     decode: DecodeConfig,
-    pool,
     tokens: jax.Array,
     start: jax.Array,
     prompt_len: jax.Array,
     new_tokens: jax.Array,
     slot: jax.Array,
-    pool_row: jax.Array,
     seed: jax.Array,
+    table_row: jax.Array,
 ):
     """Extend slot ``slot``'s KV by one static-width chunk of prompt
     starting at traced cache offset ``start``; returns
-    (state, pool, first sampled token [1]).
+    (state, first sampled token [1]).
 
     tokens [1, chunk_w]: the prompt's tokens [start, start + chunk_w),
-    right-padded past ``prompt_len`` on the final chunk.  The chunk's
-    queries attend over the slot's whole cache row under the causal
-    frontier ``start`` (the same ``cache_len``-gated attention path the
-    decode scan uses with a traced offset), so earlier chunks' — or a
-    copied donor prefix's — k/v participate exactly as if the prompt
-    had prefilled in one call, and garbage columns at/after start +
-    chunk_w stay masked.  Chunk width is static and fixed per engine,
-    so every admission, resumed at any offset, reuses ONE compiled
-    program; the serving loop schedules these calls between decode
-    steps under a token budget, which is what bounds how long an
-    arriving prompt can stall in-flight decode.
+    right-padded past ``prompt_len`` on the final chunk.  table_row
+    [1, max_blocks]: the slot's block table — fresh k/v scatter into
+    the pool through it, and the chunk's queries attend over the
+    slot's gathered pool view under the causal frontier ``start`` (the
+    same ``cache_len``-gated attention path the decode scan uses with
+    a traced offset), so earlier chunks' — or an aliased shared
+    prefix's — k/v participate exactly as if the prompt had prefilled
+    in one call, and garbage columns at/after start + chunk_w stay
+    masked.  A resumed cached prefix needs NO device copy: the engine
+    simply places the cached blocks in the table and starts the first
+    chunk at the cached offset.  Chunk width is static and fixed per
+    engine, so every admission, resumed at any offset, reuses ONE
+    compiled program; the serving loop schedules these calls between
+    decode steps under a token budget, which is what bounds how long
+    an arriving prompt can stall in-flight decode.
 
     On the final chunk (start + chunk_w >= prompt_len, decided on
     device) the program samples the request's first token from the
     last real prompt position and arms the slot's scalars (lengths /
     stop_len / last_token / done / keys — what decode_step needs to
-    advance the slot); intermediate chunks leave the slot frozen
-    (``done`` = True, set by copy_prefix_into_slot at claim and
-    re-asserted here) and park the scalar writes out of range.
+    advance the slot); intermediate chunks leave the slot frozen and
+    park the scalar writes out of range.
 
-    ``pool_row``: donor-capture target — the chunk's fresh k/v are
-    also scattered into that prefix-pool row at the same columns, so
-    building a donor entry costs no extra pass; an out-of-range row
-    (or columns beyond the pool width) drops the write.
+    The unconditional ``done`` = True FREEZE is load-bearing: a slot
+    freed by mid-generation deadline expiry still has ``done`` = False
+    on device, so without it an interleaved decode_step would keep
+    advancing the dead occupant and scatter garbage through the NEW
+    request's block table.  The engine therefore dispatches the first
+    chunk of every admission at claim time, before any step program
+    can run.
     """
     slots_n = state["done"].shape[0]
     w = tokens.shape[1]
-    ck = _slot_row(state["cache_k"], slot)
-    cv = _slot_row(state["cache_v"], slot)
     logits, (ck, cv) = _forward_with_cache(
-        cfg, params, tokens, (ck, cv), start)
+        cfg, params, tokens, (state["cache_k"], state["cache_v"]),
+        start, tables=table_row)
     # First-token sampling from the last REAL prompt position of this
     # chunk (only meaningful on the final chunk; clamped otherwise).
     idx = jnp.clip(prompt_len - 1 - start, 0, w - 1)
@@ -767,30 +776,8 @@ def prefill_chunk_into_slot(
     if decode.eos_token >= 0:
         done_final = done_final | (tok[0] == decode.eos_token)
 
-    # Donor capture: scatter this chunk's fresh k/v into the pool row
-    # at the same columns.  mode="drop" makes both "no capture" (row
-    # out of range) and "prefix longer than the pool width" (columns
-    # out of range) silent no-ops.
-    cols = start + jnp.arange(w)
-    pool_k, pool_v = pool
-
-    def capture(pool_c, row_c):
-        def cap(p, s):
-            blk = jnp.take(s[:, 0], cols, axis=1)  # [L, w, ...]
-            return p.at[:, pool_row, cols].set(
-                blk.astype(p.dtype), mode="drop")
-
-        if isinstance(pool_c, QTensor):
-            return QTensor(cap(pool_c.values, row_c.values),
-                           cap(pool_c.scale, row_c.scale), pool_c.axes)
-        return cap(pool_c, row_c)
-
-    pool_k = capture(pool_k, ck)
-    pool_v = capture(pool_v, cv)
-
     state = dict(state)
-    state["cache_k"] = _put_slot_row(state["cache_k"], ck, slot)
-    state["cache_v"] = _put_slot_row(state["cache_v"], cv, slot)
+    state["cache_k"], state["cache_v"] = ck, cv
     state["done"] = state["done"].at[slot].set(True)
     state["done"] = state["done"].at[final_slot].set(
         done_final, mode="drop")
@@ -802,4 +789,4 @@ def prefill_chunk_into_slot(
         tok[0], mode="drop")
     state["keys"] = state["keys"].at[final_slot].set(
         keys[0], mode="drop")
-    return state, (pool_k, pool_v), tok
+    return state, tok

@@ -9,8 +9,17 @@ decode step (models/generate.py's docstring measures ~6x wasted decode
 compute on wide length distributions).
 
 This engine runs the slot entry points instead (models/generate.py)
-over ONE persistent KV cache of ``slots`` rows:
+over ONE persistent PAGED KV block pool shared by ``slots`` sequences:
 
+  - the unified KV store is a device-side block pool
+    ([layers, kv_pool_blocks, kv_block_tokens, hkv, d], fp and int8
+    QTensor alike) with host-owned per-slot block tables passed into
+    every program call — a slot holds pages for the tokens it has
+    actually produced, so serving capacity is bounded by **tokens
+    resident** (free blocks), not slots x max_len, and admission
+    sheds typed ``Overloaded`` when the pool is exhausted instead of
+    deadlocking (each admission reserves its worst-case page count up
+    front; see serving/prefix_cache.py BlockManager);
   - a dedicated step loop advances all live slots one token per
     ``decode_step`` call;
   - new requests are admitted into free slots BETWEEN steps, and their
@@ -20,17 +29,19 @@ over ONE persistent KV cache of ``slots`` rows:
     than one chunk's compute, where a one-shot full-width prefill
     stalls every active slot for the whole prompt;
   - admission first resumes from the **longest cached shared prefix**:
-    a host-side block-hashed index (serving/prefix_cache.py) over a
-    small pinned pool of donor KV rows finds the longest token-block
-    prefix a previous prompt already computed, ``copy_prefix_into_slot``
-    copies those columns on device, and chunked prefill continues from
-    there — TTFT scales with the *uncached suffix* length, not the full
-    prompt (the win for fleets of chat requests sharing a system
-    prompt);
-  - finished rows retire immediately (device-side ``done`` flag) and
-    their slots are reused — no request ever waits for the batch to
-    drain, and per-request ``max_new_tokens`` is data, not a compiled
-    constant;
+    the block-hashed index finds the longest token-block prefix a
+    previous prompt already computed and the new slot's table ALIASES
+    those physical blocks (a refcount bump — zero device copies;
+    divergence lands in a fresh private block because sharing is
+    block-aligned, i.e. copy-on-write whose copy is statically dead),
+    and chunked prefill continues after them — TTFT scales with the
+    *uncached suffix* length, not the full prompt (the win for fleets
+    of chat requests sharing a system prompt);
+  - finished rows retire immediately (device-side ``done`` flag),
+    their slots are reused and their private pages return to the pool
+    (published prefix pages stay resident until LRU eviction) — no
+    request ever waits for the batch to drain, and per-request
+    ``max_new_tokens`` is data, not a compiled constant;
   - with ``speculative_tokens`` > 0 (greedy exports only), a host-side
     **n-gram drafter** proposes up to k candidate tokens per slot by
     longest-suffix match against the slot's own prompt + generated
@@ -38,13 +49,14 @@ over ONE persistent KV cache of ``slots`` rows:
     the k+1 positions at each slot's frontier, the longest exact
     greedy prefix is accepted (+1 free token from the verify logits),
     and rejected columns roll back device-side by NOT advancing the
-    slot's ``cache_len`` over them — per-slot adaptive k backs off
-    when acceptance drops, and a round in which no slot drafts runs
-    the plain decode program, so low-acceptance traffic never pays
-    the verify window;
+    slot's ``cache_len`` over them (rejected-tail BLOCKS return to
+    the pool) — per-slot adaptive k backs off when acceptance drops,
+    and a round in which no slot drafts runs the plain decode
+    program, so low-acceptance traffic never pays the verify window;
   - every shape is static, so the engine's whole lifetime compiles at
-    most four programs (chunked prefill, prefix copy, step, verify —
-    the fourth only when speculation is enabled).
+    most THREE programs (chunked prefill, step, verify — the third
+    only when speculation is enabled; prefix reuse needs no copy
+    program at all).
 
 The host loop reads sampled tokens with a small LAG (``sync_lag``
 steps): step N+lag is dispatched before step N's tokens are
@@ -80,7 +92,7 @@ from kubeflow_tpu.serving.model_server import (
     SHED_TOTAL,
     locked_snapshot,
 )
-from kubeflow_tpu.serving.prefix_cache import PrefixIndex
+from kubeflow_tpu.serving.prefix_cache import BlockManager
 from kubeflow_tpu.testing import faults
 
 # Step-duration histogram buckets: decode steps run ~0.1 ms (tiny CPU
@@ -93,7 +105,19 @@ PREFIX_HITS_HELP = "admissions resumed from a cached prefix, by engine"
 PREFIX_MISSES_TOTAL = "kft_engine_prefix_misses_total"
 PREFIX_MISSES_HELP = "admissions with no cached prefix, by engine"
 PREFIX_EVICTIONS_TOTAL = "kft_engine_prefix_evictions_total"
-PREFIX_EVICTIONS_HELP = "donor prefix-pool rows evicted (LRU), by engine"
+PREFIX_EVICTIONS_HELP = "cached prefix records evicted (LRU), by engine"
+KV_BLOCKS_GAUGE = "kft_engine_kv_blocks"
+KV_BLOCKS_HELP = "paged KV pool capacity in blocks, by engine"
+KV_BLOCKS_USED_GAUGE = "kft_engine_kv_blocks_used"
+KV_BLOCKS_USED_HELP = \
+    "paged KV blocks resident (slot- or cache-held), by engine"
+KV_EVICTIONS_TOTAL = "kft_engine_kv_block_evictions_total"
+KV_EVICTIONS_HELP = \
+    "paged KV blocks freed by prefix-cache LRU eviction, by engine"
+KV_SHED_TOTAL = "kft_engine_kv_shed_no_blocks_total"
+KV_SHED_HELP = \
+    "submissions shed because the KV block pool could not cover " \
+    "them, by engine"
 PREFILL_CHUNKS_TOTAL = "kft_engine_prefill_chunks_total"
 PREFILL_CHUNKS_HELP = "prefill chunk program calls, by engine"
 SPEC_DRAFTED_TOTAL = "kft_engine_spec_drafted_total"
@@ -234,13 +258,20 @@ class DecodeEngine:
         two decode steps the loop spends at most this many prompt
         tokens on chunked prefill, which bounds the inter-token latency
         of in-flight slots regardless of arriving prompt length.
-      prefix_pool_blocks: donor rows in the shared-prefix KV pool
-        (0 disables prefix caching; chunked prefill still applies).
-        Each row holds up to prefill_len cached columns and is filled
-        as a free side effect of a cache-miss admission's chunked
-        prefill, then reused by later admissions sharing the prefix.
-      prefix_block_tokens: prefix hash/publish granularity — prefixes
-        are cached and matched in multiples of this many tokens.
+      kv_block_tokens: paged-KV page size in cache positions — also
+        the prefix hash/share granularity (prefixes are cached and
+        aliased in multiples of this many tokens).
+      kv_pool_blocks: device block-pool capacity in pages.  0 (the
+        default) sizes it to ``slots x ceil(max_len /
+        kv_block_tokens)`` — capacity parity with a slot-reserved
+        cache; a smaller pool trades worst-case headroom for more
+        co-resident short requests (mixed-length traffic fits far
+        more than ``slots`` worth of worst cases), and exhaustion
+        sheds typed Overloaded rather than deadlocking: every
+        admission reserves its worst-case page count or stays queued.
+      prefix_caching: publish/reuse shared prefixes as refcounted
+        block aliases (zero-copy; False disables lookup and
+        publication, chunked prefill still applies).
       max_queue_depth: bounded admission — a submit arriving with this
         many requests already waiting for slots fails fast with
         Overloaded (HTTP 429 / gRPC RESOURCE_EXHAUSTED) instead of
@@ -275,17 +306,15 @@ class DecodeEngine:
         steps_per_call: int = 1,
         admit_width: int = 4,
         prefill_chunk_tokens: int = 64,
-        prefix_pool_blocks: int = 4,
-        prefix_block_tokens: int = 16,
+        kv_block_tokens: int = 16,
+        kv_pool_blocks: int = 0,
+        prefix_caching: bool = True,
         max_queue_depth: int = 0,
         overload_retry_after_s: float = 1.0,
         speculative_tokens: int = 0,
         name: str = "engine",
     ):
-        from kubeflow_tpu.models.generate import (
-            init_prefix_pool,
-            init_slot_state,
-        )
+        from kubeflow_tpu.models.generate import init_paged_state
         from kubeflow_tpu.runtime.prom import REGISTRY
 
         if slots < 1:
@@ -317,8 +346,16 @@ class DecodeEngine:
         self.admit_width = max(1, min(int(admit_width), slots))
         self.prefill_chunk_tokens = max(1, int(prefill_chunk_tokens))
         self.chunk_w = min(self.prefill_chunk_tokens, self.prefill_len)
-        self.prefix_pool_blocks = max(0, int(prefix_pool_blocks))
-        self.prefix_block_tokens = max(1, int(prefix_block_tokens))
+        self.kv_block_tokens = max(1, int(kv_block_tokens))
+        # Per-slot block-table span: enough logical pages to cover
+        # max_len positions (a static program shape).
+        self._table_blocks = -(-self.max_len // self.kv_block_tokens)
+        self.kv_pool_blocks = int(kv_pool_blocks) \
+            or slots * self._table_blocks
+        if self.kv_pool_blocks < 1:
+            raise ValueError(
+                f"kv_pool_blocks must be >= 1, got {self.kv_pool_blocks}")
+        self.prefix_caching = bool(prefix_caching)
         self.max_queue_depth = max(0, int(max_queue_depth))
         self.overload_retry_after_s = overload_retry_after_s
         self._eos = decode.eos_token >= 0
@@ -344,20 +381,23 @@ class DecodeEngine:
             # the k-token verify window is what amortizes dispatch
             # instead of the read lag.
             self.sync_lag = 0
-        self._state = init_slot_state(cfg, slots, self.max_len,
-                                      decode.kv_cache_dtype)
-        # Donor prefix pool: allocated even when caching is off (one
-        # row) so the chunk/copy programs keep one static shape — the
-        # copy program's slot FREEZE is load-bearing for admission
-        # safety regardless of caching (see copy_prefix_into_slot).
-        self._pool_rows = max(1, self.prefix_pool_blocks)
-        self._pool = init_prefix_pool(cfg, self._pool_rows,
-                                      self.prefill_len,
-                                      decode.kv_cache_dtype)
-        self._index = (
-            PrefixIndex(self.prefix_pool_blocks,
-                        self.prefix_block_tokens, self.prefill_len)
-            if self.prefix_pool_blocks > 0 else None)
+        self._state = init_paged_state(cfg, slots, self.kv_pool_blocks,
+                                       self.kv_block_tokens,
+                                       decode.kv_cache_dtype)
+        # Host-owned per-slot block tables, passed into every program
+        # call; the sentinel value (== pool size) parks writes and
+        # reads of unallocated logical pages.  Loop-thread-owned.
+        self._tables = np.full(
+            (slots, self._table_blocks), self.kv_pool_blocks, np.int32)
+        # Paged-KV bookkeeping: physical refcounts, admission
+        # reservations, and the block-hashed prefix index.  Mutated by
+        # the loop thread ONLY, always under self._lock (submit reads
+        # available() for shed attribution).
+        self._mgr = BlockManager(self.kv_pool_blocks,
+                                 self.kv_block_tokens,
+                                 caching=self.prefix_caching)
+        self._evict_rec_seen = 0
+        self._evict_blk_seen = 0
         # AOT executables, built lazily by the loop thread: the step
         # loop calls its programs thousands of times per second, and
         # the jitted wrapper re-hashes the whole params pytree
@@ -367,7 +407,6 @@ class DecodeEngine:
         # literal: these three fields ARE the engine's compiled
         # programs.
         self._chunk_exec = None
-        self._copy_exec = None
         self._step_exec = None
         self._verify_exec = None
         # Drafting-scan backoff (loop-thread-owned): consecutive empty
@@ -402,6 +441,7 @@ class DecodeEngine:
             "prefix_hits": 0, "prefix_misses": 0, "prefix_evictions": 0,
             "prefill_chunks": 0, "cached_tokens": 0, "prompt_tokens": 0,
             "spec_drafted": 0, "spec_accepted": 0, "spec_steps": 0,
+            "kv_evictions": 0, "kv_shed_no_blocks": 0,
         }
         self._step_times: List[float] = []   # bounded reservoirs
         self._chunk_times: List[float] = []
@@ -431,6 +471,14 @@ class DecodeEngine:
             PREFIX_EVICTIONS_TOTAL, PREFIX_EVICTIONS_HELP)
         self._chunks_ctr = REGISTRY.counter(
             PREFILL_CHUNKS_TOTAL, PREFILL_CHUNKS_HELP)
+        self._kv_blocks_gauge = REGISTRY.gauge(
+            KV_BLOCKS_GAUGE, KV_BLOCKS_HELP)
+        self._kv_used_gauge = REGISTRY.gauge(
+            KV_BLOCKS_USED_GAUGE, KV_BLOCKS_USED_HELP)
+        self._kv_evict_ctr = REGISTRY.counter(
+            KV_EVICTIONS_TOTAL, KV_EVICTIONS_HELP)
+        self._kv_shed_ctr = REGISTRY.counter(
+            KV_SHED_TOTAL, KV_SHED_HELP)
         self._spec_drafted_ctr = REGISTRY.counter(
             SPEC_DRAFTED_TOTAL, SPEC_DRAFTED_HELP)
         self._spec_accepted_ctr = REGISTRY.counter(
@@ -441,10 +489,13 @@ class DecodeEngine:
         self._expired_ctr = REGISTRY.counter(EXPIRED_TOTAL, EXPIRED_HELP)
         self._occ_gauge.set(0, engine=name)
         self._queue_gauge.set(0, engine=name)
+        self._kv_blocks_gauge.set(self.kv_pool_blocks, engine=name)
+        self._kv_used_gauge.set(0, engine=name)
         # Last values pushed to the gauges — the step loop only touches
         # the (locked) registry when a value actually changes.
         self._occ_last = 0
         self._queue_last = 0
+        self._kv_used_last = 0
         self._thread = threading.Thread(
             target=self._run, daemon=True, name=f"decode-engine-{name}")
         self._thread.start()
@@ -531,6 +582,11 @@ class DecodeEngine:
         # thread stamps spans from perf readings at drain time (never
         # per token), so the hot step loop stays untouched and a
         # disabled tracer costs one None check per site.
+        # Worst-case paged-KV reservation: every position the request
+        # could ever write (prompt + full budget) in whole pages.
+        # Reserving it at admission is what makes block exhaustion a
+        # typed shed instead of a mid-flight deadlock.
+        res_blocks = -(-(length + new) // self.kv_block_tokens)
         trace_ctx = tracing.current_ctx()
         entry = {
             "tokens": tokens, "new": new, "seed": seed,
@@ -539,7 +595,9 @@ class DecodeEngine:
             "t_perf": time.perf_counter()
             if trace_ctx is not None else 0.0,
             "t_first_perf": None, "spec_acc": 0,
-            "prefilling": False, "pos": 0, "cached": 0, "pool_row": None,
+            "prefilling": False, "pos": 0, "cached": 0,
+            "res_blocks": res_blocks, "res_left": 0, "blocks": [],
+            "released": False,
             # Adaptive draft width: grows on full accepts, shrinks on
             # full rejects; 0 = backed off (re-probes after cooldown).
             "spec_k": self.speculative_tokens, "spec_cool": 0,
@@ -571,12 +629,32 @@ class DecodeEngine:
             if self._stopped:
                 raise BatcherClosed(
                     f"engine {self._metric_name!r} is closed")
+            if res_blocks > self.kv_pool_blocks:
+                # The request's worst case can NEVER fit this pool —
+                # queueing it would wedge the admission head forever,
+                # so shed it typed (the client can retry a smaller
+                # budget; capacity planning reads the counter).
+                self._counters["shed"] += 1
+                self._counters["kv_shed_no_blocks"] += 1
+                self._shed_ctr.inc(batcher=self._metric_name)
+                self._kv_shed_ctr.inc(engine=self._metric_name)
+                raise Overloaded(
+                    f"request needs {res_blocks} KV blocks but engine "
+                    f"{self._metric_name!r}'s pool holds "
+                    f"{self.kv_pool_blocks}",
+                    retry_after_s=self.overload_retry_after_s)
             if self.max_queue_depth \
                     and len(self._queue) >= self.max_queue_depth:
-                # Bounded admission: all slots busy and the wait line
-                # is full — fail fast instead of queueing unboundedly
-                # (under overload a 429 now beats a 504 later).
+                # Bounded admission: the wait line is full — fail fast
+                # instead of queueing unboundedly (under overload a
+                # 429 now beats a 504 later).  Attribute the shed:
+                # when the block pool (tokens resident), not the slot
+                # count, is what is binding, the kv counter tells the
+                # operator to grow --kv_pool_blocks rather than slots.
                 self._counters["shed"] += 1
+                if self._mgr.available() < res_blocks:
+                    self._counters["kv_shed_no_blocks"] += 1
+                    self._kv_shed_ctr.inc(engine=self._metric_name)
                 self._shed_ctr.inc(batcher=self._metric_name)
                 raise Overloaded(
                     f"engine {self._metric_name!r} admission queue "
@@ -593,14 +671,14 @@ class DecodeEngine:
 
     def compiled_programs(self) -> Dict[str, int]:
         """How many device programs this engine has compiled — by
-        construction at most one chunked-prefill, one prefix-copy, one
-        step, and one speculative-verify executable (the build sites
-        are None-guarded), so a healthy engine reports at most
-        {"chunked_prefill": 1, "copy_prefix": 1, "step": 1,
-        "verify": 1} for its whole lifetime ("verify" stays 0 unless
-        speculation is enabled AND a slot actually drafted)."""
+        construction at most one chunked-prefill, one step, and one
+        speculative-verify executable (the build sites are
+        None-guarded), so a healthy engine reports at most
+        {"chunked_prefill": 1, "step": 1, "verify": 1} for its whole
+        lifetime ("verify" stays 0 unless speculation is enabled AND a
+        slot actually drafted).  There is no prefix-copy program:
+        shared-prefix reuse is host-side block-table aliasing."""
         return {"chunked_prefill": int(self._chunk_exec is not None),
-                "copy_prefix": int(self._copy_exec is not None),
                 "step": int(self._step_exec is not None),
                 "verify": int(self._verify_exec is not None)}
 
@@ -614,6 +692,7 @@ class DecodeEngine:
                 "queue_depth": len(self._queue),
                 "active_slots": sum(
                     r is not None for r in self._slot_req),
+                "kv_used": self._mgr.used_blocks(),
                 "step_times": list(self._step_times),
                 "chunk_times": list(self._chunk_times),
                 "gap_times": list(self._gap_times),
@@ -657,8 +736,8 @@ class DecodeEngine:
             # in-flight) — the chaos scenario's primary assertions.
             "shed": c["shed"],
             "deadline_expired": c["expired"],
-            # Prefix cache: how much prompt compute the donor pool
-            # saved.  cached_token_ratio is the operator's one-glance
+            # Prefix cache: how much prompt compute block-table
+            # aliasing saved.  cached_token_ratio is the one-glance
             # effectiveness number (also exported per-replica to the
             # fleet — see ModelServer.refresh_gauges).
             "prefix_hits": c["prefix_hits"],
@@ -669,6 +748,20 @@ class DecodeEngine:
             "cached_token_ratio": round(
                 c["cached_tokens"] / prompt_toks, 4)
             if prompt_toks else 0.0,
+            # Paged KV pool: capacity is tokens RESIDENT, not slots.
+            # kv_utilization is the one-glance "how full is this
+            # chip's serving memory" number (the fleet CACHE% story
+            # extended to capacity); the shed counter attributes
+            # overload to the pool rather than the slot count.
+            "kv_blocks": self.kv_pool_blocks,
+            "kv_blocks_used": extra["kv_used"],
+            "kv_block_tokens": self.kv_block_tokens,
+            "kv_block_evictions": c["kv_evictions"],
+            "kv_shed_no_blocks": c["kv_shed_no_blocks"],
+            "tokens_resident": extra["kv_used"] * self.kv_block_tokens,
+            "kv_utilization": round(
+                extra["kv_used"] / self.kv_pool_blocks, 4)
+            if self.kv_pool_blocks else 0.0,
             # Speculative decoding: drafted vs accepted tokens and the
             # per-verify-call yield.  accepted_per_step is the mean
             # EXTRA tokens a verify call delivered beyond the one a
@@ -731,14 +824,18 @@ class DecodeEngine:
         # The prefix index dies with the engine (reload invalidation:
         # the serving layer rebuilds engine + pool per model version);
         # clear it here too so a closed-but-referenced engine can never
-        # serve a stale prefix.
-        if self._index is not None:
-            self._index.invalidate()
-        # A closed engine exports no live slots or queue: hot-swap
-        # retires the metric series at zero instead of freezing a
-        # stale occupancy in /metrics forever.
+        # serve a stale prefix.  After a clean drain every slot has
+        # released its pages, so dropping the cached records frees the
+        # whole pool.
+        with self._lock:
+            self._mgr.invalidate()
+        # A closed engine exports no live slots, queue, or resident
+        # KV: hot-swap retires the metric series at zero instead of
+        # freezing a stale occupancy in /metrics forever.
         self._set_occ_gauge(0)
         self._set_queue_gauge(0)
+        self._kv_blocks_gauge.set(0, engine=self._metric_name)
+        self._set_kv_used_gauge(0)
 
     # -- step loop --------------------------------------------------------
 
@@ -774,6 +871,12 @@ class DecodeEngine:
             d = entry["deadline"]
             if d is not None and d <= pnow:
                 self._slot_req[i] = None
+                # Park the dead occupant's table row: its in-flight
+                # device state (done may still be False) keeps
+                # advancing harmlessly, but every write now drops —
+                # its freed pages can be reallocated immediately.
+                self._tables[i][:] = self.kv_pool_blocks
+                self._release_entry_locked(entry)
                 self._counters["in_flight"] -= 1
                 expired.append(entry)
         # Deterministically-retired requests live in NEITHER the queue
@@ -794,6 +897,11 @@ class DecodeEngine:
                     continue
                 if any(entry is e for e in expired):
                     continue
+                # Deterministically retired: the slot (and possibly
+                # its table row) already belongs to a successor, but
+                # the entry still owns its physical pages until
+                # delivery — release them now with the failure.
+                self._release_entry_locked(entry)
                 self._counters["in_flight"] -= 1
                 expired.append(entry)
         if expired:
@@ -820,13 +928,87 @@ class DecodeEngine:
                     f"(engine {self._metric_name!r})")
                 entry["event"].set()
 
-    def _release_capture(self, entry: dict) -> None:
-        """Abandon an entry's donor capture (expired mid-prefill): the
-        pool row's partial writes are unreachable and the row unpins."""
-        row = entry.get("pool_row")
-        entry["pool_row"] = None
-        if row is not None and self._index is not None:
-            self._index.abort_capture(row)
+    def _release_entry_locked(self, entry: dict) -> None:
+        """Return an entry's physical pages (slot refs) and never-taken
+        reservation to the pool.  Pages a published prefix record
+        advertises stay resident as evictable cache.  Idempotent —
+        retirement, expiry, and drain can each reach a request once.
+        Never touches the slot's table row: by release time the row
+        may already belong to a successor request."""
+        if entry["released"]:
+            return
+        entry["released"] = True
+        self._mgr.release(entry["blocks"], unreserve=entry["res_left"])
+        entry["blocks"] = []
+        entry["res_left"] = 0
+
+    def _plan_blocks_locked(self, entry: dict):
+        """Reserve the entry's worst-case page count (aliasing the
+        longest cached prefix for free); None = the pool cannot cover
+        it yet, leave the request at the queue head — retirements free
+        pages, and FIFO order means a starving big request is never
+        jumped into starvation."""
+        prompt = entry["tokens"][0]
+        return self._mgr.admit(prompt, int(prompt.shape[0]) - 1,
+                               entry["res_blocks"])
+
+    def _ensure_cover(self, entry: dict, upto_pos: int) -> None:
+        """Grow the slot's block table to cover position ``upto_pos``,
+        taking physical pages from the entry's admission reservation
+        (capped there — positions past the reservation park on the
+        table sentinel and their writes drop; only positions the
+        frontier can never reach land there)."""
+        target = min(upto_pos // self.kv_block_tokens + 1,
+                     entry["res_blocks"])
+        if target <= len(entry["blocks"]):
+            return
+        # Chaos hook: raise = allocation failure (engine death at the
+        # growth site — _abort resolves every waiter), sleep = slow
+        # allocator under pool pressure.
+        faults.fire("engine.alloc_block")
+        row = self._tables[entry["slot"]]
+        with self._lock:
+            while len(entry["blocks"]) < target:
+                blk = self._mgr.take()
+                row[len(entry["blocks"])] = blk
+                entry["blocks"].append(blk)
+                entry["res_left"] -= 1
+            rec_d, blk_d = self._flush_evictions_locked()
+        if rec_d:
+            self._evict_ctr.inc(rec_d, engine=self._metric_name)
+        if blk_d:
+            self._kv_evict_ctr.inc(blk_d, engine=self._metric_name)
+
+    def _trim_cover(self, entry: dict, next_write_pos: int) -> None:
+        """Speculative rollback, pool side: pages past the one covering
+        ``next_write_pos`` hold only rejected-draft k/v (already behind
+        the attention mask) — return them to the pool and restore the
+        entry's reservation, so a burst of rejected windows never
+        inflates tokens resident."""
+        target = max(1, next_write_pos // self.kv_block_tokens + 1)
+        n = len(entry["blocks"])
+        if n <= target:
+            return
+        row = self._tables[entry["slot"]]
+        row[target:n] = self.kv_pool_blocks
+        with self._lock:
+            tail = entry["blocks"][target:]
+            del entry["blocks"][target:]
+            entry["res_left"] += len(tail)
+            self._mgr.rollback(tail)
+
+    def _flush_evictions_locked(self):
+        """Fold the manager's eviction totals into the engine counters;
+        returns the (records, blocks) deltas for the prom counters."""
+        rec_d = self._mgr.evictions - self._evict_rec_seen
+        blk_d = self._mgr.block_evictions - self._evict_blk_seen
+        if rec_d:
+            self._evict_rec_seen = self._mgr.evictions
+            self._counters["prefix_evictions"] += rec_d
+        if blk_d:
+            self._evict_blk_seen = self._mgr.block_evictions
+            self._counters["kv_evictions"] += blk_d
+        return rec_d, blk_d
 
     def _set_queue_gauge(self, depth: int) -> None:
         if depth != self._queue_last:
@@ -838,79 +1020,57 @@ class DecodeEngine:
             self._occ_last = active
             self._occ_gauge.set(active, engine=self._metric_name)
 
-    def _begin_prefill(self, entry: dict, slot: int) -> None:
-        """Admission, host side: find the longest cached prefix, copy
-        it into (and FREEZE) the slot in one device call, claim a donor
-        row for capture on a miss, and queue the entry for chunked
-        prefill.  The copy program runs for EVERY admission — at k = 0
-        it is the claim-time freeze that makes reusing a deadline-
-        expired slot safe (see copy_prefix_into_slot)."""
-        from kubeflow_tpu.models.generate import copy_prefix_into_slot
+    def _set_kv_used_gauge(self, used: int) -> None:
+        if used != self._kv_used_last:
+            self._kv_used_last = used
+            self._kv_used_gauge.set(used, engine=self._metric_name)
 
+    def _begin_prefill(self, entry: dict, slot: int) -> None:
+        """Admission, host side.  The admission plan already aliased
+        the longest cached prefix into the slot's block table (a
+        refcount bump — no device copy exists), so all that remains is
+        accounting and the FIRST prefill chunk, dispatched at claim
+        time: its unconditional device-side ``done`` freeze is what
+        makes reusing a deadline-expired slot safe — without it an
+        interleaved decode_step would advance the dead occupant and
+        scatter through the NEW request's table."""
         prompt = entry["tokens"][0]
         true_len = int(prompt.shape[0])
-        row, cached = (None, 0)
-        if self._index is not None:
-            row, cached = self._index.lookup(prompt, true_len - 1)
+        cached = entry["cached"]
         # Chaos hook: sleep = slow admission; raise = device death at
         # admission (propagates to _abort, every waiter resolved).
         faults.fire("engine.admit")
-        if self._copy_exec is None:
-            self._copy_exec = copy_prefix_into_slot.lower(
-                self._state, self._pool, np.int32(0), np.int32(0),
-                np.int32(0)).compile()
-        t0 = time.perf_counter()
-        self._state = self._copy_exec(
-            self._state, self._pool, np.int32(row or 0), np.int32(slot),
-            np.int32(cached))
-        dt = time.perf_counter() - t0
-        evicted = False
-        if (self._index is not None and cached == 0
-                and true_len >= self.prefix_block_tokens):
-            # Full miss with at least one publishable block: capture
-            # this prompt's prefix as a new donor while prefilling it.
-            # Partial hits don't extend the donor (a donor must be
-            # self-contained from column 0); the pool stays small, so
-            # the common shared-system-prompt case — one miss, then
-            # hits — is the one that matters.
-            pool_row, evicted = self._index.begin_capture()
-            entry["pool_row"] = pool_row
-        entry["pos"] = cached
-        entry["cached"] = cached
-        entry["prefilling"] = True
-        self._prefilling.append(entry)
         with self._lock:
             self._counters["prompt_tokens"] += true_len
-            self._counters["busy_s"] += dt
-            if self._index is not None:
+            if self.prefix_caching:
                 # Hit/miss accounting only when caching is ON — with
-                # --prefix_pool_blocks 0 a climbing miss counter would
-                # read as "cache enabled and failing" on dashboards.
+                # caching disabled a climbing miss counter would read
+                # as "cache enabled and failing" on dashboards.
                 if cached:
                     self._counters["prefix_hits"] += 1
                     self._counters["cached_tokens"] += cached
                 else:
                     self._counters["prefix_misses"] += 1
-                if evicted:
-                    self._counters["prefix_evictions"] += 1
-        if self._index is not None:
+        if self.prefix_caching:
             (self._hits_ctr if cached else self._misses_ctr).inc(
                 engine=self._metric_name)
-            if evicted:
-                self._evict_ctr.inc(engine=self._metric_name)
         if entry["trace"] is not None:
             # Admission span: queue wait (submit -> slot claim) plus
-            # the prefix lookup/copy, annotated with the cache verdict
-            # — TTFT debugging's first question ("was it queued or was
-            # it prefill?") answered per request.
+            # the prefix verdict — TTFT debugging's first question
+            # ("was it queued or was it prefill?") answered per
+            # request.  cached tokens cost zero copies now, so there
+            # is no copy_ms to report.
             tracing.record_span(
                 "engine.admission", entry["trace"], entry["t_perf"],
                 time.perf_counter(),
                 attrs={"engine": self._metric_name, "slot": slot,
                        "prompt_tokens": true_len,
                        "cached_tokens": cached,
-                       "prefix": "hit" if cached else "miss",
-                       "copy_ms": round(dt * 1e3, 3)})
+                       "prefix": "hit" if cached else "miss"})
+        entry["prefilling"] = True
+        self._prefill_chunk(entry)  # claim-time freeze + first chunk
+        if entry["prefilling"]:
+            self._prefilling.append(entry)
 
     def _prefill_chunk(self, entry: dict) -> None:
         """One static-width chunk of one entry's prompt into its slot
@@ -921,34 +1081,29 @@ class DecodeEngine:
         w = self.chunk_w
         prompt = entry["tokens"][0]
         true_len = int(prompt.shape[0])
-        # The final chunk's [start, start+w) write window must fit the
-        # slot's max_len columns — XLA's dynamic_update_slice CLAMPS an
-        # out-of-bounds start (it does not drop), which would shift the
-        # whole chunk onto earlier valid columns.  Pulling start back
-        # recomputes a few already-written columns instead: same
-        # tokens, same positions, same prefix KV => identical k/v, so
-        # the overlap is a no-op rewrite.  Only the final chunk can
-        # overflow (intermediate chunks end before prompt_len <=
-        # prefill_len < max_len), so this never slows steady prefill.
-        start = min(entry["pos"], self.max_len - w)
+        # The chunk's [start, start+w) window may overhang the
+        # reserved pages on the final chunk (right-pad columns past
+        # the prompt): the paged scatter PARKS those positions on the
+        # table sentinel and drops them — they sit beyond every
+        # frontier the slot can reach, so no pull-back dance is
+        # needed.
+        start = entry["pos"]
         chunk = np.zeros((1, w), np.int32)
         seg = prompt[start:start + w]
         chunk[0, :seg.shape[0]] = seg
-        pool_row = entry["pool_row"]
-        if pool_row is None:
-            pool_row = self._pool_rows  # OOB = capture writes dropped
+        self._ensure_cover(entry, start + w - 1)
         if self._chunk_exec is None:
             self._chunk_exec = prefill_chunk_into_slot.lower(
                 self.cfg, self.params, self._state, self.decode,
-                self._pool, chunk, np.int32(0), np.int32(1),
-                np.int32(1), np.int32(0), np.int32(0),
-                np.int32(0)).compile()
+                chunk, np.int32(0), np.int32(1), np.int32(1),
+                np.int32(0), np.int32(0),
+                self._tables[:1]).compile()
         t0 = time.perf_counter()
-        self._state, self._pool, tok = self._chunk_exec(
-            self.params, self._state, self._pool, chunk,
+        self._state, tok = self._chunk_exec(
+            self.params, self._state, chunk,
             np.int32(start), np.int32(true_len), np.int32(entry["new"]),
-            np.int32(entry["slot"]), np.int32(pool_row),
-            np.int32(entry["seed"]))
+            np.int32(entry["slot"]), np.int32(entry["seed"]),
+            self._tables[entry["slot"]:entry["slot"] + 1])
         dt = time.perf_counter() - t0
         entry["pos"] = start + w
         finished = entry["pos"] >= true_len
@@ -956,10 +1111,13 @@ class DecodeEngine:
             entry["prefilling"] = False
             entry["scheduled"] = 1
             self._pending.append((tok, [(0, entry)], None))
-            if entry["pool_row"] is not None and self._index is not None:
-                self._index.commit_capture(
-                    entry["pool_row"], prompt, true_len)
-                entry["pool_row"] = None
+            if self.prefix_caching:
+                # Publication is free: the full-block prefix pages
+                # this prefill just wrote ARE the cache entry — a
+                # refcount bump in the index, no donor copy.
+                with self._lock:
+                    self._mgr.publish(prompt, true_len,
+                                      entry["blocks"])
         with self._lock:
             self._counters["prefill_chunks"] += 1
             # Prefill compute belongs in busy_s — tokens_per_sec must
@@ -1024,6 +1182,7 @@ class DecodeEngine:
         host = np.asarray(arr)
         emitted = 0
         finished = 0
+        finished_entries: List[dict] = []
         ttfts: List[float] = []
         if counts is not None:
             counts = np.asarray(counts)
@@ -1062,6 +1221,7 @@ class DecodeEngine:
                         # kft: allow=lock-guard
                         self._slot_req[entry["slot"]] = None
                     self._finish(entry)
+                    finished_entries.append(entry)
                     ttfts.append(entry["t_first"] - entry["t"])
                     finished += 1
                     break
@@ -1069,6 +1229,11 @@ class DecodeEngine:
             self._counters["tokens"] += emitted
             self._counters["requests"] += finished
             self._counters["in_flight"] -= finished
+            # Delivered requests return their private KV pages to the
+            # pool; published prefix pages stay resident as evictable
+            # cache until LRU eviction needs them.
+            for e in finished_entries:
+                self._release_entry_locked(e)
             self._ttft_times.extend(ttfts)
             if len(self._ttft_times) > 4096:
                 del self._ttft_times[:2048]
@@ -1197,25 +1362,35 @@ class DecodeEngine:
         slot, drain the variable-count emissions synchronously, and
         fold the outcome into the adaptive widths + counters.
 
-        Rejected drafts need no host-side cleanup: the program only
-        advanced each slot's cache_len over the accepted prefix, so
-        the rejected columns are already behind the attention mask
-        (device-side rollback), and donor-pool capture only ever runs
-        in the prefill-chunk program — a drafted-but-rejected token
-        can never be captured into a prefix-pool row."""
+        Rejected drafts need minimal host-side cleanup: the program
+        only advanced each slot's cache_len over the accepted prefix,
+        so the rejected columns are already behind the attention mask
+        (device-side rollback) and the host just trims whole rejected-
+        tail BLOCKS back to the pool; prefix publication only ever
+        covers full PROMPT blocks written by prefill, so a drafted-
+        but-rejected token can never enter a published prefix page."""
         from kubeflow_tpu.models.generate import verify_step
 
+        # Cover every slot's verify window [len, len + k] with pages
+        # from its reservation BEFORE dispatch (accepted positions
+        # must land in real pages; positions past the reservation can
+        # only be rejected/past-budget and park on the sentinel).
+        for _, entry in snapshot:
+            self._ensure_cover(
+                entry, entry["tokens"].shape[1] + len(entry["emitted"])
+                + self.speculative_tokens)
         if self._verify_exec is None:
             self._verify_exec = verify_step.lower(
                 self.cfg, self.params, self._state, self.decode,
-                self.speculative_tokens, draft, draft_len).compile()
+                self.speculative_tokens, draft, draft_len,
+                self._tables).compile()
         # Chaos hook: the same site as the decode step — injected
         # stalls/deaths must hit speculative rounds identically
         # (deadlines expire mid-verify, _abort resolves waiters).
         faults.fire("engine.step")
         t0 = time.perf_counter()
         self._state, toks, counts = self._verify_exec(
-            self.params, self._state, draft, draft_len)
+            self.params, self._state, draft, draft_len, self._tables)
         # Materialize ONCE and share the host copies with the drain —
         # a second device->host transfer per round would show up at
         # this call rate.
@@ -1252,6 +1427,21 @@ class DecodeEngine:
                 if entry["spec_k"] <= 0:
                     entry["spec_k"] = 0
                     entry["spec_cool"] = _SPEC_COOLDOWN
+        # Speculative rollback, pool side: the drain materialized each
+        # slot's true emission count, so pages past the new frontier
+        # hold only rejected-draft garbage — trim them back to the
+        # pool (a delivered/expired entry already released everything).
+        # `scheduled` tracks the delivered count too: the plain decode
+        # rounds that follow a backed-off slot size their page cover
+        # from it, and a stale value would let a later decode write
+        # park on the table sentinel and silently drop its k/v.
+        for _, entry in snapshot:
+            entry["scheduled"] = max(entry["scheduled"],
+                                     len(entry["emitted"]))
+            if not entry["released"]:
+                self._trim_cover(
+                    entry,
+                    entry["tokens"].shape[1] + len(entry["emitted"]))
         total = int(counts_np.sum())
         advancing = int(np.count_nonzero(counts_np))
         if dt > 0:
@@ -1297,8 +1487,19 @@ class DecodeEngine:
                         while (free and self._queue
                                and len(self._prefilling)
                                + len(admissions) < self.admit_width):
-                            entry = self._queue.pop(0)
+                            entry = self._queue[0]
+                            plan = self._plan_blocks_locked(entry)
+                            if plan is None:
+                                # Tokens-resident admission bound: the
+                                # pool cannot reserve this request's
+                                # worst case yet.  It HOLDS the queue
+                                # head (FIFO — no starvation) until
+                                # retirements free pages; submit sheds
+                                # new arrivals past the queue cap.
+                                break
+                            self._queue.pop(0)
                             slot = free.pop(0)
+                            shared, cached = plan
                             # Claim the slot and bump in_flight in the
                             # same locked section that pops the queue:
                             # stats() must never see queue_depth==0 AND
@@ -1308,22 +1509,31 @@ class DecodeEngine:
                             # by _abort even if its prefill dispatch
                             # dies.
                             entry["slot"] = slot
+                            entry["cached"] = cached
+                            entry["pos"] = cached
+                            entry["blocks"] = list(shared)
+                            entry["res_left"] = \
+                                entry["res_blocks"] - len(shared)
+                            # Zero-copy prefix resume: the cached
+                            # blocks slide into the table's leading
+                            # entries; prefill starts at the cached
+                            # offset.
+                            row = self._tables[slot]
+                            row[:] = self.kv_pool_blocks
+                            row[:len(shared)] = shared
                             self._slot_req[slot] = entry
                             self._counters["in_flight"] += 1
                             admissions.append((entry, slot))
                         self._set_queue_gauge(len(self._queue))
                 self._fail_expired(expired)
                 if expired and self._prefilling:
-                    # Mid-prefill expiries leave the chunk schedule and
-                    # release their donor captures; their frozen slots
-                    # are safe to reclaim (claim-time freeze).
-                    keep = []
-                    for p in self._prefilling:
-                        if any(p is e for e in expired):
-                            self._release_capture(p)
-                        else:
-                            keep.append(p)
-                    self._prefilling = keep
+                    # Mid-prefill expiries leave the chunk schedule
+                    # (the sweep already released their pages and
+                    # parked their table rows); their frozen slots are
+                    # safe to reclaim (claim-time first-chunk freeze).
+                    self._prefilling = [
+                        p for p in self._prefilling
+                        if not any(p is e for e in expired)]
                 if past_drain:
                     self._abort(RuntimeError(
                         f"engine {self._metric_name!r} drain deadline "
@@ -1396,6 +1606,19 @@ class DecodeEngine:
                                 continue
                 if live:
                     k = self.steps_per_call
+                    # Cover every advancing slot's next k write
+                    # positions with pages from its admission
+                    # reservation BEFORE dispatch (the reservation
+                    # guarantees them, so this can never block); slots
+                    # already done on device write nothing, and the
+                    # cover cap at res_blocks bounds what an EOS-lagged
+                    # slot can take to pages it had reserved anyway.
+                    for r in self._slot_req:
+                        if r is None or r["prefilling"]:
+                            continue
+                        self._ensure_cover(
+                            r, r["tokens"].shape[1]
+                            + r["scheduled"] + k - 1)
                     # Build (one-time) OUTSIDE the timed window: the
                     # first per-token latency sample must not carry
                     # seconds of XLA compile into the p50/p95 stats and
@@ -1403,7 +1626,7 @@ class DecodeEngine:
                     if self._step_exec is None:
                         self._step_exec = decode_step.lower(
                             self.cfg, self.params, self._state,
-                            self.decode, k).compile()
+                            self.decode, k, self._tables).compile()
                     # Chaos hook: sleep = slow/wedged step (deadlines
                     # expire mid-generation); raise = device death.
                     # Outside the timed window so the injected stall
@@ -1421,7 +1644,7 @@ class DecodeEngine:
                                   if self.speculative_tokens else 0)
                     t0 = time.perf_counter()
                     self._state, sampled = self._step_exec(
-                        self.params, self._state)
+                        self.params, self._state, self._tables)
                     self._pending.append((sampled, [
                         (i, r) for i, r in enumerate(self._slot_req)
                         if r is not None and not r["prefilling"]], None))
@@ -1467,6 +1690,10 @@ class DecodeEngine:
                             self._drain_one()
                 self._set_occ_gauge(
                     sum(r is not None for r in self._slot_req))
+                # Pages resident (loop thread is the pool's only
+                # mutator; the guarded setter only touches the locked
+                # registry on change).
+                self._set_kv_used_gauge(self._mgr.used_blocks())
         except BaseException as exc:  # noqa: BLE001 — fail loudly to waiters
             self._abort(exc)
 

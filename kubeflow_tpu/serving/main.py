@@ -34,8 +34,9 @@ def batcher_factory(*, micro_batch_size: int, batch_timeout_s: float,
                     lm_engine_steps_per_call: int = 1,
                     lm_engine_admit_width: int = 4,
                     prefill_chunk_tokens: int = 64,
-                    prefix_pool_blocks: int = 4,
-                    prefix_block_tokens: int = 16,
+                    kv_block_tokens: int = 16,
+                    kv_pool_blocks: int = 0,
+                    prefix_caching: bool = True,
                     max_queue_depth: int = 0,
                     overload_retry_after_s: float = 1.0,
                     speculative_tokens: int = 0):
@@ -97,8 +98,9 @@ def batcher_factory(*, micro_batch_size: int, batch_timeout_s: float,
                     steps_per_call=lm_engine_steps_per_call,
                     admit_width=lm_engine_admit_width,
                     prefill_chunk_tokens=prefill_chunk_tokens,
-                    prefix_pool_blocks=prefix_pool_blocks,
-                    prefix_block_tokens=prefix_block_tokens,
+                    kv_block_tokens=kv_block_tokens,
+                    kv_pool_blocks=kv_pool_blocks,
+                    prefix_caching=prefix_caching,
                     max_queue_depth=max_queue_depth,
                     overload_retry_after_s=overload_retry_after_s,
                     speculative_tokens=speculative_tokens,
@@ -199,17 +201,25 @@ def main(argv=None) -> int:
                          "decode steps, so in-flight inter-token "
                          "latency is bounded by one chunk regardless "
                          "of prompt length")
-    ap.add_argument("--prefix_pool_blocks", type=int, default=4,
-                    help="DecodeEngine shared-prefix KV pool: donor "
-                         "rows cached for prefix reuse across "
-                         "requests (each holds up to the prefill "
-                         "width; 0 disables prefix caching).  Size to "
-                         "the number of DISTINCT hot system prompts; "
-                         "invalidated on every model (re)load")
-    ap.add_argument("--prefix_block_tokens", type=int, default=16,
-                    help="prefix cache hash/match granularity in "
-                         "tokens — prefixes are cached and matched in "
-                         "multiples of this")
+    ap.add_argument("--kv_block_tokens", type=int, default=16,
+                    help="DecodeEngine paged-KV page size in cache "
+                         "positions — also the prefix hash/share "
+                         "granularity (shared prefixes alias in "
+                         "multiples of this many tokens)")
+    ap.add_argument("--kv_pool_blocks", type=int, default=0,
+                    help="DecodeEngine device KV block-pool capacity "
+                         "in pages (0 = slots x ceil(max_len / "
+                         "kv_block_tokens), capacity parity with a "
+                         "slot-reserved cache).  Serving capacity is "
+                         "bounded by TOKENS RESIDENT in this pool, not "
+                         "slot count: mixed-length traffic fits far "
+                         "more requests than the worst case, and "
+                         "exhaustion sheds typed Overloaded (429)")
+    ap.add_argument("--no_prefix_cache", action="store_true",
+                    help="disable shared-prefix block aliasing "
+                         "(admissions never resume from cached "
+                         "prefixes; the paged pool and chunked "
+                         "prefill still apply)")
     ap.add_argument("--speculative_tokens", type=int, default=0,
                     help="DecodeEngine self-speculative decoding: up "
                          "to this many n-gram-drafted candidate tokens "
@@ -287,8 +297,9 @@ def main(argv=None) -> int:
                 lm_engine_steps_per_call=args.lm_engine_steps_per_call,
                 lm_engine_admit_width=args.lm_engine_admit_width,
                 prefill_chunk_tokens=args.prefill_chunk_tokens,
-                prefix_pool_blocks=args.prefix_pool_blocks,
-                prefix_block_tokens=args.prefix_block_tokens,
+                kv_block_tokens=args.kv_block_tokens,
+                kv_pool_blocks=args.kv_pool_blocks,
+                prefix_caching=not args.no_prefix_cache,
                 max_queue_depth=args.max_queue_depth,
                 overload_retry_after_s=args.overload_retry_after_s,
                 speculative_tokens=args.speculative_tokens,

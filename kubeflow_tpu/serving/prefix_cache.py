@@ -1,40 +1,67 @@
-"""Host-side block-hashed prefix index over the donor KV pool.
+"""Host-side block manager for the paged KV pool.
 
-The DecodeEngine pays full prefill for every admission even when
-thousands of chat requests share an identical system-prompt prefix.
-This module is the bookkeeping half of shared-prefix KV reuse: the
-device half (models/generate.py ``copy_prefix_into_slot`` /
-``prefill_chunk_into_slot``) copies and fills donor rows of a small
-pinned KV pool; this index remembers which pool row holds which
-token prefix, at BLOCK granularity.
+The DecodeEngine's unified KV store is a device-side BLOCK POOL
+(models/generate.py ``init_paged_state``): fixed-size pages of
+``block_tokens`` cache positions, shared by every slot through per-slot
+block tables the host passes into each program call.  This module is
+ALL of the host bookkeeping for that pool:
 
-Design, in the radix-tree-lite shape vLLM/SGLang use:
+  - **physical allocation with refcounts** — a block is free, held by
+    one or more slots (``slot_ref``: live requests whose tables point
+    at it), and/or held by the prefix cache (``rec_ref``: published
+    prefix records that advertise it).  A block returns to the free
+    list only when both counts are zero, so a cached prefix can never
+    be reallocated under a slot that aliased it;
 
-  - prompts are hashed in fixed-size token blocks, each block's digest
-    chained over its predecessor's (``h_i = H(h_{i-1} || block_i)``),
-    so a digest identifies an exact token PREFIX, not a bag of blocks;
-  - a committed pool row publishes one digest per full block it holds;
-    lookup walks the querying prompt's chain from the longest candidate
-    down and returns the deepest published match — the longest cached
-    prefix, in O(blocks) with no tree structure to rebalance;
-  - eviction is LRU over committed rows, and a row pinned by an active
-    slot (a capture in flight — the chunked prefill currently writing
-    it) is NEVER evicted: a donor must not be reallocated under the
-    program that is filling it;
-  - the index holds tokens and row numbers only — no device memory —
-    and dies with its engine, which is what makes model-reload
-    invalidation automatic (the serving layer rebuilds the engine, and
-    with it this index, around every hot-swapped version).
+  - **token-reservation admission accounting** — admission reserves a
+    request's WORST-CASE block count (ceil((prompt + budget) /
+    block_tokens)) up front and physical blocks are taken lazily from
+    that reservation as the frontier grows, so a mid-prefill or
+    mid-decode slot can never be starved by later admissions
+    (deadlock-freedom by construction: ``free + evictable >= reserved``
+    is the invariant every operation preserves), while speculative
+    rollback returns rejected-tail blocks to the pool without losing
+    the guarantee;
+
+  - **the block-hashed prefix index** — prompts are hashed in
+    ``block_tokens``-token blocks, each digest chained over its
+    predecessor's (``h_i = H(h_{i-1} || block_i)``) so a digest
+    identifies an exact token PREFIX; a completed prefill publishes its
+    full-block prefix as a record mapping digests to the PHYSICAL
+    blocks that already hold the computed k/v.  A later admission that
+    matches simply aliases those blocks into its own table (refcount
+    bump — zero device copies; divergence starts at the first
+    non-shared block, which is always a freshly allocated private
+    block because sharing is block-aligned, i.e. copy-on-write with
+    the copy statically dead);
+
+  - **LRU eviction of refcount-0 cached blocks** — when allocation
+    needs pages and the free list is dry, least-recently-used prefix
+    records are dropped; only blocks no live slot still references
+    actually free (a record evicted mid-use keeps its aliased blocks
+    resident until the aliasing slots retire).  First-writer-wins on
+    digest collisions (two misses racing to capture one hot prompt):
+    the established record keeps serving the digest, so evicting the
+    duplicate cannot orphan the survivor.  A prefix being captured is
+    "pinned" structurally — its blocks are slot-referenced until the
+    capturing request retires.
+
+The index holds tokens hashes and block numbers only — no device
+memory — and dies with its engine, which is what makes model-reload
+invalidation automatic (the serving layer rebuilds the engine, and
+with it this manager, around every hot-swapped version).
 
 Single-writer by design: the engine's loop thread is the only caller
-of the mutating surface, so the class needs no lock of its own (the
-engine snapshots counters under its own lock for stats()).
+of the mutating surface, and the engine wraps every call in its own
+lock so ``available()``/gauge reads from the submit path are never
+torn.
 """
 
 from __future__ import annotations
 
 import hashlib
-from typing import Dict, List, Optional, Tuple
+from collections import OrderedDict
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -56,131 +83,252 @@ def _block_digests(tokens: np.ndarray, block: int,
     return out
 
 
-class PrefixIndex:
-    """Block-hashed prefix -> donor pool row map with LRU + pin
-    eviction.
+class _PrefixRecord:
+    """One published prefix: its digest chain and the physical blocks
+    (index i of ``blocks`` holds tokens [i*block, (i+1)*block))."""
+
+    __slots__ = ("digests", "blocks")
+
+    def __init__(self, digests: List[bytes], blocks: List[int]):
+        self.digests = digests
+        self.blocks = blocks
+
+
+class BlockManager:
+    """Paged-KV pool bookkeeping: refcounted physical blocks,
+    reservation accounting, and the prefix index (module docstring).
 
     Args:
-      rows: donor pool entries (device rows; ``--prefix_pool_blocks``).
-      block_tokens: hash/publish granularity — a prefix is cacheable
-        in multiples of this many tokens.
-      pool_len: cache columns per pool row; caps how much prefix one
-        donor can hold.
+      num_blocks: physical pool pages (``--kv_pool_blocks``).
+      block_tokens: cache positions per page — also the prefix
+        hash/share granularity (``--kv_block_tokens``).
+      caching: publish/lookup prefixes (False = pure allocator; the
+        engine's identity tests compare ON vs OFF).
     """
 
-    def __init__(self, rows: int, block_tokens: int, pool_len: int):
-        if rows < 1:
-            raise ValueError(f"rows must be >= 1, got {rows}")
+    def __init__(self, num_blocks: int, block_tokens: int,
+                 caching: bool = True):
+        if num_blocks < 1:
+            raise ValueError(
+                f"num_blocks must be >= 1, got {num_blocks}")
         if block_tokens < 1:
             raise ValueError(
                 f"block_tokens must be >= 1, got {block_tokens}")
-        self.rows = int(rows)
+        self.num_blocks = int(num_blocks)
         self.block = int(block_tokens)
-        self.pool_len = int(pool_len)
-        self._free: List[int] = list(range(self.rows))
-        # digest -> (row, cached columns); committed rows only.
-        self._chains: Dict[bytes, Tuple[int, int]] = {}
-        # row -> its published digests, in insertion order = LRU order
-        # (move-to-end on hit).
-        self._lru: Dict[int, List[bytes]] = {}
-        self._pinned: set = set()
-        self.evictions = 0
+        self.caching = bool(caching)
+        # Free LIFO (pop from the end -> low block ids first, which
+        # keeps tests deterministic and device pages warm).
+        self._free: List[int] = list(range(self.num_blocks - 1, -1, -1))
+        self._slot_ref = [0] * self.num_blocks
+        self._rec_ref = [0] * self.num_blocks
+        # Blocks with slot_ref == 0 and rec_ref > 0: resident cache
+        # pages reclaimable by eviction.  Maintained incrementally so
+        # available() is O(1).
+        self._cached_idle = 0
+        # Admission reservations not yet backed by a physical take().
+        self._reserved = 0
+        # digest -> (record, depth): lookup returns record.blocks[:depth].
+        self._chains: Dict[bytes, Tuple[_PrefixRecord, int]] = {}
+        # id(record) -> record, insertion order == LRU order.
+        self._lru: "OrderedDict[int, _PrefixRecord]" = OrderedDict()
+        self.evictions = 0        # prefix records evicted (LRU)
+        self.block_evictions = 0  # physical blocks freed by eviction
 
-    # -- lookup ------------------------------------------------------------
+    # -- capacity ----------------------------------------------------------
 
-    def lookup(self, tokens: np.ndarray,
-               limit: int) -> Tuple[Optional[int], int]:
-        """Longest published block-prefix of ``tokens`` covering at
-        most ``limit`` columns; returns (pool row, cached columns) or
-        (None, 0).  Callers pass ``limit = prompt_len - 1`` so at least
-        one prompt token is always recomputed — the KV pool caches
-        keys/values, not the logits the first sampled token needs."""
-        n_blocks = min(int(limit), self.pool_len) // self.block
-        if n_blocks <= 0 or not self._chains:
-            return None, 0
+    def available(self) -> int:
+        """Blocks an admission could still reserve: free pages plus
+        evictable cached pages, minus reservations already promised."""
+        return len(self._free) + self._cached_idle - self._reserved
+
+    def used_blocks(self) -> int:
+        """Pages resident (slot- or cache-held)."""
+        return self.num_blocks - len(self._free)
+
+    # -- admission ---------------------------------------------------------
+
+    def admit(self, tokens: np.ndarray, limit: int,
+              total_blocks: int) -> Optional[Tuple[List[int], int]]:
+        """Admission, atomically: find the longest cached block-prefix
+        of ``tokens`` covering at most ``limit`` positions, alias its
+        blocks (slot refs bumped), and reserve the remaining
+        ``total_blocks - shared`` private pages.  Returns
+        (shared_blocks, cached_tokens), or None when the pool cannot
+        currently cover the request (the engine leaves it queued;
+        retirement frees pages).  Callers pass ``limit = prompt_len -
+        1`` so at least one prompt token always recomputes — blocks
+        cache k/v, not the logits the first sampled token needs."""
+        shared, cached = self._lookup(tokens, limit)
+        private = max(0, int(total_blocks) - len(shared))
+        # Aliasing an idle cached page consumes an evictable page, so
+        # it must be covered by headroom exactly like a reservation —
+        # otherwise an earlier admission's reserve could become
+        # unsatisfiable (the invariant free + evictable >= reserved).
+        shared_idle = sum(1 for b in shared if self._slot_ref[b] == 0)
+        if (len(self._free) + self._cached_idle - self._reserved
+                < private + shared_idle):
+            return None
+        for b in shared:
+            if self._slot_ref[b] == 0:
+                self._cached_idle -= 1
+            self._slot_ref[b] += 1
+        self._reserved += private
+        return shared, cached
+
+    def take(self) -> int:
+        """One physical page from the caller's reservation (admission
+        guaranteed it — evicts LRU records if the free list is dry).
+        The returned block is exclusively owned (slot_ref 1, no record
+        refs): the caller is its only writer until release."""
+        if self._reserved <= 0:
+            raise RuntimeError(
+                "BlockManager.take() without a reservation — paged-KV "
+                "accounting bug")
+        while not self._free:
+            self._evict_lru()
+        self._reserved -= 1
+        b = self._free.pop()
+        self._slot_ref[b] = 1
+        return b
+
+    def release(self, blocks: Sequence[int], unreserve: int = 0) -> None:
+        """Drop one slot reference per block (retirement, expiry) and
+        return ``unreserve`` never-taken reserved pages.  Pages a
+        published record still advertises stay resident as evictable
+        cache; the rest free immediately."""
+        if unreserve:
+            self._reserved -= int(unreserve)
+            assert self._reserved >= 0, "reservation accounting broken"
+        for b in blocks:
+            b = int(b)
+            self._slot_ref[b] -= 1
+            assert self._slot_ref[b] >= 0, f"double release of block {b}"
+            if self._slot_ref[b] == 0:
+                if self._rec_ref[b] > 0:
+                    self._cached_idle += 1
+                else:
+                    self._free.append(b)
+
+    def rollback(self, blocks: Sequence[int]) -> None:
+        """Speculative rollback: return freshly written tail pages to
+        the pool AND restore the owner's reservation (it may regrow
+        over the same positions after the rejected window)."""
+        self.release(blocks)
+        self._reserved += len(blocks)
+
+    # -- prefix index ------------------------------------------------------
+
+    def _lookup(self, tokens: np.ndarray,
+                limit: int) -> Tuple[List[int], int]:
+        n_blocks = int(limit) // self.block
+        if not self.caching or n_blocks <= 0 or not self._chains:
+            return [], 0
         digests = _block_digests(tokens, self.block, n_blocks)
         for i in range(n_blocks, 0, -1):
-            hit = self._chains.get(digests[i - 1])
-            if hit is not None:
-                row, _ = hit
-                self._lru[row] = self._lru.pop(row)  # move to end
-                return row, i * self.block
-        return None, 0
+            ent = self._chains.get(digests[i - 1])
+            if ent is not None:
+                rec, _ = ent
+                self._lru.move_to_end(id(rec))
+                return list(rec.blocks[:i]), i * self.block
+        return [], 0
 
-    # -- capture lifecycle -------------------------------------------------
-
-    def begin_capture(self) -> Tuple[Optional[int], bool]:
-        """Claim (and pin) a pool row for a new donor capture; returns
-        (row, evicted_flag).  Evicts the least-recently-used committed
-        row when no free row exists; (None, False) when every row is
-        pinned by an active capture."""
-        evicted = False
-        if self._free:
-            row = self._free.pop()
-        else:
-            row = next((r for r in self._lru if r not in self._pinned),
-                       None)
-            if row is None:
-                return None, False
-            self._drop_row(row)
-            self.evictions += 1
-            evicted = True
-        self._pinned.add(row)
-        return row, evicted
-
-    def commit_capture(self, row: int, tokens: np.ndarray,
-                       true_len: int) -> int:
-        """Publish a filled capture: register one digest per FULL block
-        of real prompt the row now holds (partial trailing blocks carry
-        right-pad garbage and are never published).  Returns published
-        columns; a capture too short to publish is released instead."""
-        n_blocks = min(int(true_len), self.pool_len) // self.block
+    def publish(self, tokens: np.ndarray, true_len: int,
+                blocks: Sequence[int]) -> int:
+        """Register a completed prefill's full-block prefix: digest i
+        maps to ``blocks[i]``, which already holds the computed k/v —
+        publication is a refcount bump, never a copy.  Partial trailing
+        blocks carry positions the request keeps writing (decode) and
+        are never published.  First-writer-wins per digest.  Returns
+        newly published tokens (0 = fully covered already, too short,
+        or caching off)."""
+        if not self.caching:
+            return 0
+        n_blocks = min(int(true_len) // self.block, len(blocks))
         if n_blocks <= 0:
-            self.abort_capture(row)
             return 0
         digests = _block_digests(tokens, self.block, n_blocks)
+        if digests[-1] in self._chains:
+            return 0  # the full chain is already served
+        rec = _PrefixRecord(digests,
+                            [int(b) for b in blocks[:n_blocks]])
+        new_tokens = 0
         for i, d in enumerate(digests):
-            # FIRST-writer-wins on digest collisions between rows
-            # holding the same prefix (two misses racing to capture one
-            # hot prompt): the established row keeps serving the
-            # digest, so evicting the duplicate later cannot orphan it
-            # — eviction removes only digests still pointing at the
-            # evicted row.
-            self._chains.setdefault(d, (row, (i + 1) * self.block))
-        self._lru[row] = digests
-        self._pinned.discard(row)
-        return n_blocks * self.block
-
-    def abort_capture(self, row: int) -> None:
-        """Release a claimed row without publishing (expired or failed
-        admission): its partial writes are unreachable garbage and the
-        row returns to the free list."""
-        self._pinned.discard(row)
-        if row not in self._lru and row not in self._free:
-            self._free.append(row)
+            if d not in self._chains:
+                self._chains[d] = (rec, i + 1)
+                new_tokens += self.block
+        for b in rec.blocks:
+            # Publishing happens while the capturing slot still holds
+            # the pages (slot_ref >= 1), so no page transitions
+            # free/idle here.
+            self._rec_ref[b] += 1
+        self._lru[id(rec)] = rec
+        return new_tokens
 
     # -- maintenance -------------------------------------------------------
 
-    def _drop_row(self, row: int) -> None:
-        for d in self._lru.pop(row, ()):  # only digests still ours
-            if self._chains.get(d, (None,))[0] == row:
+    def _drop_record(self, rec: _PrefixRecord, count: bool) -> None:
+        for d in rec.digests:
+            ent = self._chains.get(d)
+            if ent is not None and ent[0] is rec:
                 del self._chains[d]
+        for b in rec.blocks:
+            self._rec_ref[b] -= 1
+            if self._rec_ref[b] == 0 and self._slot_ref[b] == 0:
+                self._cached_idle -= 1
+                self._free.append(b)
+                if count:
+                    self.block_evictions += 1
+
+    def _evict_lru(self) -> None:
+        if not self._lru:
+            raise RuntimeError(
+                "paged-KV pool accounting broken: take() with no free "
+                "and no evictable blocks")
+        _, rec = self._lru.popitem(last=False)
+        self.evictions += 1
+        self._drop_record(rec, count=True)
 
     def invalidate(self) -> None:
-        """Forget every cached prefix (model reload: the new version's
-        KV is numerically unrelated — serving stale prefixes would be
-        silent corruption, so the serving layer rebuilds engine + index
-        per version and close() calls this as a belt-and-braces)."""
-        self._chains.clear()
-        self._lru.clear()
-        self._pinned.clear()
-        self._free = list(range(self.rows))
+        """Forget every cached prefix (engine close / model reload: a
+        new version's KV is numerically unrelated, so serving a stale
+        prefix would be silent corruption).  Pages still aliased by
+        live slots stay resident until those slots release them."""
+        while self._lru:
+            _, rec = self._lru.popitem(last=False)
+            self._drop_record(rec, count=False)
 
     def stats(self) -> Dict[str, int]:
         return {
-            "rows": self.rows,
-            "committed_rows": len(self._lru),
-            "pinned_rows": len(self._pinned),
-            "published_blocks": len(self._chains),
+            "blocks": self.num_blocks,
+            "block_tokens": self.block,
+            "used_blocks": self.used_blocks(),
+            "free_blocks": len(self._free),
+            "cached_idle_blocks": self._cached_idle,
+            "reserved_blocks": self._reserved,
+            "published_records": len(self._lru),
+            "published_digests": len(self._chains),
             "evictions": self.evictions,
+            "block_evictions": self.block_evictions,
         }
+
+    def check_invariants(self) -> None:
+        """Debug/test hook: every structural invariant, or raise."""
+        assert self._reserved >= 0
+        free_set = set(self._free)
+        assert len(free_set) == len(self._free), "duplicate free block"
+        idle = 0
+        for b in range(self.num_blocks):
+            assert self._slot_ref[b] >= 0 and self._rec_ref[b] >= 0
+            held = self._slot_ref[b] > 0 or self._rec_ref[b] > 0
+            assert held != (b in free_set), (
+                f"block {b} ref/free disagreement")
+            if self._slot_ref[b] == 0 and self._rec_ref[b] > 0:
+                idle += 1
+        assert idle == self._cached_idle, (idle, self._cached_idle)
+        assert len(self._free) + self._cached_idle >= self._reserved, (
+            "reservation invariant violated")
+        for rec_id, rec in self._lru.items():
+            assert rec_id == id(rec)
+            for b in rec.blocks:
+                assert self._rec_ref[b] >= 1

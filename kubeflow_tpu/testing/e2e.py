@@ -99,14 +99,24 @@ def engine_smoke(namespace: str = "kubeflow-test") -> None:
     prompt) must register prefix-cache hits in
     ``kft_engine_prefix_hits_total`` and keep the max inter-token gap
     of in-flight slots under the chunk-budget bound (no full-prefill
-    stall spike).  Finally a speculative burst (--speculative_tokens
+    stall spike).  Then a speculative burst (--speculative_tokens
     rebuild, repetitive prompts the n-gram drafter can predict) must
     register accepted drafts in ``kft_engine_spec_accepted_total``,
-    report all four compiled programs over :stats, and produce
-    token-IDENTICAL output to a spec-OFF control rebuild."""
+    report all three compiled programs over :stats (chunked prefill,
+    step, verify — prefix reuse is zero-copy block aliasing, no copy
+    program exists), and produce token-IDENTICAL output to a spec-OFF
+    control rebuild.  Finally a block-exhaustion burst against a
+    deliberately tiny ``kv_pool_blocks`` pool: admission must shed
+    typed Overloaded (HTTP 429) while the pool is exhausted,
+    retirement must free blocks and restore admission (the queued
+    request completes), and the
+    ``kft_engine_kv_block_evictions_total`` /
+    ``kft_engine_kv_shed_no_blocks_total`` counters must move as
+    deltas over /metrics."""
     import json
     import tempfile
     import threading
+    import urllib.error
     import urllib.request
 
     import jax
@@ -139,7 +149,7 @@ def engine_smoke(namespace: str = "kubeflow-test") -> None:
             micro_batch_size=0, batch_timeout_s=0.005,
             lm_engine=True, lm_engine_slots=2,
             lm_engine_prefill_len=16, prefill_chunk_tokens=8,
-            prefix_pool_blocks=2, prefix_block_tokens=4))
+            kv_block_tokens=4))
         httpd, _ = make_http_server(server, port=0, host="127.0.0.1")
         try:
             port = httpd.server_address[1]
@@ -238,19 +248,19 @@ def engine_smoke(namespace: str = "kubeflow-test") -> None:
                 parsed, "kft_serving_cached_token_ratio") is not None
 
             # --- speculative burst: rebuild the batching plane with
-            # speculation on (fresh engine, fourth AOT program) and
+            # speculation on (fresh engine, third AOT program) and
             # drive repetitive prompts — tiled patterns whose greedy
             # continuations collapse into runs the n-gram drafter
             # predicts.  Speculation must ACCEPT drafts (counted in
             # kft_engine_spec_accepted_total) while staying token-
             # identical to a spec-OFF control rebuild.
-            def rebuild(spec_tokens):
+            def rebuild(spec_tokens, **extra):
                 server.enable_batching("lm", batcher_factory(
                     micro_batch_size=0, batch_timeout_s=0.005,
                     lm_engine=True, lm_engine_slots=2,
                     lm_engine_prefill_len=16, prefill_chunk_tokens=8,
-                    prefix_pool_blocks=2, prefix_block_tokens=4,
-                    speculative_tokens=spec_tokens))
+                    kv_block_tokens=4,
+                    speculative_tokens=spec_tokens, **extra))
 
             rebuild(4)
             # Pick burst prompts the DRAFTER itself would succeed on,
@@ -324,12 +334,14 @@ def engine_smoke(namespace: str = "kubeflow-test") -> None:
             assert stats["spec_accepted"] > 0, (
                 f"speculative burst accepted no drafts: {stats}")
             assert 0 < stats["spec_acceptance_rate"] <= 1
-            # The four-program guarantee, end to end over :stats —
+            # The three-program guarantee, end to end over :stats —
             # verify exists exactly once; a purely-drafted burst may
             # never need the plain step program, so it is 0 or 1.
+            # There is no copy_prefix key: prefix reuse is host-side
+            # block-table aliasing, not a device program.
             programs = stats["compiled_programs"]
-            assert set(programs) == {"chunked_prefill", "copy_prefix",
-                                     "step", "verify"}, programs
+            assert set(programs) == {"chunked_prefill", "step",
+                                     "verify"}, programs
             assert programs["verify"] == 1, programs
             assert programs["chunked_prefill"] == 1, programs
             with urllib.request.urlopen(
@@ -357,6 +369,96 @@ def engine_smoke(namespace: str = "kubeflow-test") -> None:
                 stats = json.loads(resp.read())["batcher"]
             assert stats["spec_drafted"] == 0
             assert stats["compiled_programs"]["verify"] == 0
+            assert set(stats["compiled_programs"]) \
+                == {"chunked_prefill", "step", "verify"}
+
+            # --- block-exhaustion burst: a deliberately tiny pool (8
+            # pages of 4 tokens against 12-token prompts + 16-token
+            # budgets = 7 reserved pages per request, so exactly ONE
+            # request fits) and a queue cap of 1.  8 simultaneous
+            # clients: one admits, one queues, the rest MUST shed 429
+            # Overloaded while the pool is exhausted — and every
+            # accepted request must still complete, because
+            # retirement frees its pages and re-opens admission for
+            # the queued one (tokens-resident admission never
+            # deadlocks a mid-flight slot).
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics",
+                    timeout=30) as resp:
+                parsed = parse_metrics(resp.read().decode())
+            shed_before = sample_value(
+                parsed, "kft_engine_kv_shed_no_blocks_total",
+                engine="lm-v1") or 0
+            evict_before = sample_value(
+                parsed, "kft_engine_kv_block_evictions_total",
+                engine="lm-v1") or 0
+            rebuild(0, kv_pool_blocks=8, max_queue_depth=1)
+            burst = [rng.randint(1, 128, size=(12,)).tolist()
+                     for _ in range(8)]
+            outs.clear()
+            codes: dict = {}
+
+            def burst_client(i, prompt):
+                try:
+                    client(i, prompt)
+                    codes[i] = 200
+                except urllib.error.HTTPError as err:
+                    codes[i] = err.code
+                    err.read()
+
+            threads = [threading.Thread(target=burst_client,
+                                        args=(i, p))
+                       for i, p in enumerate(burst)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            ok = [i for i, c in codes.items() if c == 200]
+            shed = [i for i, c in codes.items() if c == 429]
+            assert codes and set(codes.values()) <= {200, 429}, codes
+            assert ok, f"exhaustion burst completed nothing: {codes}"
+            assert shed, (
+                f"pool exhaustion shed nothing (want 429s): {codes}")
+            for i in ok:
+                tokens = outs[i]["predictions"][0]["tokens"]
+                assert tokens[:len(burst[i])] == burst[i]
+                assert len(tokens) == len(burst[i]) + max_new
+            # Admission restored after the burst drains: a fresh
+            # request must be served, not shed.
+            client("post", burst[0])
+            assert len(outs["post"]["predictions"][0]["tokens"]) \
+                == len(burst[0]) + max_new
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/model/lm:stats",
+                    timeout=30) as resp:
+                stats = json.loads(resp.read())["batcher"]
+            assert stats["kv_shed_no_blocks"] >= len(shed), stats
+            assert stats["kv_blocks"] == 8
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics",
+                    timeout=30) as resp:
+                parsed = parse_metrics(resp.read().decode())
+            shed_after = sample_value(
+                parsed, "kft_engine_kv_shed_no_blocks_total",
+                engine="lm-v1") or 0
+            evict_after = sample_value(
+                parsed, "kft_engine_kv_block_evictions_total",
+                engine="lm-v1") or 0
+            # The pool gauges are live: capacity == the rebuilt
+            # engine's 8 pages, and the published prefix pages of the
+            # drained burst are still resident (scrape-visible — the
+            # loop refreshes the used gauge, not just close()).
+            assert sample_value(parsed, "kft_engine_kv_blocks",
+                                engine="lm-v1") == 8
+            assert (sample_value(parsed, "kft_engine_kv_blocks_used",
+                                 engine="lm-v1") or 0) > 0
+            assert shed_after - shed_before >= len(shed), (
+                shed_before, shed_after, codes)
+            # Successive distinct prompts through an 8-page pool force
+            # LRU eviction of published prefix pages — the eviction
+            # counter must move.
+            assert evict_after > evict_before, (
+                evict_before, evict_after)
         finally:
             httpd.shutdown()
             server.stop()

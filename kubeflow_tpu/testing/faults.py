@@ -13,6 +13,11 @@ Hook sites planted in production code (grep for ``faults.fire``):
     engine.step       before each DecodeEngine step-program call
                       (sleep = slow/wedged step, raise = device death)
     engine.admit      before each prefill admission call
+    engine.alloc_block before paged-KV pages are taken from a slot's
+                      admission reservation as its frontier grows
+                      (sleep = slow allocator under pool pressure,
+                      raise = allocation failure — engine death at
+                      the growth site, every waiter resolved)
     batcher.dispatch  MicroBatcher batch dispatch (sleep = queue stall)
     loader.load       ModelServer.reload before load_version
                       (raise = corrupt checkpoint directory)

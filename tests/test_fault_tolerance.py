@@ -439,6 +439,32 @@ class TestEngineOverload:
             finally:
                 engine.close()
 
+    def test_alloc_block_fault_aborts_cleanly(self, engine_model):
+        """The paged-KV allocator's hook site (engine.alloc_block,
+        fired when pages are taken from an admission's reservation):
+        an injected raise is a device-allocation death — the loop
+        aborts, the waiting client gets the error (never a hang), and
+        the closed engine refuses new work."""
+        from kubeflow_tpu.serving.engine import DecodeEngine
+        from kubeflow_tpu.serving.errors import BatcherClosed
+
+        spec, _ = engine_model
+        with faults.injected("seed=1;engine.alloc_block:raise") as inj:
+            engine = DecodeEngine(spec["cfg"], spec["params"],
+                                  spec["decode"], slots=1,
+                                  prefill_len=16, name="ft-alloc")
+            try:
+                with pytest.raises(Exception) as err:
+                    engine.submit(
+                        {"tokens": np.arange(1, 6, dtype=np.int32)})
+                assert "injected fault" in str(err.value)
+                assert inj.fired("engine.alloc_block") >= 1
+                with pytest.raises(BatcherClosed):
+                    engine.submit(
+                        {"tokens": np.arange(1, 6, dtype=np.int32)})
+            finally:
+                engine.close()
+
 
 class TestServerInflightCap:
     def test_direct_path_bounded_by_max_inflight(self):

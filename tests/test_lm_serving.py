@@ -160,9 +160,10 @@ class TestDecodeEngine:
     """Continuous-batching engine (serving/engine.py): generations must
     be token-identical to single-request generate(), across mixed
     prompt lengths, per-request budgets, and slot reuse — while
-    compiling exactly three device programs for the whole workload
-    (the fourth, speculative verify, only exists under
-    ``speculative_tokens`` — see TestSpeculativeDecoding)."""
+    compiling exactly two device programs for the whole workload
+    (the third, speculative verify, only exists under
+    ``speculative_tokens`` — see TestSpeculativeDecoding; prefix reuse
+    is zero-copy block-table aliasing, never a device program)."""
 
     def test_matches_generate_mixed_lengths_slot_reuse_three_programs(
             self, engine_model, monkeypatch):
@@ -171,10 +172,8 @@ class TestDecodeEngine:
         from kubeflow_tpu.models import generate as gen_mod
         from kubeflow_tpu.serving.engine import DecodeEngine
 
-        compiles = {"chunked_prefill": 0, "copy_prefix": 0, "step": 0,
-                    "verify": 0}
+        compiles = {"chunked_prefill": 0, "step": 0, "verify": 0}
         for attr, key in (("prefill_chunk_into_slot", "chunked_prefill"),
-                          ("copy_prefix_into_slot", "copy_prefix"),
                           ("decode_step", "step"),
                           ("verify_step", "verify")):
             monkeypatch.setattr(gen_mod, attr, _counting_proxy(
@@ -187,8 +186,8 @@ class TestDecodeEngine:
         # and budgets span 3..NEW_TOKENS.  (4 distinct lengths: each
         # distinct length costs one reference generate() compile.)
         # chunk width 8 < the longest prompts, so multi-chunk prefill
-        # resumption is exercised; the prefix pool is ON with a small
-        # block so repeated short prefixes can hit.
+        # resumption is exercised; prefix caching is ON with a small
+        # page so repeated short prefixes can alias.
         lens = [3, 9, 16, 2, 9, 16, 3, 16, 2]
         news = [12, 6, 3, 8, 12, 4, 10, 5, 12]
         prompts = [rng.randint(1, VOCAB, size=(n,)).tolist()
@@ -196,8 +195,7 @@ class TestDecodeEngine:
         engine = DecodeEngine(spec["cfg"], spec["params"],
                               spec["decode"], slots=3, prefill_len=16,
                               admit_width=2, prefill_chunk_tokens=8,
-                              prefix_pool_blocks=2,
-                              prefix_block_tokens=4, name="test-equiv")
+                              kv_block_tokens=4, name="test-equiv")
         try:
             outs = [None] * len(prompts)
 
@@ -227,13 +225,13 @@ class TestDecodeEngine:
         finally:
             engine.close()
         # The whole mixed workload — admission waves, slot reuse,
-        # varying budgets, multi-chunk prefills, prefix-pool copies —
-        # compiled exactly three programs (no speculative verify: this
-        # engine runs with speculation off).
-        three = {"chunked_prefill": 1, "copy_prefix": 1, "step": 1,
-                 "verify": 0}
-        assert compiles == three
-        assert engine.compiled_programs() == three
+        # varying budgets, multi-chunk prefills, zero-copy prefix
+        # aliasing — compiled exactly two programs (no speculative
+        # verify: this engine runs with speculation off; no prefix
+        # copy program EXISTS — a cache hit is a block-table edit).
+        two = {"chunked_prefill": 1, "step": 1, "verify": 0}
+        assert compiles == two
+        assert engine.compiled_programs() == two
 
     def test_eos_retirement_matches_generate(self, engine_model):
         """With EOS configured, a slot frozen by the device `done` flag
@@ -329,12 +327,13 @@ class TestDecodeEngine:
 
     def test_prefix_cache_identity_on_off_with_eviction(
             self, engine_model):
-        """Shared-prefix KV reuse must be invisible in the tokens:
+        """Shared-prefix aliasing must be invisible in the tokens:
         engine output with the prefix cache ON equals single-request
-        generate() equals cache OFF — including a donor eviction forced
-        MID-STREAM (pool of one row, a second prefix family arriving
-        while the first family's requests are still in flight) and slot
-        reuse after retirement (8 requests through 2 slots)."""
+        generate() equals cache OFF — including LRU eviction forced
+        MID-STREAM (a deliberately tight block pool contended by two
+        prefix families over 2 slots) and slot reuse after retirement
+        (8 requests through 2 slots).  The paged pool must drain
+        COMPLETELY on close: no block leaks, no dangling refcounts."""
         import threading
 
         from kubeflow_tpu.serving.engine import DecodeEngine
@@ -351,12 +350,18 @@ class TestDecodeEngine:
         news = [6, 9, 5, 12, 8, 4, 10, 7]
         want = _reference_rows(spec, prompts, news)
 
-        def run(pool_blocks):
+        def run(caching):
+            # 10 pages of 4 tokens: a 13-token prompt + 12-budget
+            # worst case reserves 7, so two co-resident requests
+            # exceed the pool unless retired pages recycle — cached
+            # records get LRU-evicted under allocation pressure while
+            # later same-family requests still hit.
             engine = DecodeEngine(
                 spec["cfg"], spec["params"], spec["decode"], slots=2,
                 prefill_len=16, prefill_chunk_tokens=4,
-                prefix_pool_blocks=pool_blocks, prefix_block_tokens=4,
-                name=f"test-prefix-{pool_blocks}")
+                kv_block_tokens=4, kv_pool_blocks=10,
+                prefix_caching=caching,
+                name=f"test-prefix-{int(caching)}")
             try:
                 outs = [None] * len(prompts)
 
@@ -371,26 +376,165 @@ class TestDecodeEngine:
                     t.start()
                 for t in threads:
                     t.join()
-                return outs, engine.stats()
+                engine._mgr.check_invariants()
+                return outs, engine.stats(), engine
             finally:
                 engine.close()
 
-        on_outs, on_stats = run(pool_blocks=1)
-        off_outs, off_stats = run(pool_blocks=0)
+        on_outs, on_stats, on_engine = run(caching=True)
+        off_outs, off_stats, off_engine = run(caching=False)
         for i in range(len(prompts)):
             got_on = np.asarray(on_outs[i]["tokens"])[0].tolist()
             got_off = np.asarray(off_outs[i]["tokens"])[0].tolist()
             assert got_on == want[i], f"cache ON drifted on request {i}"
             assert got_off == want[i], f"cache OFF drifted on request {i}"
-        # The single donor row really was contended: both families
-        # admitted, so at least one eviction happened, and at least one
-        # later same-family request still hit.
+        # The pool really was contended: both families admitted, so
+        # cached pages were reclaimed (record + block eviction
+        # counters moved), and at least one later same-family request
+        # still hit.
         assert on_stats["prefix_hits"] >= 1
         assert on_stats["prefix_evictions"] >= 1
+        assert on_stats["kv_block_evictions"] >= 1
         assert on_stats["cached_prompt_tokens"] >= 8
         assert 0 < on_stats["cached_token_ratio"] < 1
         assert off_stats["prefix_hits"] == 0
         assert off_stats["cached_token_ratio"] == 0.0
+        assert off_stats["kv_blocks_used"] == 0  # nothing cached
+        # Everything returned to both pools after close().
+        assert on_engine._mgr.used_blocks() == 0
+        assert off_engine._mgr.used_blocks() == 0
+
+    def test_shared_prefix_zero_copy_aliasing_identity(
+            self, engine_model):
+        """Two requests sharing a block-aligned prefix must produce
+        bit-identical tokens to unshared runs while the engine copies
+        ZERO prefix tokens: the hit is a refcounted block-table alias
+        of the pages the first prefill wrote — the sharer's table
+        leads with the SAME physical block ids the published record
+        advertises, and no copy program exists to run."""
+        from kubeflow_tpu.serving.engine import DecodeEngine
+
+        spec, _ = engine_model
+        rng = np.random.RandomState(SEED + 13)
+        common = rng.randint(1, VOCAB, size=(8,)).tolist()
+        p1 = common + rng.randint(1, VOCAB, size=(4,)).tolist()
+        p2 = common + rng.randint(1, VOCAB, size=(6,)).tolist()
+        want = _reference_rows(spec, [p1, p2], [6, 6])
+        engine = DecodeEngine(
+            spec["cfg"], spec["params"], spec["decode"], slots=2,
+            prefill_len=16, prefill_chunk_tokens=8, kv_block_tokens=4,
+            name="test-zero-copy")
+        try:
+            o1 = engine.submit({"tokens": np.asarray(p1, np.int32),
+                                "max_new_tokens": 6})
+            # The published record's physical pages (the prefix's k/v,
+            # written once by p1's prefill).
+            with engine._lock:
+                recs = list(engine._mgr._lru.values())
+            assert recs, "p1's prefill published no prefix record"
+            published = list(recs[0].blocks)
+            o2 = engine.submit({"tokens": np.asarray(p2, np.int32),
+                                "max_new_tokens": 6,
+                                "return_timing": True})
+            assert np.asarray(o1["tokens"])[0].tolist() == want[0]
+            assert np.asarray(o2["tokens"])[0].tolist() == want[1], (
+                "shared-prefix resume drifted from the unshared run")
+            stats = engine.stats()
+            # The full 8-token (2-page) prefix was served by aliasing:
+            # cached tokens counted, zero device copies possible —
+            # there is no copy program in the compiled set at all.
+            assert o2["cached_tokens"] == 8
+            assert stats["prefix_hits"] == 1
+            assert stats["cached_prompt_tokens"] == 8
+            assert set(stats["compiled_programs"]) == {
+                "chunked_prefill", "step", "verify"}
+            # White-box: the alias really is the SAME physical pages —
+            # p2's own published record leads with p1's block ids (its
+            # prefill never wrote new pages for the shared prefix; a
+            # copy would have needed fresh ones).
+            with engine._lock:
+                recs = list(engine._mgr._lru.values())
+            assert any(r.blocks[:2] == published[:2]
+                       and len(r.blocks) > 2 for r in recs), (
+                "sharer's record does not alias the donor's pages")
+            engine._mgr.check_invariants()
+        finally:
+            engine.close()
+        assert engine._mgr.used_blocks() == 0
+
+    def test_int8_kv_rides_the_paged_pool(self, engine_model):
+        """The unified KV store is ONE block pool for fp and int8
+        QTensor caches alike: with kv_cache_dtype='int8' the engine
+        must stay token-identical to int8 generate() — including a
+        zero-copy prefix hit, whose aliased pages hold k/v the donor
+        quantized (same tokens at same positions quantize identically,
+        so aliasing is exact)."""
+        import dataclasses
+
+        from kubeflow_tpu.models.generate import generate
+        from kubeflow_tpu.serving.engine import DecodeEngine
+
+        spec, _ = engine_model
+        decode = dataclasses.replace(spec["decode"],
+                                     kv_cache_dtype="int8")
+        rng = np.random.RandomState(SEED + 31)
+        common = rng.randint(1, VOCAB, size=(8,)).tolist()
+        prompts = [common + rng.randint(1, VOCAB, size=(n,)).tolist()
+                   for n in (4, 6)] \
+            + [rng.randint(1, VOCAB, size=(9,)).tolist()]
+        engine = DecodeEngine(
+            spec["cfg"], spec["params"], decode, slots=2,
+            prefill_len=16, prefill_chunk_tokens=8, kv_block_tokens=4,
+            name="test-int8-paged")
+        try:
+            for p in prompts:
+                out = engine.submit({"tokens": np.asarray(p, np.int32)})
+                ref, _ = generate(spec["cfg"], spec["params"],
+                                  np.asarray(p, np.int32)[None], decode)
+                assert np.asarray(out["tokens"])[0].tolist() \
+                    == np.asarray(ref)[0].tolist(), (
+                    "int8 paged engine drifted from int8 generate()")
+            assert engine.stats()["prefix_hits"] == 1
+            engine._mgr.check_invariants()
+        finally:
+            engine.close()
+        assert engine._mgr.used_blocks() == 0
+
+    def test_pool_exhaustion_sheds_typed_overloaded(self, engine_model):
+        """A request whose worst-case page count can never fit the
+        pool sheds typed Overloaded AT SUBMIT (429, kv-attributed in
+        stats) instead of queueing forever; a fitting request on the
+        same engine still serves (admission reserves worst case, so a
+        mid-flight slot can never deadlock on pages)."""
+        from kubeflow_tpu.serving.engine import DecodeEngine
+        from kubeflow_tpu.serving.errors import Overloaded
+
+        spec, _ = engine_model
+        engine = DecodeEngine(
+            spec["cfg"], spec["params"], spec["decode"], slots=2,
+            prefill_len=16, kv_block_tokens=4, kv_pool_blocks=3,
+            name="test-exhaust")
+        try:
+            # 12 prompt + 12 budget = 6 pages > the 3-page pool.
+            with pytest.raises(Overloaded):
+                engine.submit({
+                    "tokens": np.arange(1, 13, dtype=np.int32)})
+            stats = engine.stats()
+            assert stats["shed"] == 1
+            assert stats["kv_shed_no_blocks"] == 1
+            assert stats["kv_blocks"] == 3
+            # 2 prompt + 4 budget = 2 pages: fits, serves.
+            out = engine.submit({
+                "tokens": np.asarray([3, 4], np.int32),
+                "max_new_tokens": 4})
+            assert np.asarray(out["tokens"]).shape == (1, 6)
+            stats = engine.stats()
+            assert stats["requests"] == 1
+            assert stats["tokens_resident"] \
+                == stats["kv_blocks_used"] * 4
+            assert 0 <= stats["kv_utilization"] <= 1
+        finally:
+            engine.close()
 
     def test_prefix_cache_invalidated_on_model_reload(self,
                                                       engine_model):
@@ -405,8 +549,7 @@ class TestDecodeEngine:
         factory = batcher_factory(
             micro_batch_size=0, batch_timeout_s=0.005, lm_engine=True,
             lm_engine_slots=2, lm_engine_prefill_len=16,
-            prefill_chunk_tokens=8, prefix_pool_blocks=2,
-            prefix_block_tokens=4)
+            prefill_chunk_tokens=8, kv_block_tokens=4)
         prompt = _prompt()
         want = _reference_rows(spec, [prompt], [NEW_TOKENS])[0]
         try:
@@ -469,13 +612,15 @@ class TestDecodeEngine:
 
     def test_final_chunk_near_cache_end_stays_in_bounds(
             self, engine_model):
-        """A cached-prefix resume whose final chunk window would run
-        past the slot's max_len must not corrupt the cache: XLA's
-        dynamic_update_slice CLAMPS an out-of-bounds start (shifting
-        the whole chunk onto earlier valid columns), so the engine
-        pulls the final chunk's start back and recomputes the overlap
-        instead.  Geometry: prefill_len=16, max_len=18, chunk 8, a
-        12-column cached prefix -> naive window [12, 20) > 18."""
+        """A cached-prefix resume whose final chunk window runs past
+        the slot's max_len must not corrupt the cache: the paged
+        scatter parks positions beyond the block table's real pages on
+        the sentinel and DROPS them (they sit beyond every frontier
+        the slot can reach), so overhang costs nothing — unlike the
+        old contiguous layout, where XLA's dynamic_update_slice would
+        CLAMP the out-of-bounds start and shift the chunk onto earlier
+        valid columns.  Geometry: prefill_len=16, max_len=18, chunk 8,
+        a 12-column cached prefix -> naive window [12, 20) > 18."""
         from kubeflow_tpu.serving.engine import DecodeEngine
 
         spec, _ = engine_model
@@ -485,8 +630,7 @@ class TestDecodeEngine:
         engine = DecodeEngine(
             spec["cfg"], spec["params"], spec["decode"], slots=1,
             prefill_len=16, max_len=18, prefill_chunk_tokens=8,
-            prefix_pool_blocks=1, prefix_block_tokens=4,
-            name="test-chunk-bounds")
+            kv_block_tokens=4, name="test-chunk-bounds")
         try:
             for i in range(2):  # second run resumes from 12 cached cols
                 out = engine.submit({
@@ -615,8 +759,7 @@ class TestDecodeEngine:
                                        on_tpu=False)
         detail = record["detail"]
         assert detail["compiled_programs"] == {
-            "chunked_prefill": 1, "copy_prefix": 1, "step": 1,
-            "verify": 0}
+            "chunked_prefill": 1, "step": 1, "verify": 0}
         assert detail["engine_vs_batcher"] > 1.0, (
             f"engine {detail['engine_tokens_per_sec']} tok/s did not "
             f"beat static batcher {detail['batcher_tokens_per_sec']} "
@@ -659,7 +802,7 @@ class TestSpeculativeDecoding:
         engine = DecodeEngine(
             spec["cfg"], spec["params"], decode or spec["decode"],
             slots=slots, prefill_len=16, prefill_chunk_tokens=8,
-            prefix_pool_blocks=2, prefix_block_tokens=4,
+            kv_block_tokens=4,
             speculative_tokens=speculative_tokens,
             name=f"{name}-{speculative_tokens}")
         try:
@@ -680,12 +823,12 @@ class TestSpeculativeDecoding:
         finally:
             engine.close()
 
-    def test_spec_on_equals_spec_off_equals_generate_four_programs(
+    def test_spec_on_equals_spec_off_equals_generate_three_programs(
             self, engine_model, monkeypatch):
         """The tentpole identity: a mixed repetitive/random workload
         with slot reuse is token-identical across spec ON, spec OFF,
         and generate(), real draft acceptance happened, and the spec-ON
-        engine compiled exactly the four programs."""
+        engine compiled exactly the three programs."""
         import kubeflow_tpu.serving.engine as eng_mod
 
         from kubeflow_tpu.models import generate as gen_mod
@@ -698,10 +841,8 @@ class TestSpeculativeDecoding:
         # here, and it must hold regardless of gating.
         monkeypatch.setattr(eng_mod, "_SPEC_RATE_MARGIN", 0.0)
 
-        compiles = {"chunked_prefill": 0, "copy_prefix": 0, "step": 0,
-                    "verify": 0}
+        compiles = {"chunked_prefill": 0, "step": 0, "verify": 0}
         for attr, key in (("prefill_chunk_into_slot", "chunked_prefill"),
-                          ("copy_prefix_into_slot", "copy_prefix"),
                           ("decode_step", "step"),
                           ("verify_step", "verify")):
             monkeypatch.setattr(gen_mod, attr, _counting_proxy(
@@ -729,14 +870,13 @@ class TestSpeculativeDecoding:
         assert on_stats["spec_steps"] > 0
         assert off_stats["spec_drafted"] == 0
         assert off_stats["spec_steps"] == 0
-        # Four programs, each compiled once across BOTH engines (the
-        # spec-OFF engine reuses three of the same .lower sites and
+        # Three programs, each compiled once across BOTH engines (the
+        # spec-OFF engine reuses two of the same .lower sites and
         # never lowers verify).
-        assert compiles == {"chunked_prefill": 2, "copy_prefix": 2,
-                            "step": 2, "verify": 1}
+        assert compiles == {"chunked_prefill": 2, "step": 2,
+                            "verify": 1}
         assert on_stats["compiled_programs"] == {
-            "chunked_prefill": 1, "copy_prefix": 1, "step": 1,
-            "verify": 1}
+            "chunked_prefill": 1, "step": 1, "verify": 1}
         assert off_stats["compiled_programs"]["verify"] == 0
 
     def test_forced_full_rejection_rollback_and_slot_reuse(

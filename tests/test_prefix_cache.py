@@ -1,114 +1,290 @@
-"""Host-side prefix index (serving/prefix_cache.py): block-hashed
-longest-prefix lookup, LRU + refcount eviction, and the invariants the
-DecodeEngine's shared-prefix reuse leans on."""
+"""Paged-KV block manager (serving/prefix_cache.py): refcounted
+physical allocation, token-reservation admission, block-hashed
+zero-copy prefix aliasing, LRU eviction — and a randomized invariant
+battery over a seeded mixed workload (the allocator must never
+double-free, never alias a page to two diverged writers, and free
+everything on release+invalidate)."""
 
 import numpy as np
 import pytest
 
-from kubeflow_tpu.serving.prefix_cache import PrefixIndex
+from kubeflow_tpu.serving.prefix_cache import BlockManager
 
 
 def toks(*vals):
     return np.asarray(vals, np.int32)
 
 
-class TestPrefixIndex:
-    def test_longest_block_prefix_match(self):
-        idx = PrefixIndex(rows=2, block_tokens=2, pool_len=8)
-        row, evicted = idx.begin_capture()
-        assert (row, evicted) == (0 if row == 0 else row, False)
-        published = idx.commit_capture(row, toks(1, 2, 3, 4, 5, 6), 6)
-        assert published == 6  # three full blocks
-        # Full three-block match, capped by limit.
-        assert idx.lookup(toks(1, 2, 3, 4, 5, 6, 7), limit=6) == (row, 6)
-        # limit forces at least one recomputed token: only 2 blocks fit.
-        assert idx.lookup(toks(1, 2, 3, 4, 5, 6), limit=5) == (row, 4)
-        # Divergence after one block matches one block.
-        assert idx.lookup(toks(1, 2, 9, 9, 9, 9), limit=6) == (row, 2)
-        # Different first block: no match (chained digests — a shared
-        # MIDDLE block must not match).
-        assert idx.lookup(toks(9, 2, 3, 4), limit=4) == (None, 0)
-        # Sub-block prefixes can't match.
-        assert idx.lookup(toks(1, 2), limit=1) == (None, 0)
+def run_request(mgr, tokens, budget):
+    """One request's whole pool lifecycle, the way the engine drives
+    it: admit (alias + reserve worst case), take every reserved page,
+    publish the full-block prefix, release.  Returns (blocks, cached,
+    res) with the pages still HELD (caller releases)."""
+    need = -(-(len(tokens) + budget) // mgr.block)
+    plan = mgr.admit(np.asarray(tokens, np.int32), len(tokens) - 1, need)
+    if plan is None:
+        return None
+    shared, cached = plan
+    blocks = list(shared)
+    res = need - len(shared)
+    while len(blocks) < need:
+        blocks.append(mgr.take())
+        res -= 1
+    mgr.publish(np.asarray(tokens, np.int32), len(tokens), blocks)
+    return blocks, cached, res
+
+
+class TestBlockManager:
+    def test_admit_reserve_take_release_roundtrip(self):
+        mgr = BlockManager(num_blocks=8, block_tokens=2)
+        plan = mgr.admit(toks(1, 2, 3, 4), 3, 4)
+        assert plan == ([], 0)  # cold: no alias, 4 reserved
+        assert mgr.available() == 4
+        blocks = [mgr.take() for _ in range(4)]
+        assert len(set(blocks)) == 4
+        assert mgr.used_blocks() == 4
+        mgr.release(blocks)
+        assert mgr.used_blocks() == 0
+        assert mgr.available() == 8
+        mgr.check_invariants()
+
+    def test_take_without_reservation_is_a_bug(self):
+        mgr = BlockManager(num_blocks=2, block_tokens=2)
+        with pytest.raises(RuntimeError):
+            mgr.take()
+
+    def test_admission_refused_when_pool_cannot_cover(self):
+        mgr = BlockManager(num_blocks=4, block_tokens=2)
+        assert mgr.admit(toks(1, 2), 1, 3) is not None
+        # 1 block of headroom left; a 2-block request must hold.
+        assert mgr.admit(toks(3, 4), 1, 2) is None
+        # ... until the first request unreserves.
+        mgr.release([], unreserve=3)
+        assert mgr.admit(toks(3, 4), 1, 2) is not None
+        mgr.check_invariants()
+
+    def test_longest_block_prefix_aliases_zero_copy(self):
+        mgr = BlockManager(num_blocks=16, block_tokens=2)
+        out = run_request(mgr, [1, 2, 3, 4, 5, 6], 2)
+        blocks, cached, res = out
+        assert cached == 0
+        # Full three-block prefix published; a sharer aliases the SAME
+        # physical pages (zero-copy is literal: identical block ids).
+        plan = mgr.admit(toks(1, 2, 3, 4, 5, 6, 7), 6, 4)
+        shared, cached2 = plan
+        assert cached2 == 6 and shared == blocks[:3]
+        # limit forces >= 1 recomputed token: only 2 blocks match.
+        plan = mgr.admit(toks(1, 2, 3, 4, 5, 6), 5, 3)
+        assert plan[1] == 4 and plan[0] == blocks[:2]
+        # Divergence after one block aliases one block (chained
+        # digests: a shared MIDDLE block never matches alone).
+        plan = mgr.admit(toks(1, 2, 9, 9), 3, 2)
+        assert plan[1] == 2 and plan[0] == blocks[:1]
+        plan = mgr.admit(toks(9, 2, 3, 4), 3, 2)
+        assert plan == ([], 0)
+        mgr.check_invariants()
 
     def test_partial_trailing_block_never_published(self):
-        idx = PrefixIndex(rows=1, block_tokens=4, pool_len=16)
-        row, _ = idx.begin_capture()
-        assert idx.commit_capture(row, toks(*range(1, 7)), 6) == 4
-        assert idx.lookup(toks(*range(1, 9)), limit=7) == (row, 4)
+        mgr = BlockManager(num_blocks=8, block_tokens=4)
+        run_request(mgr, [1, 2, 3, 4, 5, 6], 2)
+        plan = mgr.admit(toks(1, 2, 3, 4, 5, 6, 7, 8), 7, 2)
+        assert plan[1] == 4  # only the full block matched
 
-    def test_lru_eviction_prefers_least_recently_used(self):
-        idx = PrefixIndex(rows=2, block_tokens=2, pool_len=4)
-        a, _ = idx.begin_capture()
-        idx.commit_capture(a, toks(1, 1), 2)
-        b, _ = idx.begin_capture()
-        idx.commit_capture(b, toks(2, 2), 2)
-        # Touch A so B becomes LRU.
-        assert idx.lookup(toks(1, 1, 3), limit=2) == (a, 2)
-        c, evicted = idx.begin_capture()
-        assert evicted and c == b
-        idx.commit_capture(c, toks(3, 3), 2)
-        assert idx.evictions == 1
-        assert idx.lookup(toks(2, 2, 9), limit=2) == (None, 0)  # gone
-        assert idx.lookup(toks(1, 1, 9), limit=2) == (a, 2)     # kept
+    def test_aliased_pages_survive_writer_release(self):
+        """The capturing request retires while a sharer still aliases
+        the pages: they must stay resident (refcount), and free only
+        when BOTH the sharer and the record let go."""
+        mgr = BlockManager(num_blocks=4, block_tokens=2)
+        blocks, _, res = run_request(mgr, [1, 2, 3, 4], 0)
+        shared, cached = mgr.admit(toks(1, 2, 3, 4), 3, 2)
+        assert cached == 2 and shared == blocks[:1]
+        mgr.release(blocks, unreserve=res)  # writer gone
+        mgr.check_invariants()
+        # The aliased page is still resident (sharer + record hold it).
+        assert shared[0] not in mgr._free
+        mgr.release(shared, unreserve=2 - len(shared))
+        mgr.check_invariants()
+        # Record-held pages remain as evictable cache, not leaked.
+        assert mgr.used_blocks() == 2  # the two published pages
+        mgr.invalidate()
+        assert mgr.used_blocks() == 0
 
-    def test_pinned_rows_never_evicted(self):
-        idx = PrefixIndex(rows=1, block_tokens=2, pool_len=4)
-        row, _ = idx.begin_capture()
-        # Mid-capture (pinned, uncommitted): the only row is pinned, so
-        # a second capture must be refused, not steal it.
-        assert idx.begin_capture() == (None, False)
-        idx.commit_capture(row, toks(5, 5), 2)
-        # Committed rows are unpinned and evictable again.
-        row2, evicted = idx.begin_capture()
-        assert row2 == row and evicted
+    def test_lru_eviction_frees_only_unreferenced(self):
+        mgr = BlockManager(num_blocks=4, block_tokens=2)
+        a, _, ra = run_request(mgr, [1, 1, 1, 1], 0)
+        mgr.release(a, unreserve=ra)
+        b, _, rb = run_request(mgr, [2, 2, 2, 2], 0)
+        mgr.release(b, unreserve=rb)
+        # Pool full of cached pages; a fresh 2-block request must evict
+        # the LRU record (a's) — b's stays.
+        plan = mgr.admit(toks(3, 3, 3, 3), 3, 2)
+        assert plan == ([], 0)
+        c = [mgr.take(), mgr.take()]
+        assert mgr.evictions == 1 and mgr.block_evictions == 2
+        assert set(c) == set(a)  # a's pages were recycled
+        assert mgr.admit(toks(1, 1, 1, 1), 3, 0) == ([], 0)  # a gone
+        plan = mgr.admit(toks(2, 2, 2, 2), 3, 2)
+        assert plan[1] == 2  # b still served
+        mgr.check_invariants()
 
-    def test_abort_returns_row_without_publishing(self):
-        idx = PrefixIndex(rows=1, block_tokens=2, pool_len=4)
-        row, _ = idx.begin_capture()
-        idx.abort_capture(row)
-        assert idx.lookup(toks(1, 1, 1), limit=2) == (None, 0)
-        row2, evicted = idx.begin_capture()
-        assert row2 == row and not evicted  # free again, no eviction
-
-    def test_too_short_commit_is_released(self):
-        idx = PrefixIndex(rows=1, block_tokens=4, pool_len=8)
-        row, _ = idx.begin_capture()
-        assert idx.commit_capture(row, toks(1, 2, 3), 3) == 0
-        row2, evicted = idx.begin_capture()
-        assert row2 == row and not evicted
-
-    def test_invalidate_forgets_everything(self):
-        idx = PrefixIndex(rows=2, block_tokens=2, pool_len=4)
-        row, _ = idx.begin_capture()
-        idx.commit_capture(row, toks(1, 2, 3, 4), 4)
-        assert idx.lookup(toks(1, 2, 3, 4, 5), limit=4)[1] == 4
-        idx.invalidate()
-        assert idx.lookup(toks(1, 2, 3, 4, 5), limit=4) == (None, 0)
-        assert idx.stats()["committed_rows"] == 0
-        # All rows are allocatable again.
-        assert idx.begin_capture()[0] is not None
-        assert idx.begin_capture()[0] is not None
+    def test_record_evicted_mid_use_keeps_pages_resident(self):
+        mgr = BlockManager(num_blocks=4, block_tokens=2)
+        a, _, ra = run_request(mgr, [1, 1, 1, 1], 0)
+        mgr.release(a, unreserve=ra)
+        shared, cached = mgr.admit(toks(1, 1, 1, 1), 3, 1)
+        assert cached == 2
+        # Force eviction pressure (a 3-block request against 2 free
+        # pages): the record dies, but the page the sharer still
+        # aliases must NOT free out from under it.
+        b, _, rb = run_request(mgr, [2, 2, 2, 2, 2, 2], 0)
+        assert mgr.evictions == 1
+        assert mgr.block_evictions == 1  # only the unreferenced page
+        for blk in shared:
+            assert blk not in mgr._free
+        mgr.release(shared)
+        mgr.release(b, unreserve=rb)
+        mgr.check_invariants()
 
     def test_digest_collision_first_writer_wins(self):
-        """Two rows committing the SAME prefix (racing captures of one
-        hot prompt): the established row keeps serving its digests, so
-        evicting the duplicate later cannot orphan the prefix."""
-        idx = PrefixIndex(rows=2, block_tokens=2, pool_len=4)
-        a, _ = idx.begin_capture()
-        idx.commit_capture(a, toks(1, 2, 3, 4), 4)
-        b, _ = idx.begin_capture()
-        idx.commit_capture(b, toks(1, 2, 3, 4), 4)  # duplicate chain
-        assert idx.lookup(toks(1, 2, 3, 4, 5), limit=4) == (a, 4)
-        # Evict b (a was just touched, so b is LRU) — the prefix must
-        # survive because b never owned its digests.
-        c, evicted = idx.begin_capture()
-        assert evicted and c == b
-        idx.commit_capture(c, toks(7, 8), 2)
-        assert idx.lookup(toks(1, 2, 3, 4, 5), limit=4) == (a, 4)
+        mgr = BlockManager(num_blocks=8, block_tokens=2)
+        a, _, ra = run_request(mgr, [1, 2, 3, 4], 0)
+        # Cache OFF lookup path for the duplicate: publish the same
+        # chain from different physical pages (racing captures).
+        plan = mgr.admit(toks(9, 9, 9, 9), 3, 2)
+        dup = [mgr.take(), mgr.take()]
+        mgr.publish(toks(1, 2, 3, 4), 4, dup)
+        # The established record keeps serving the digests.
+        shared, cached = mgr.admit(toks(1, 2, 3, 4, 5), 4, 3)
+        assert cached == 4 and shared == a[:2]
+        mgr.release(shared, unreserve=1)
+        mgr.release(a, unreserve=ra)
+        mgr.release(dup)
+        mgr.check_invariants()
+
+    def test_caching_off_is_pure_allocator(self):
+        mgr = BlockManager(num_blocks=4, block_tokens=2, caching=False)
+        blocks, cached, res = run_request(mgr, [1, 2, 3, 4], 0)
+        assert cached == 0
+        mgr.release(blocks, unreserve=res)
+        assert mgr.admit(toks(1, 2, 3, 4), 3, 2) == ([], 0)
+        assert mgr.used_blocks() == 0  # publish was a no-op
+        mgr.check_invariants()
+
+    def test_rollback_restores_reservation(self):
+        mgr = BlockManager(num_blocks=4, block_tokens=2)
+        mgr.admit(toks(1, 2), 1, 3)
+        blocks = [mgr.take() for _ in range(3)]
+        assert mgr.available() == 1
+        mgr.rollback(blocks[2:])  # speculative tail trim
+        assert mgr.available() == 1  # page freed, reservation restored
+        assert mgr.take() == blocks[2]
+        mgr.release(blocks)
+        mgr.check_invariants()
+
+    def test_invalidate_forgets_everything(self):
+        mgr = BlockManager(num_blocks=4, block_tokens=2)
+        blocks, _, res = run_request(mgr, [1, 2, 3, 4], 0)
+        mgr.release(blocks, unreserve=res)
+        shared, cached = mgr.admit(toks(1, 2, 3, 4), 3, 1)
+        assert cached == 2
+        mgr.release(shared)  # the sharer retires before the reload
+        mgr.invalidate()
+        assert mgr.admit(toks(1, 2, 3, 4), 3, 0) == ([], 0)
+        assert mgr.used_blocks() == 0
+        assert mgr.stats()["published_records"] == 0
+        mgr.check_invariants()
 
     def test_validation(self):
         with pytest.raises(ValueError):
-            PrefixIndex(rows=0, block_tokens=2, pool_len=4)
+            BlockManager(num_blocks=0, block_tokens=2)
         with pytest.raises(ValueError):
-            PrefixIndex(rows=1, block_tokens=0, pool_len=4)
+            BlockManager(num_blocks=1, block_tokens=0)
+
+
+class TestAllocatorInvariantBattery:
+    """Seeded randomized mixed workload against a small pool: admit /
+    grow / speculative-rollback / release / publish in arbitrary
+    interleavings.  After EVERY operation the structural invariants
+    must hold (no double-free, refcount/free-list agreement,
+    reservation coverage), no page may ever be writable by two
+    diverged requests at once, and a full drain + invalidate must
+    return every page."""
+
+    @pytest.mark.parametrize("seed", [0, 1, 7])
+    def test_mixed_workload_never_corrupts(self, seed):
+        rng = np.random.RandomState(seed)
+        mgr = BlockManager(num_blocks=12, block_tokens=4)
+        live = []  # dicts: tokens, blocks, shared_n, res_left, need
+
+        def writable(req):
+            # Pages this request may WRITE: its private (taken) pages.
+            # Aliased prefix pages are read-only by construction — the
+            # engine starts its first write at the block-aligned
+            # cached offset, which always lands in a private page.
+            return set(req["blocks"][req["shared_n"]:])
+
+        for _ in range(400):
+            op = rng.randint(4)
+            if op == 0 and len(live) < 6:  # admit
+                # Half the prompts share one of two hot prefixes so
+                # aliasing actually happens; suffixes diverge.
+                base = ([1, 2, 3, 4, 5, 6, 7, 8] if rng.randint(2)
+                        else [9, 9, 9, 9])
+                tokens = (base * 2)[:rng.randint(4, 13)] + \
+                    rng.randint(10, 90, size=(rng.randint(0, 5),)
+                                ).tolist()
+                budget = int(rng.randint(1, 9))
+                need = -(-(len(tokens) + budget) // mgr.block)
+                plan = mgr.admit(np.asarray(tokens, np.int32),
+                                 len(tokens) - 1, need)
+                if plan is not None:
+                    shared, cached = plan
+                    assert cached <= len(tokens) - 1
+                    assert len(shared) * mgr.block == cached
+                    live.append({
+                        "tokens": tokens, "blocks": list(shared),
+                        "shared_n": len(shared),
+                        "res_left": need - len(shared), "need": need,
+                        "published": False})
+            elif op == 1 and live:  # grow the frontier
+                req = live[rng.randint(len(live))]
+                if req["res_left"] > 0:
+                    blk = mgr.take()
+                    req["res_left"] -= 1
+                    # Exclusive ownership at take(): no other live
+                    # request may hold (let alone write) this page.
+                    for other in live:
+                        if other is not req:
+                            assert blk not in other["blocks"], (
+                                "page aliased to a diverged writer")
+                    req["blocks"].append(blk)
+                    if not req["published"] and (
+                            len(req["blocks"]) * mgr.block
+                            >= len(req["tokens"])):
+                        mgr.publish(
+                            np.asarray(req["tokens"], np.int32),
+                            len(req["tokens"]), req["blocks"])
+                        req["published"] = True
+            elif op == 2 and live:  # speculative tail rollback
+                req = live[rng.randint(len(live))]
+                private_n = len(req["blocks"]) - req["shared_n"]
+                if private_n > 1:
+                    tail = req["blocks"][-1:]
+                    del req["blocks"][-1:]
+                    req["res_left"] += 1
+                    mgr.rollback(tail)
+            elif op == 3 and live:  # retire
+                req = live.pop(rng.randint(len(live)))
+                mgr.release(req["blocks"], unreserve=req["res_left"])
+            # Writable sets of any two live requests stay disjoint.
+            for i, a in enumerate(live):
+                for b in live[i + 1:]:
+                    assert not (writable(a) & writable(b))
+            mgr.check_invariants()
+
+        for req in live:
+            mgr.release(req["blocks"], unreserve=req["res_left"])
+        mgr.check_invariants()
+        mgr.invalidate()
+        assert mgr.used_blocks() == 0, "pages leaked after full drain"
+        assert mgr.available() == mgr.num_blocks
