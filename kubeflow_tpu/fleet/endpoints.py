@@ -136,6 +136,14 @@ class _EjectBreaker:
         with self._lock:
             return self.failures
 
+    def state(self) -> str:
+        """closed / open / half_open — the operator-facing breaker
+        phase (`kubeflow-tpu fleet status` BREAKER column)."""
+        with self._lock:
+            if self.failures == 0:
+                return "closed"
+            return "half_open" if self._half_open else "open"
+
 
 class EndpointState:
     """Mutable per-endpoint fleet state (owned by the registry; the
@@ -224,6 +232,25 @@ class EndpointState:
         elif self.breaker.open:
             # Failed half-open trial: double the backoff.
             self.breaker.record_failure()
+        return tripped
+
+    def force_eject(self) -> bool:
+        """Trip the breaker NOW, bypassing the consecutive-failure
+        threshold.  The router calls this when a replica dies
+        MID-GENERATION on a proxied stream: that is proof of death,
+        not weather — waiting out `eject_threshold` further probes
+        would keep offering new work to a corpse.  Recovery is the
+        ordinary half-open probe walk.  Returns True when this call
+        ejected the endpoint."""
+        with self._lock:
+            self._consecutive_failures = self._eject_threshold
+            tripped = not self.breaker.open
+        self.breaker.record_failure()
+        if tripped:
+            REGISTRY.counter(EJECTIONS_TOTAL, EJECTIONS_HELP).inc(
+                endpoint=self.name)
+            log.warning("endpoint %s force-ejected "
+                        "(died mid-generation)", self.name)
         return tripped
 
 
@@ -534,6 +561,7 @@ class EndpointRegistry:
                     "local_inflight": s.local_inflight,
                     "cached_token_ratio": s.cached_token_ratio,
                     "breaker_failures": s.breaker.failure_count(),
+                    "breaker_state": s.breaker.state(),
                 })
         return out
 

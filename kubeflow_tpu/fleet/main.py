@@ -67,6 +67,11 @@ def build_parser() -> argparse.ArgumentParser:
                     help="retry tokens deposited per admitted request "
                          "— bounds retries to this fraction of live "
                          "traffic")
+    ap.add_argument("--max_replays", type=int, default=2,
+                    help="per-request cap on idempotent-POST replays "
+                         "after a transport failure (resume-based "
+                         "failover for :generate streams; 0 restores "
+                         "the never-replay 502 semantics)")
     ap.add_argument("--eject_threshold", type=int, default=3,
                     help="consecutive failures that eject a replica")
     ap.add_argument("--eject_backoff_s", type=float, default=1.0,
@@ -131,7 +136,8 @@ def main(argv=None) -> int:
     router = FleetRouter(
         registry, max_tries=args.max_tries,
         try_timeout_s=args.try_timeout_s,
-        retry_budget_ratio=args.retry_budget_ratio)
+        retry_budget_ratio=args.retry_budget_ratio,
+        max_replays=args.max_replays)
     httpd, _ = make_router_server(router, port=args.port,
                                   host=args.host)
     autoscaler = None

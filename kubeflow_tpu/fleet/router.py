@@ -16,12 +16,30 @@ a dumb round-robin LB lacks:
               504 without burning a replica slot.
   retries     a bounded retry budget (token bucket refilled by a
               fraction of admitted requests) retries on a DIFFERENT
-              replica — but only work that provably did not execute:
-              429 Overloaded sheds (the replica refused it) and
-              connection-refused failures (nothing was sent).  A POST
-              whose bytes reached a replica is NEVER replayed — predict
-              with sampling is not idempotent — while GETs (stats/
-              metadata) retry on any transport failure.
+              replica: 429 Overloaded sheds (the replica refused it),
+              connection-refused failures (nothing was sent), and any
+              GET transport failure.
+  replay      every proxied model POST (:predict/:classify/:generate)
+              carries an idempotency key — client-supplied
+              x-kft-idempotency-key or router-minted — so a transport
+              failure after bytes reached a replica is REPLAYABLE: the
+              replica's dedup cache answers a completed duplicate and
+              attaches an in-flight one (never a double execution),
+              and an unanswered request re-executes on a different
+              replica.  Replays spend the retry budget plus a
+              per-request cap (``max_replays``).  POSTs outside the
+              model routes keep the old never-replay 502.
+  failover    a :generate STREAM that dies mid-generation replays with
+              a ``resume_tokens`` payload (prompt + tokens already
+              delivered) when the upstream advertised determinism
+              (greedy, `resumable`) — the engine re-admits it as one
+              chunked prefill and emits only the suffix — or replays
+              from scratch and SKIPS the delivered prefix when a
+              sampling seed was recorded (`seeded`); the router
+              splices the streams so the client sees one gapless,
+              duplicate-free token sequence.  Unseeded sampling
+              streams keep today's truncation/502 semantics.  The
+              dead replica is force-ejected immediately.
   Retry-After when every candidate shed, the router answers 429 with
               the SMALLEST Retry-After observed — the earliest instant
               any replica predicted it would have room.
@@ -35,8 +53,9 @@ a dumb round-robin LB lacks:
 
 Metrics: kft_router_requests_total{outcome,code},
 kft_router_retries_total{reason}, kft_router_retry_budget_exhausted_
-total, kft_router_request_seconds, plus the registry's endpoint-state
-gauges and ejection counters.
+total, kft_router_replays_total{outcome}, kft_router_resume_tokens,
+kft_router_request_seconds, plus the registry's endpoint-state gauges
+and ejection counters.
 """
 
 from __future__ import annotations
@@ -48,6 +67,7 @@ import random
 import threading
 import time
 import urllib.parse
+import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, Tuple
 
@@ -66,6 +86,21 @@ BUDGET_EXHAUSTED_TOTAL = "kft_router_retry_budget_exhausted_total"
 BUDGET_EXHAUSTED_HELP = "retries skipped because the budget was empty"
 LATENCY_SECONDS = "kft_router_request_seconds"
 LATENCY_HELP = "router end-to-end request latency"
+REPLAYS_TOTAL = "kft_router_replays_total"
+REPLAYS_HELP = ("idempotent-POST replays by outcome: ok/failed = a "
+                "replayed request completed/did not, cap_exceeded/"
+                "budget_exhausted/not_replayable = a wanted replay "
+                "was denied")
+RESUME_DEPTH = "kft_router_resume_tokens"
+RESUME_DEPTH_HELP = ("tokens already delivered to the client when a "
+                     "mid-generation failover resumed")
+_RESUME_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0,
+                   256.0, 512.0)
+# The idempotency-key header: accepted from clients, minted otherwise,
+# forwarded verbatim on every attempt of one request (matching
+# serving/http.py IDEMPOTENCY_HEADER — duplicated literal to keep the
+# fleet layer import-free of the serving package).
+IDEMPOTENCY_HEADER = "x-kft-idempotency-key"
 
 # Proxied routes: everything under /model/... plus the replicas' own
 # health surface is ROUTED; the router's own health/metrics live on
@@ -143,6 +178,12 @@ class _RetryBudget:
                 return True
             return False
 
+    def snapshot(self) -> Dict[str, float]:
+        """Remaining/cap for the status surfaces (`fleet status`)."""
+        with self._lock:
+            return {"tokens": round(self._tokens, 2),
+                    "cap": self._cap}
+
 
 class FleetRouter:
     """Routing core, transport-independent (the HTTP handler and the
@@ -153,9 +194,15 @@ class FleetRouter:
                  try_timeout_s: float = 120.0,
                  retry_budget_ratio: float = 0.2,
                  retry_budget_cap: float = 10.0,
+                 max_replays: int = 2,
                  rng: Optional[random.Random] = None):
         self.registry = registry
         self.max_tries = max(1, int(max_tries))
+        # Per-request replay cap: transport failures AFTER bytes
+        # reached a replica may re-execute at most this many times on
+        # other replicas (0 restores the never-replay 502 semantics);
+        # each replay also spends a retry-budget token.
+        self.max_replays = max(0, int(max_replays))
         self.try_timeout_s = try_timeout_s
         self.budget = _RetryBudget(retry_budget_ratio, retry_budget_cap)
         self._pool = _UpstreamPool()
@@ -172,6 +219,9 @@ class FleetRouter:
         self._exhausted = REGISTRY.counter(BUDGET_EXHAUSTED_TOTAL,
                                            BUDGET_EXHAUSTED_HELP)
         self._latency = REGISTRY.histogram(LATENCY_SECONDS, LATENCY_HELP)
+        self._replays = REGISTRY.counter(REPLAYS_TOTAL, REPLAYS_HELP)
+        self._resume_hist = REGISTRY.histogram(
+            RESUME_DEPTH, RESUME_DEPTH_HELP, buckets=_RESUME_BUCKETS)
 
     # -- balancing ---------------------------------------------------------
 
@@ -200,13 +250,7 @@ class FleetRouter:
         minus hop-by-hop headers) or a router-synthesized 429/502/503/
         504 when no replica could take the request."""
         t0 = time.perf_counter()
-        # Root (or continued) span of the distributed trace: each
-        # forward attempt becomes a child whose traceparent rides the
-        # proxied request, so the replica's server span joins THIS
-        # trace.  Tail sampling keeps every non-ok outcome.
-        span = tracing.start_span(
-            "router.request", parent=tracing.extract(headers),
-            attrs={"method": method, "path": path})
+        span = self._root_span(method, path, headers)
         try:
             status, out_headers, out_body, outcome = self._route(
                 method, path, body, headers, span)
@@ -218,29 +262,97 @@ class FleetRouter:
             raise
         self._requests.inc(outcome=outcome, code=str(status))
         self._latency.observe(time.perf_counter() - t0)
+        # "recovered" (ok only after >= 1 replay) rides the error
+        # retention tier: a failed-then-recovered request is exactly
+        # the trace an incident review needs, even though the client
+        # saw success.
         span.end(status=outcome, code=status)
         return status, out_headers, out_body
+
+    # -- shared span sites (span names are unique per module) --------------
+
+    def _root_span(self, method: str, path: str, headers):
+        """Root (or continued) span of the distributed trace: each
+        forward attempt becomes a child whose traceparent rides the
+        proxied request, so the replica's server span joins THIS
+        trace.  Tail sampling keeps every non-ok outcome."""
+        return tracing.start_span(
+            "router.request", parent=tracing.extract(headers),
+            attrs={"method": method, "path": path})
+
+    def _attempt_span(self, parent, state: EndpointState,
+                      dead: Optional[str] = None,
+                      resume_tokens: Optional[int] = None):
+        """One upstream attempt: an ordinary forward, or — when
+        ``dead`` names the replica whose mid-generation death this
+        attempt recovers from — a replay annotated with the resume
+        depth."""
+        if dead is None:
+            return tracing.start_span(
+                "router.forward", parent=parent,
+                attrs={"replica": state.name})
+        return tracing.start_span(
+            "router.replay", parent=parent,
+            attrs={"replica": state.name, "dead": dead,
+                   "resume_tokens": int(resume_tokens or 0)})
+
+    # -- idempotency keys --------------------------------------------------
+
+    @staticmethod
+    def _replayable_path(method: str, path: str) -> bool:
+        """Model POSTs are replay-safe under an idempotency key:
+        predict is pure (the dedup cache de-duplicates same-replica
+        retries; a cross-replica re-execution delivers at most one
+        response to the client) and :generate failover is handled by
+        the streaming path.  Any other POST keeps the never-replay
+        502."""
+        return method == "POST" and path.startswith("/model/") and (
+            path.endswith(":predict") or path.endswith(":classify")
+            or path.endswith(":generate"))
+
+    def _idem_key(self, headers: Dict[str, str]):
+        """(key, headers-with-key): the client's key when supplied
+        (any case), else a freshly minted one — every attempt of one
+        request forwards the SAME key, which is what lets a replica's
+        dedup cache recognize a replay."""
+        key = None
+        for k, v in headers.items():
+            if k.lower() == IDEMPOTENCY_HEADER:
+                key = v
+                break
+        if key is None:
+            key = uuid.uuid4().hex
+        fwd = {k: v for k, v in headers.items()
+               if k.lower() != IDEMPOTENCY_HEADER}
+        fwd[IDEMPOTENCY_HEADER] = key
+        return key, fwd
 
     def _route(self, method, path, body, headers,
                span=tracing.NULL_SPAN):
         self.budget.deposit()
         deadline, body = self._extract_deadline(method, path, body)
+        replayable = self._replayable_path(method, path)
+        if replayable:
+            _, headers = self._idem_key(headers)
         tried: List[str] = []
         retry_after_hints: List[float] = []
         last_error = "no endpoints"
         idempotent = method == "GET"
-        for _ in range(self.max_tries):
+        replays = 0
+        dead: Optional[str] = None
+        for _ in range(self.max_tries + self.max_replays):
             if deadline is not None \
                     and faults.monotonic() >= deadline:
+                if replays:
+                    self._replays.inc(outcome="failed")
                 return 504, {}, _jerr("deadline expired in router"), \
                     "deadline_exceeded"
             state = self.pick(exclude=tuple(tried))
             if state is None:
                 break
             tried.append(state.name)
-            fwd_span = tracing.start_span(
-                "router.forward", parent=span,
-                attrs={"replica": state.name})
+            fwd_span = self._attempt_span(span, state, dead=dead)
+            dead = None
             fwd_headers = headers
             if fwd_span:
                 # The forward span's id becomes the replica's remote
@@ -270,15 +382,37 @@ class FleetRouter:
                         continue
                     break
                 outcome = "ok" if status < 500 else "upstream_error"
+                if replays:
+                    self._replays.inc(
+                        outcome="ok" if status < 500 else "failed")
+                    if status < 500:
+                        outcome = "recovered"
                 return status, resp_headers, resp_body, outcome
             # kind == "connect" (nothing sent) or "transport" (bytes
-            # were sent; only idempotent work may be replayed).
+            # were sent: GETs and keyed model POSTs may be replayed;
+            # anything else keeps the never-replay 502).
             last_error = verdict[1]
             fwd_span.end(status=kind, error=last_error)
             if kind == "connect" or (kind == "transport" and idempotent):
                 if self._grant_retry(kind):
                     continue
+                break
+            if kind == "transport" and replayable:
+                if replays >= self.max_replays:
+                    self._replays.inc(outcome="cap_exceeded")
+                    break
+                if not self._grant_retry("replay"):
+                    self._replays.inc(outcome="budget_exhausted")
+                    break
+                # Chaos hook: scripted replay-path failures (the
+                # failover layer itself under test).
+                faults.fire("router.replay")
+                replays += 1
+                dead = state.name
+                continue
             break
+        if replays:
+            self._replays.inc(outcome="failed")
         if last_error == "overloaded":
             hint = min(retry_after_hints) if retry_after_hints else 1.0
             return 429, {"Retry-After": f"{max(1, round(hint))}"}, \
@@ -295,6 +429,326 @@ class FleetRouter:
             return False
         self._retries.inc(reason=reason)
         return True
+
+    # -- streaming failover (the :generate proxy) --------------------------
+
+    def handle_stream(self, path: str, body: bytes,
+                      headers: Dict[str, str], sink) -> \
+            Optional[Tuple[int, Dict[str, str], bytes]]:
+        """Proxy one streaming :generate POST with mid-generation
+        failover.  ``sink`` carries the client side: ``start()`` sends
+        the 200 chunked header once, ``write_line(dict)`` one NDJSON
+        line, and ``started`` says whether any byte left.  Returns a
+        plain (status, headers, body) triple when the request failed
+        BEFORE streaming began (the caller answers it like any routed
+        response), else None — everything was written to the sink."""
+        t0 = time.perf_counter()
+        span = self._root_span("POST", path, headers)
+        try:
+            verdict, code, outcome = self._stream_route(
+                path, body, headers, sink, span)
+        except BaseException:
+            span.end(status="error")
+            raise
+        self._requests.inc(outcome=outcome, code=str(code))
+        self._latency.observe(time.perf_counter() - t0)
+        span.end(status=outcome, code=code)
+        return verdict
+
+    def _stream_route(self, path, body, headers, sink, span):
+        """Returns (plain_response_or_None, status_code, outcome)."""
+        self.budget.deposit()
+        deadline, body = self._extract_deadline("POST", path, body)
+        _, headers = self._idem_key(headers)
+        tried: List[str] = []
+        retry_after_hints: List[float] = []
+        delivered: List[int] = []   # tokens forwarded to the client
+        meta: Optional[Dict] = None  # first upstream meta line
+        replays = 0
+        dead: Optional[str] = None
+        last_error = "no endpoints"
+
+        def fail(status, message, outcome, extra_headers=None):
+            """Terminal failure: a plain routed response while nothing
+            has streamed, else a terminal error line — the status line
+            is long gone and the NDJSON error line is the only honest
+            signal left on an open stream."""
+            if replays:
+                self._replays.inc(outcome="failed")
+            if sink.started:
+                sink.write_line({"error": message, "code": status})
+                return None, status, outcome
+            return (status, extra_headers or {},
+                    _jerr(message)), status, outcome
+
+        for _ in range(self.max_tries + self.max_replays):
+            if deadline is not None \
+                    and faults.monotonic() >= deadline:
+                return fail(504, "deadline expired in router",
+                            "deadline_exceeded")
+            state = self.pick(exclude=tuple(tried))
+            if state is None:
+                break
+            tried.append(state.name)
+            att_span = self._attempt_span(
+                span, state, dead=dead,
+                resume_tokens=len(delivered) if dead else None)
+            dead = None
+            fwd_headers = headers
+            if att_span:
+                fwd_headers = {
+                    k: v for k, v in headers.items()
+                    if k.lower() != tracing.TRACEPARENT}
+                fwd_headers[tracing.TRACEPARENT] = \
+                    att_span.traceparent()
+            verdict = self._stream_attempt(
+                state, path, body, fwd_headers, deadline, sink,
+                delivered, meta)
+            kind = verdict[0]
+            if kind == "done":
+                _, code = verdict
+                att_span.end(status="ok" if code < 500 else
+                             "upstream_error", code=code)
+                outcome = "ok" if code < 500 else "upstream_error"
+                if replays:
+                    self._replays.inc(
+                        outcome="ok" if code < 500 else "failed")
+                    if code < 500:
+                        outcome = "recovered"
+                return None, code, outcome
+            if kind == "response":
+                # The replica answered a non-200 before any stream
+                # began on THIS attempt: ordinary routed-response
+                # semantics (429 retries on the budget).
+                _, status, resp_headers, resp_body = verdict
+                att_span.end(
+                    status="shed" if status == 429 else
+                    "upstream_error" if status >= 500 else "ok",
+                    code=status)
+                if status == 429:
+                    hint = _parse_retry_after(resp_headers)
+                    if hint is not None:
+                        retry_after_hints.append(hint)
+                    last_error = "overloaded"
+                    if self._grant_retry("overloaded"):
+                        continue
+                    break
+                if sink.started:
+                    # A resume attempt was REFUSED (e.g. 400) after
+                    # the client already holds a prefix: terminal
+                    # error line.
+                    return fail(status, "resume refused upstream",
+                                "upstream_error")
+                outcome = "ok" if status < 500 else "upstream_error"
+                if replays:
+                    self._replays.inc(
+                        outcome="ok" if status < 500 else "failed")
+                    if status < 500:
+                        outcome = "recovered"
+                return (status, resp_headers, resp_body), status, \
+                    outcome
+            if kind == "broken":
+                # Transport death after bytes reached the replica; the
+                # verdict carries the freshest meta (an attempt that
+                # died before its meta line leaves the previous one
+                # standing).
+                _, detail, got_meta, streamed = verdict
+                if got_meta is not None:
+                    meta = got_meta
+                last_error = detail
+                att_span.end(status="transport", error=detail)
+                if streamed:
+                    # Proof of death, not weather: a replica whose 200
+                    # stream broke mid-generation leaves rotation NOW
+                    # (plus its pooled connections — all stale).
+                    if state.force_eject():
+                        self._pool.close_endpoint(state.endpoint.url)
+                # Nothing delivered yet => a fresh attempt is always
+                # safe (the client holds no prefix to contradict).
+                # With tokens delivered, only a deterministic stream
+                # may continue: greedy (resume payload) or an
+                # explicitly seeded sample (from-scratch skip-splice).
+                # Unseeded sampling keeps the documented 502.
+                can_failover = (
+                    not delivered
+                    or bool(meta and meta.get("resumable"))
+                    or bool(meta and meta.get("seeded")))
+                if not can_failover:
+                    self._replays.inc(outcome="not_replayable")
+                    return fail(502,
+                                f"upstream died mid-generation, not "
+                                f"replayable: {detail}",
+                                "upstream_error")
+                if replays >= self.max_replays:
+                    self._replays.inc(outcome="cap_exceeded")
+                    break
+                if not self._grant_retry("replay"):
+                    self._replays.inc(outcome="budget_exhausted")
+                    break
+                # Chaos hook: the replay/failover decision point.
+                faults.fire("router.replay")
+                replays += 1
+                dead = state.name
+                if delivered:
+                    self._resume_hist.observe(float(len(delivered)))
+                continue
+            # kind == "connect": nothing was sent — an ordinary retry.
+            last_error = verdict[1]
+            att_span.end(status="connect", error=last_error)
+            if self._grant_retry("connect"):
+                continue
+            break
+        if last_error == "overloaded":
+            hint = min(retry_after_hints) if retry_after_hints else 1.0
+            return fail(
+                429, "all replicas overloaded", "shed",
+                extra_headers={"Retry-After": f"{max(1, round(hint))}"})
+        if last_error == "no endpoints":
+            return fail(503, "no routable replicas", "no_endpoints")
+        return fail(502, f"upstream failed: {last_error}",
+                    "upstream_error")
+
+    def _stream_attempt(self, state: EndpointState, path, body,
+                        headers, deadline, sink, delivered, meta):
+        """One upstream streaming attempt.  Verdicts:
+        ("done", code) — terminal line forwarded, stream complete;
+        ("response", status, headers, body) — non-200 answer;
+        ("connect", detail) — nothing sent;
+        ("broken", detail, meta_or_None, streamed) — transport death
+        after bytes reached the replica; ``meta`` is the upstream meta
+        line if this attempt got that far (the caller's failover
+        decision input) and ``streamed`` says whether the 200 stream
+        had begun (a true mid-generation death, force-eject material).
+
+        Forwards token lines to ``sink`` AS RECEIVED, extending
+        ``delivered`` in place: on a resume the upstream emits only
+        the suffix; on a seeded from-scratch replay the upstream
+        re-emits everything and the first len(delivered) tokens are
+        SKIPPED (same seed => same stream), so the client never sees
+        a duplicate or a gap either way."""
+        send_body = body
+        mode = "fresh"
+        if delivered:
+            if meta and meta.get("resumable"):
+                mode = "resume"
+                send_body = self._rewrite_resume(body, delivered)
+            else:
+                mode = "replay"  # seeded: re-run and skip the prefix
+        timeout = self.try_timeout_s
+        if deadline is not None:
+            remaining = deadline - faults.monotonic()
+            if remaining <= 0:
+                return "connect", "deadline expired"
+            timeout = min(timeout, remaining)
+            send_body = _rewrite_deadline(send_body, remaining)
+        url = state.endpoint.url
+        conn = self._pool.get(url)
+        if conn is None:
+            parsed = urllib.parse.urlsplit(url)
+            conn = http.client.HTTPConnection(
+                parsed.hostname, parsed.port, timeout=timeout)
+        if conn.sock is not None:
+            conn.sock.settimeout(timeout)
+        fwd_headers = {k: v for k, v in headers.items()
+                       if k.lower() not in _HOP_HEADERS}
+        state.enter()
+        got_meta = None
+        streamed = False
+        try:
+            faults.fire("router.forward")
+            conn.request("POST", path, body=send_body or None,
+                         headers=fwd_headers)
+            resp = conn.getresponse()
+            if resp.status != 200:
+                payload = resp.read()
+                resp_headers = _copy_headers(resp.headers)
+                if resp.will_close:
+                    conn.close()
+                else:
+                    self._pool.put(url, conn)
+                if resp.status >= 500:
+                    self._note_failure(state)
+                else:
+                    state.note_success()
+                return ("response", resp.status, resp_headers, payload)
+            streamed = True
+            skip = len(delivered) if mode == "replay" else 0
+            while True:
+                line = resp.readline()
+                if not line:
+                    raise http.client.IncompleteRead(b"")
+                line = line.strip()
+                if not line:
+                    continue
+                msg = json.loads(line)
+                if "meta" in msg:
+                    got_meta = msg["meta"]
+                    if not sink.started:
+                        sink.start()
+                        sink.write_line(msg)
+                    continue
+                if "tokens" in msg:
+                    toks = [int(t) for t in msg["tokens"]]
+                    if skip:
+                        drop = min(skip, len(toks))
+                        toks = toks[drop:]
+                        skip -= drop
+                    if toks:
+                        delivered.extend(toks)
+                        sink.write_line({"tokens": toks})
+                    continue
+                if "done" in msg:
+                    state.note_success()
+                    sink.write_line({"done": True,
+                                     "tokens_emitted": len(delivered)})
+                    resp.read()  # drain to EOF so the conn can pool
+                    self._pool.put(url, conn)
+                    return ("done", 200)
+                if "error" in msg:
+                    # A replica-side terminal verdict (e.g. deadline
+                    # expiry mid-generation) is an ANSWER, not a
+                    # death: forward it and finish.
+                    code = int(msg.get("code", 500))
+                    state.note_success()
+                    if not sink.started:
+                        sink.start()
+                    sink.write_line(msg)
+                    resp.read()
+                    self._pool.put(url, conn)
+                    return ("done", code)
+        except (ConnectionRefusedError, faults.FaultInjected) as e:
+            conn.close()
+            self._note_failure(state)
+            if streamed:
+                return ("broken", f"{state.name}: {e}",
+                        got_meta or meta, True)
+            return ("connect", f"{state.name}: {e}")
+        except (http.client.HTTPException, ConnectionError,
+                TimeoutError, OSError, ValueError) as e:
+            # Bytes reached the replica (request sent), so every
+            # failure here is the "died mid-request" class the
+            # failover loop arbitrates; ValueError covers a torn JSON
+            # line from a mid-write crash — same failure, later byte.
+            conn.close()
+            self._note_failure(state)
+            detail = f"{state.name}: {type(e).__name__}: {e}"
+            return ("broken", detail, got_meta or meta, streamed)
+        finally:
+            state.exit()
+
+    @staticmethod
+    def _rewrite_resume(body: bytes, delivered: List[int]) -> bytes:
+        """Resume payload: prompt + tokens the client already holds.
+        The engine re-admits the union as one chunked prefill (cached
+        blocks alias for free) and emits only the suffix."""
+        try:
+            payload = json.loads(body)
+        except ValueError:
+            return body
+        if not isinstance(payload, dict):
+            return body
+        payload["resume_tokens"] = list(delivered)
+        return json.dumps(payload).encode()
 
     def _forward_once(self, state: EndpointState, method, path, body,
                       headers, deadline):
@@ -448,6 +902,37 @@ def _rewrite_deadline(body: bytes, remaining_s: float) -> bytes:
     return json.dumps(payload).encode()
 
 
+class StreamSink:
+    """The client side of a proxied :generate stream: chunked NDJSON
+    over the handler's socket.  ``start()`` is idempotent and lazy —
+    the router delays the 200 until the upstream proved it can stream,
+    so pre-stream failures still answer ordinary status codes."""
+
+    def __init__(self, handler):
+        self._h = handler
+        self.started = False
+
+    def start(self) -> None:
+        if self.started:
+            return
+        self._h.send_response(200)
+        self._h.send_header("Content-Type", "application/x-ndjson")
+        self._h.send_header("Transfer-Encoding", "chunked")
+        self._h.end_headers()
+        self.started = True
+
+    def write_line(self, payload: Dict) -> None:
+        self.start()
+        data = json.dumps(payload).encode() + b"\n"
+        self._h.wfile.write(b"%x\r\n" % len(data) + data + b"\r\n")
+        self._h.wfile.flush()
+
+    def finish(self) -> None:
+        if self.started:
+            self._h.wfile.write(b"0\r\n\r\n")
+            self._h.wfile.flush()
+
+
 class _Handler(BaseHTTPRequestHandler):
     router: FleetRouter  # bound by make_router_server
 
@@ -503,8 +988,13 @@ class _Handler(BaseHTTPRequestHandler):
                                 "text/plain; version=0.0.4"}, data)
             return
         if self.path == "/fleet/endpoints":
-            self._respond(200, {}, json.dumps(
-                router.registry.describe()).encode())
+            # Endpoint table plus the router-wide failover budget —
+            # the `kubeflow-tpu fleet status` payload.
+            self._respond(200, {}, json.dumps({
+                "endpoints": router.registry.describe(),
+                "retry_budget": router.budget.snapshot(),
+                "max_replays": router.max_replays,
+            }).encode())
             return
         if self.path == "/debug/traces":
             # Tail-sampled request traces (router root + forward
@@ -516,6 +1006,28 @@ class _Handler(BaseHTTPRequestHandler):
             return
         length = int(self.headers.get("Content-Length", 0))
         body = self.rfile.read(length) if length else b""
+        if method == "POST" and self.path.endswith(":generate") \
+                and self.path.startswith("/model/"):
+            # Streaming generate: the router splices upstream streams
+            # across mid-generation failover; the sink writes chunked
+            # NDJSON to THIS connection as lines arrive.
+            sink = StreamSink(self)
+            try:
+                plain = router.handle_stream(
+                    self.path, body, dict(self.headers.items()), sink)
+            except ConnectionError:
+                return  # the client went away; nothing left to say
+            except Exception as e:  # noqa: BLE001 — proxy must not die
+                log.exception("router stream handler error")
+                if sink.started:
+                    sink.finish()
+                    return
+                plain = (500, {}, _jerr(f"{type(e).__name__}: {e}"))
+            if plain is not None:
+                self._respond(*plain)
+            else:
+                sink.finish()
+            return
         try:
             status, headers, payload = router.handle(
                 method, self.path, body, dict(self.headers.items()))

@@ -421,6 +421,11 @@ class DecodeEngine:
 
         self._lock = threading.Lock()
         self._work = threading.Condition(self._lock)
+        # Streaming delivery signal: submit_stream() readers wait here
+        # and _drain_one notifies after each materialized emission, so
+        # a streamed token reaches its client one drain after the
+        # device produced it (no polling the hot loop).
+        self._emit = threading.Condition(self._lock)
         self._queue: List[dict] = []
         self._stopped = False
         self._drain_deadline: Optional[float] = None
@@ -519,6 +524,10 @@ class DecodeEngine:
                 return False
         else:
             length = _true_token_len(row)
+        # A resume's delivered tokens join the context, so they count
+        # against the static prefill width too.
+        length += int(np.asarray(
+            inputs.get("resume_tokens", ())).size)
         return bool(0 < length <= self.prefill_len)
 
     def submit(self, inputs: Dict[str, Any],
@@ -533,6 +542,18 @@ class DecodeEngine:
         With ``return_timing`` truthy the result also carries
         ``ttft_s`` / ``latency_s`` / ``cached_tokens`` (bench surface).
 
+        ``resume_tokens`` (mid-generation failover, the router's
+        replay payload): tokens a PRIOR attempt of this request
+        already emitted.  They join the prompt as ordinary context —
+        the whole resume is one chunked prefill that aliases whatever
+        prefix blocks this replica has cached — and the budget shrinks
+        by their count, so the engine emits exactly the SUFFIX an
+        uninterrupted run would have produced after them (greedy
+        decode is prefix-deterministic, which is what makes the
+        spliced stream token-identical).  A resume whose tokens
+        already exhaust the budget or end at EOS resolves immediately
+        as a completed generation.
+
         ``deadline`` (absolute faults.monotonic() instant) is enforced
         everywhere the request lives: expired-on-arrival raises here,
         an expired queued request is failed before admission, and an
@@ -540,6 +561,59 @@ class DecodeEngine:
         the deterministic-retirement path — its slot frees for the
         next admission while its lagged device emissions are dropped
         on the floor, exactly like a normally-retired slot's."""
+        entry = self._admit(inputs, deadline)
+        entry["event"].wait()
+        if entry["err"] is not None:
+            raise entry["err"]
+        return entry["out"]
+
+    def submit_stream(self, inputs: Dict[str, Any],
+                      deadline: Optional[float] = None):
+        """Streaming twin of :meth:`submit`: admits the request (same
+        validation, deadlines, resume semantics, typed sheds — all
+        raised HERE, before any byte is produced) and returns
+        ``(meta, iterator)``.  ``meta`` tells the transport layer what
+        failover the request supports — ``resumable`` (greedy export:
+        a replay with ``resume_tokens`` is token-identical) and
+        ``seeded`` (an explicit sampling seed was recorded: a replay
+        FROM SCRATCH reproduces the identical stream, so a proxy can
+        skip already-delivered tokens) — plus the admitted context
+        width and granted budget.  The iterator yields lists of newly
+        emitted token ints as the drain materializes them and raises
+        the request's typed error (DeadlineExceeded, BatcherClosed)
+        mid-stream if it fails after admission."""
+        entry = self._admit(inputs, deadline)
+        meta = {
+            "resumable": self.decode.temperature <= 0.0,
+            "seeded": inputs.get("seed") is not None,
+            "prompt_tokens": int(entry["tokens"].shape[1]),
+            "max_new_tokens": entry["new"],
+        }
+
+        def stream():
+            sent = 0
+            while True:
+                with self._emit:
+                    n = len(entry["emitted"])
+                    if n <= sent and not entry["event"].is_set():
+                        self._emit.wait(timeout=0.02)
+                        continue
+                if n > sent:
+                    chunk = [int(t) for t in entry["emitted"][sent:n]]
+                    sent = n
+                    yield chunk
+                if entry["event"].is_set() \
+                        and sent >= len(entry["emitted"]):
+                    if entry["err"] is not None:
+                        raise entry["err"]
+                    return
+
+        return meta, stream()
+
+    def _admit(self, inputs: Dict[str, Any],
+               deadline: Optional[float]) -> dict:
+        """Validate + enqueue one request (submit/submit_stream share
+        this); returns the live entry whose ``event`` resolves it."""
         tokens = np.asarray(inputs["tokens"], np.int32)
         if tokens.ndim == 1:
             tokens = tokens[None]
@@ -556,20 +630,52 @@ class DecodeEngine:
                     f"(the tokens width)")
         else:
             length = _true_token_len(tokens[0])
+        if length <= 0:
+            raise ValueError(
+                f"true prompt length {length} must be positive")
+        tokens = np.ascontiguousarray(tokens[:, :length])
+        # Mid-generation resume: a prior attempt's delivered tokens
+        # join the context (one ordinary chunked prefill — they alias
+        # cached prefix blocks where this replica has them) and the
+        # budget shrinks by their count, so only the suffix an
+        # uninterrupted run would produce is emitted.
+        resume = np.asarray(
+            inputs.get("resume_tokens", ()), np.int32).reshape(-1)
+        resume_len = int(resume.shape[0])
+        total_budget = int(np.asarray(inputs.get(
+            "max_new_tokens", self.decode.max_new_tokens)).reshape(()))
+        if total_budget < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {total_budget}")
+        total_budget = min(total_budget, self.decode.max_new_tokens)
+        if resume_len:
+            # Chaos hook: the resume admission path (sleep = slow
+            # failover, raise = resume rejected — the router's replay
+            # layer must surface it rather than hang the splice).
+            faults.fire("engine.resume")
+            if resume_len > total_budget:
+                raise ValueError(
+                    f"resume_tokens carries {resume_len} tokens but "
+                    f"the budget is {total_budget}")
+            tokens = np.concatenate([tokens, resume[None]], axis=1)
+            length += resume_len
+            if resume_len == total_budget or (
+                    self._eos
+                    and bool(np.any(resume == self.decode.eos_token))):
+                # The prior attempt already finished the generation
+                # (died between its last token and the done marker):
+                # resolve as a completed request, nothing to emit.
+                return self._completed_entry(tokens, inputs)
         if not 0 < length <= self.prefill_len:
             raise ValueError(
-                f"true prompt length {length} outside "
+                f"true context length {length} (prompt + "
+                f"{resume_len} resumed) outside "
                 f"(0, {self.prefill_len}] (engine prefill width)")
-        tokens = np.ascontiguousarray(tokens[:, :length])
-        new = int(np.asarray(inputs.get(
-            "max_new_tokens", self.decode.max_new_tokens)).reshape(()))
-        if new < 1:
-            raise ValueError(f"max_new_tokens must be >= 1, got {new}")
         # Same budget contract as every other serving path: the export
         # config's max_new_tokens is the ceiling (a client cannot buy a
         # bigger completion than the model advertises), and the cache
         # headroom caps it further — both against the TRUE length.
-        new = min(new, self.decode.max_new_tokens, self.max_len - length)
+        new = min(total_budget - resume_len, self.max_len - length)
         seed = int(np.asarray(inputs.get("seed", 0)).reshape(()))
         if deadline is not None and faults.monotonic() >= deadline:
             with self._lock:
@@ -664,10 +770,24 @@ class DecodeEngine:
             self._queue.append(entry)
             self._set_queue_gauge(len(self._queue))
             self._work.notify()
-        entry["event"].wait()
-        if entry["err"] is not None:
-            raise entry["err"]
-        return entry["out"]
+        return entry
+
+    def _completed_entry(self, tokens: np.ndarray,
+                         inputs: Dict[str, Any]) -> dict:
+        """A resume whose prior attempt already finished (budget spent
+        or EOS delivered, only the done marker lost): resolve without
+        touching the loop — the full context IS the result."""
+        entry = {
+            "tokens": tokens, "new": 0, "emitted": [],
+            "out": {"tokens": tokens}, "err": None,
+            "event": threading.Event(),
+        }
+        if inputs.get("return_timing"):
+            entry["out"]["ttft_s"] = 0.0
+            entry["out"]["latency_s"] = 0.0
+            entry["out"]["cached_tokens"] = 0
+        entry["event"].set()
+        return entry
 
     def compiled_programs(self) -> Dict[str, int]:
         """How many device programs this engine has compiled — by
@@ -1237,6 +1357,8 @@ class DecodeEngine:
             self._ttft_times.extend(ttfts)
             if len(self._ttft_times) > 4096:
                 del self._ttft_times[:2048]
+            # Wake streaming readers: their tokens materialized above.
+            self._emit.notify_all()
         if emitted:
             self._tok_counter.inc(emitted, engine=self._metric_name)
 

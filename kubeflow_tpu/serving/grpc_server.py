@@ -43,6 +43,18 @@ def _deadline_from(context: grpc.ServicerContext):
 SERVICE = "kft.serving.PredictionService"
 GRPC_PORT = 9000  # same port the reference's model server bound
 
+# Idempotency key metadata (the gRPC analogue of the REST header):
+# retried calls carrying the same key are answered from the model
+# server's dedup cache instead of re-executing (docs §5.6).
+IDEMPOTENCY_METADATA = "x-kft-idempotency-key"
+
+
+def _idem_key_from(context: grpc.ServicerContext):
+    for key, value in (context.invocation_metadata() or ()):
+        if key == IDEMPOTENCY_METADATA:
+            return value
+    return None
+
 # grpc.health.v1 readiness parity (the standard Health service wire
 # contract, hand-rolled like the rest of this module — the image has no
 # grpc_health codegen).  Check mirrors /readyz: SERVING while models
@@ -99,7 +111,8 @@ class PredictionServicer:
         version = request.model_spec.version \
             if request.model_spec.version > 0 else None
         outputs = self.server.predict(model.name, inputs, version,
-                                      deadline=_deadline_from(context))
+                                      deadline=_deadline_from(context),
+                                      idem_key=_idem_key_from(context))
         resp = pb.PredictResponse()
         resp.model_spec.name = model.name
         resp.model_spec.version = model.version
@@ -116,7 +129,8 @@ class PredictionServicer:
         outputs = {k: np.asarray(v) for k, v in
                    self.server.predict(
                        model.name, inputs, version,
-                       deadline=_deadline_from(context)).items()}
+                       deadline=_deadline_from(context),
+                       idem_key=_idem_key_from(context)).items()}
         resp = pb.ClassifyResponse()
         resp.model_spec.name = model.name
         resp.model_spec.version = model.version
@@ -365,9 +379,13 @@ class PredictionClient:
             for name, (req, resp) in _METHODS.items()
         }
 
-    def _call(self, name: str, req, timeout: Optional[float]):
+    def _call(self, name: str, req, timeout: Optional[float],
+              idem_key: Optional[str] = None):
+        metadata = ((IDEMPOTENCY_METADATA, idem_key),) \
+            if idem_key else None
         try:
-            return self._methods[name](req, timeout=timeout)
+            return self._methods[name](req, timeout=timeout,
+                                       metadata=metadata)
         except grpc.RpcError as e:
             code = e.code() if callable(getattr(e, "code", None)) else None
             details = e.details() if callable(
@@ -397,13 +415,17 @@ class PredictionClient:
             raise
 
     def predict(self, model: str, inputs: dict,
-                version: int = 0, timeout: Optional[float] = None):
+                version: int = 0, timeout: Optional[float] = None,
+                idem_key: Optional[str] = None):
+        """``idem_key`` rides the x-kft-idempotency-key metadata: a
+        retry with the same key is answered from the server's dedup
+        cache (attached in flight / cached result), never re-run."""
         req = pb.PredictRequest()
         req.model_spec.name = model
         req.model_spec.version = version
         for key, value in inputs.items():
             req.inputs[key].CopyFrom(numpy_to_tensor(np.asarray(value)))
-        resp = self._call("Predict", req, timeout)
+        resp = self._call("Predict", req, timeout, idem_key=idem_key)
         return {k: tensor_to_numpy(t) for k, t in resp.outputs.items()}
 
     def classify(self, model: str, inputs: dict, top_k: int = 5,

@@ -39,6 +39,13 @@ _ROUTES = [
     ("GET", re.compile(r"^/model/(?P<name>[^/:]+):stats$"), "stats"),
     ("POST", re.compile(r"^/model/(?P<name>[^/:]+):predict$"), "predict"),
     ("POST", re.compile(r"^/model/(?P<name>[^/:]+):classify$"), "classify"),
+    # Streaming LM generation (DecodeEngine models only): chunked
+    # NDJSON — one meta line, {"tokens": [...]} lines as the engine
+    # emits, a terminal done/error line.  The resume_tokens body key is
+    # the mid-generation-failover payload the fleet router replays
+    # with (docs §5.6).
+    ("POST", re.compile(r"^/model/(?P<name>[^/:]+):generate$"),
+     "generate"),
     ("POST", re.compile(
         r"^/model/(?P<name>[^/:]+)/version/(?P<version>\d+):predict$"),
      "predict"),
@@ -59,6 +66,32 @@ _ROUTES = [
     # through to the drained-body 404 like any unrouted request.
     ("GET", re.compile(r"^/debug/traces$"), "traces"),
 ]
+
+
+IDEMPOTENCY_HEADER = "x-kft-idempotency-key"
+
+
+def parse_deadline_ms(body: Dict[str, Any]) -> Optional[float]:
+    """``deadline_ms`` body key -> absolute policy-clock instant (or
+    None).  Shared by predict/classify and the streaming generate
+    route so every POST surface validates deadlines identically."""
+    deadline_ms = body.get("deadline_ms")
+    if deadline_ms is None:
+        return None
+    try:
+        deadline_ms = float(deadline_ms)
+    except (TypeError, ValueError):
+        raise ValueError(
+            f"deadline_ms must be a number, got "
+            f"{deadline_ms!r}") from None
+    # NaN would sail through `<= 0` and then lose every later
+    # comparison — a deadline the client believes is set but
+    # nothing enforces.
+    if not math.isfinite(deadline_ms) or deadline_ms <= 0:
+        raise ValueError(
+            f"deadline_ms must be a positive finite number, "
+            f"got {deadline_ms}")
+    return faults.monotonic() + deadline_ms / 1e3
 
 
 def decode_b64_if_needed(value: Any) -> Any:
@@ -138,6 +171,7 @@ class ServingAPI:
     def predict(
         self, name: str, body: Dict[str, Any],
         version: Optional[int] = None,
+        idem_key: Optional[str] = None,
     ) -> Dict[str, Any]:
         instances = body.get("instances")
         if instances is None:
@@ -146,23 +180,7 @@ class ServingAPI:
         # becomes an absolute policy-clock instant enforced in the
         # batching planes (queued AND, on the engine, mid-generation).
         # Expiry surfaces as DeadlineExceeded -> HTTP 504.
-        deadline = None
-        deadline_ms = body.get("deadline_ms")
-        if deadline_ms is not None:
-            try:
-                deadline_ms = float(deadline_ms)
-            except (TypeError, ValueError):
-                raise ValueError(
-                    f"deadline_ms must be a number, got "
-                    f"{deadline_ms!r}") from None
-            # NaN would sail through `<= 0` and then lose every later
-            # comparison — a deadline the client believes is set but
-            # nothing enforces.
-            if not math.isfinite(deadline_ms) or deadline_ms <= 0:
-                raise ValueError(
-                    f"deadline_ms must be a positive finite number, "
-                    f"got {deadline_ms}")
-            deadline = faults.monotonic() + deadline_ms / 1e3
+        deadline = parse_deadline_ms(body)
         instances = decode_b64_if_needed(instances)
         model = self.server.get(name, version)
         sig_inputs = list(
@@ -170,16 +188,36 @@ class ServingAPI:
         )
         inputs = instances_to_inputs(instances, sig_inputs or None)
         outputs = self.server.predict(name, inputs, version,
-                                      deadline=deadline)
+                                      deadline=deadline,
+                                      idem_key=idem_key)
         return {"predictions": outputs_to_predictions(outputs)}
+
+    def generate(self, name: str, body: Dict[str, Any]):
+        """Streaming generation admission: (meta, iterator) from the
+        model's DecodeEngine.  Body keys: ``tokens`` (the prompt),
+        optional ``max_new_tokens`` / ``seed`` / ``prompt_len`` /
+        ``deadline_ms`` / ``resume_tokens`` (the router's failover
+        payload — tokens a prior attempt already delivered)."""
+        tokens = body.get("tokens")
+        if tokens is None:
+            raise ValueError("Request json object must use the key: tokens")
+        deadline = parse_deadline_ms(body)
+        inputs: Dict[str, Any] = {"tokens": np.asarray(tokens, np.int32)}
+        for key in ("max_new_tokens", "seed", "prompt_len",
+                    "resume_tokens"):
+            if body.get(key) is not None:
+                inputs[key] = body[key]
+        return self.server.generate_stream(name, inputs,
+                                           deadline=deadline)
 
     def classify(
         self, name: str, body: Dict[str, Any],
         version: Optional[int] = None,
+        idem_key: Optional[str] = None,
     ) -> Dict[str, Any]:
         """Classification response shape: [[ [class_id, score], ... ], ...]
         per instance (TF-Serving ClassificationResult equivalent)."""
-        result = self.predict(name, body, version)
+        result = self.predict(name, body, version, idem_key=idem_key)
         classifications = []
         for row in result["predictions"]:
             if "top_k_classes" in row:
@@ -292,6 +330,8 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(200, self.api.metadata(groups["name"]))
         elif action == "stats":
             self._send(200, self.api.stats(groups["name"]))
+        elif action == "generate":
+            self._run_generate(groups["name"])
         else:
             import time as _time
 
@@ -327,9 +367,13 @@ class _Handler(BaseHTTPRequestHandler):
             # answer, not an always-keep incident.
             outcome = span_status = "error"
             t0 = _time.perf_counter()
+            # Idempotency key (router-minted or client-supplied): the
+            # dedup layer in ModelServer.predict answers retried keys
+            # from its result cache instead of re-executing.
+            idem_key = self.headers.get(IDEMPOTENCY_HEADER)
             try:
                 with tracing.use_span(span):
-                    out = fn(name, body, version)
+                    out = fn(name, body, version, idem_key=idem_key)
                 outcome = span_status = "ok"
             except KeyError:
                 span_status = "not_found"
@@ -354,6 +398,101 @@ class _Handler(BaseHTTPRequestHandler):
                 span.end(status=span_status)
             self._send(200, out)
 
+    def _run_generate(self, name: str) -> None:
+        """The streaming :generate route: chunked NDJSON over the
+        keep-alive connection.  Admission failures (shed, expired
+        deadline, bad request, no engine) raise BEFORE the status line
+        and map to the ordinary error codes; once streaming has begun
+        a failure becomes a terminal ``{"error": ..., "code": ...}``
+        line — a second status line on a half-written chunked body
+        would corrupt the connection."""
+        import time as _time
+
+        from kubeflow_tpu.runtime.prom import REGISTRY
+        from kubeflow_tpu.serving.model_server import (
+            LATENCY_HELP,
+            LATENCY_SECONDS,
+            REQUESTS_HELP,
+            REQUESTS_TOTAL,
+        )
+
+        length = int(self.headers.get("Content-Length", 0))
+        body = json.loads(self.rfile.read(length) or b"{}")
+        model_label = name if self.api.server.has_model(name) \
+            else "_unknown_"
+        span = tracing.start_span(
+            "server.generate", parent=tracing.extract(self.headers),
+            attrs={"model": model_label, "transport": "rest"})
+        outcome = span_status = "error"
+        t0 = _time.perf_counter()
+        try:
+            try:
+                with tracing.use_span(span):
+                    meta, stream = self.api.generate(name, body)
+            except KeyError:
+                span_status = "not_found"
+                raise
+            except ValueError:
+                span_status = "invalid_argument"
+                raise
+            except Overloaded:
+                outcome = span_status = "shed"
+                raise
+            except DeadlineExceeded:
+                outcome = span_status = "deadline_exceeded"
+                raise
+            self.send_response(200)
+            self.send_header("Content-Type", "application/x-ndjson")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+            emitted = 0
+            try:
+                self._write_chunk({"meta": dict(meta, model=name)})
+                for chunk in stream:
+                    emitted += len(chunk)
+                    self._write_chunk({"tokens": chunk})
+                self._write_chunk({"done": True,
+                                   "tokens_emitted": emitted})
+                outcome = span_status = "ok"
+            except DeadlineExceeded as e:
+                outcome = span_status = "deadline_exceeded"
+                self._write_chunk({"error": str(e), "code": 504})
+            except ConnectionError:
+                # The CLIENT went away mid-stream (crashed router /
+                # closed laptop lid): nothing is left to write to and
+                # nothing to report — the engine entry resolves on its
+                # own.  Returning skips the chunk terminator; the
+                # connection is dead anyway.
+                span_status = "client_disconnected"
+                return
+            except Exception as e:  # noqa: BLE001 — stream must close
+                log.exception("generate stream error")
+                self._write_chunk({"error": f"{type(e).__name__}: {e}",
+                                   "code": 500})
+            finally:
+                stream.close()
+            self._end_chunks()
+        finally:
+            REGISTRY.counter(REQUESTS_TOTAL, REQUESTS_HELP).inc(
+                model=model_label, route="generate", outcome=outcome)
+            REGISTRY.histogram(
+                LATENCY_SECONDS, LATENCY_HELP,
+            ).observe(_time.perf_counter() - t0, route="generate")
+            span.end(status=span_status)
+
+    def _write_chunk(self, payload: Dict[str, Any]) -> None:
+        """One NDJSON line as one HTTP/1.1 chunk, flushed — a proxy
+        (the fleet router) splices streams on line boundaries, so each
+        line must hit the wire when it exists, not when a buffer
+        fills."""
+        data = json.dumps(payload).encode() + b"\n"
+        self.wfile.write(b"%x\r\n" % len(data) + data + b"\r\n")
+        self.wfile.flush()
+
+    def _end_chunks(self) -> None:
+        self.wfile.write(b"0\r\n\r\n")
+        self.wfile.flush()
+
     def _send(self, code: int, payload: Any, raw: bool = False,
               headers: Optional[Dict[str, str]] = None) -> None:
         data = (payload if raw else json.dumps(payload)).encode()
@@ -375,13 +514,17 @@ class _Handler(BaseHTTPRequestHandler):
 
 
 def make_http_server(
-    model_server: ModelServer, port: int = 8000, host: str = "0.0.0.0"
+    model_server: ModelServer, port: int = 8000, host: str = "0.0.0.0",
+    server_cls: type = ThreadingHTTPServer,
 ) -> Tuple[ThreadingHTTPServer, threading.Thread]:
     """Build and start the REST server on a daemon thread; returns
     (httpd, thread).  Port 8000 matches the reference proxy
-    (kubeflow/tf-serving/tf-serving.libsonnet:176-207)."""
+    (kubeflow/tf-serving/tf-serving.libsonnet:176-207).
+    ``server_cls`` lets the chaos harness substitute a
+    ThreadingHTTPServer subclass whose kill() severs live connections
+    (a SIGKILL's socket signature, in process)."""
     handler = type("BoundHandler", (_Handler,), {"api": ServingAPI(model_server)})
-    httpd = ThreadingHTTPServer((host, port), handler)
+    httpd = server_cls((host, port), handler)
     thread = threading.Thread(target=httpd.serve_forever, daemon=True,
                               name="serving-http")
     thread.start()

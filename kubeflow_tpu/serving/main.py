@@ -244,6 +244,14 @@ def main(argv=None) -> int:
     ap.add_argument("--overload_retry_after_s", type=float, default=1.0,
                     help="Retry-After hint carried by shed (429) "
                          "responses")
+    ap.add_argument("--dedup_capacity", type=int, default=1024,
+                    help="idempotency dedup cache entries (completed "
+                         "results answered to retried keys; in-flight "
+                         "duplicates attach instead of re-executing)")
+    ap.add_argument("--dedup_ttl_s", type=float, default=120.0,
+                    help="how long a completed idempotency-key result "
+                         "stays answerable (policy clock); 0 disables "
+                         "expiry")
     ap.add_argument("--drain_deadline_s", type=float, default=30.0,
                     help="graceful-drain budget on SIGTERM: /readyz "
                          "flips not-ready immediately, then in-flight "
@@ -276,7 +284,9 @@ def main(argv=None) -> int:
         reload_backoff_s=args.reload_backoff_s,
         reload_backoff_cap_s=args.reload_backoff_cap_s,
         max_inflight=args.max_inflight,
-        overload_retry_after_s=args.overload_retry_after_s)
+        overload_retry_after_s=args.overload_retry_after_s,
+        dedup_capacity=args.dedup_capacity,
+        dedup_ttl_s=args.dedup_ttl_s)
     server.add_model(args.model_name, args.model_base_path)
     # The factory is installed whenever ANY batching path might apply:
     # lm_generate models default to the continuous DecodeEngine even

@@ -14,12 +14,13 @@ compiled programs instead of one per request shape.
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import logging
 import random
 import threading
 import time
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -80,6 +81,13 @@ CACHED_RATIO_GAUGE = "kft_serving_cached_token_ratio"
 CACHED_RATIO_HELP = ("fraction of prompt tokens served from the engine "
                      "prefix cache; unlabeled = process aggregate, "
                      "model= per-model")
+# Idempotency dedup: requests answered from the per-key result cache
+# (completed duplicates) or attached to an in-flight execution — the
+# survivable-inference counter a chaos run asserts on.
+DEDUP_HITS_TOTAL = "kft_serving_dedup_hits_total"
+DEDUP_HITS_HELP = ("requests answered from the idempotency dedup "
+                   "cache (completed result or in-flight attach), "
+                   "by model")
 
 
 @dataclasses.dataclass
@@ -166,6 +174,83 @@ class _ReloadBreaker:
             return self.failures > 0
 
 
+class _DedupCache:
+    """Bounded, TTL'd idempotency-key -> result cache.
+
+    One entry per key: the FIRST request to present a key becomes the
+    primary and executes; concurrent duplicates attach to its entry
+    and wait on its event; later duplicates of a COMPLETED key are
+    answered from the cached result — so a connection that dies after
+    the replica finished no longer forces a client-visible failure or
+    a double execution when the request is retried with the same key.
+
+    Failures are never cached: ``fail`` resolves attached waiters with
+    the error and drops the entry, so a later retry re-executes (a
+    transient Overloaded must not be replayed from cache for the TTL).
+    Completed entries expire after ``ttl_s`` on the skewable policy
+    clock and are LRU-bounded at ``capacity``; in-flight entries are
+    pinned (waiters hold references) and never evicted."""
+
+    def __init__(self, capacity: int = 1024, ttl_s: float = 120.0):
+        self.capacity = max(1, int(capacity))
+        self.ttl_s = float(ttl_s)
+        self._lock = threading.Lock()
+        self._entries: "collections.OrderedDict[str, dict]" = \
+            collections.OrderedDict()
+
+    def begin(self, key: str) -> Tuple[str, dict]:
+        """Claim or join ``key``: ("new", entry) makes the caller the
+        primary (it MUST finish/fail the entry), ("inflight", entry)
+        attaches to a live execution, ("done", entry) hands back the
+        cached result."""
+        with self._lock:
+            self._sweep_locked()
+            entry = self._entries.get(key)
+            if entry is not None:
+                self._entries.move_to_end(key)
+                verdict = "done" if entry["event"].is_set() \
+                    else "inflight"
+                return verdict, entry
+            entry = {"event": threading.Event(), "result": None,
+                     "err": None, "done_at": None}
+            self._entries[key] = entry
+            while len(self._entries) > self.capacity:
+                victim = next(
+                    (k for k, e in self._entries.items()
+                     if e["event"].is_set()), None)
+                if victim is None:
+                    break  # everything in flight: pinned
+                del self._entries[victim]
+            return "new", entry
+
+    def finish(self, key: str, entry: dict, result: Any) -> None:
+        with self._lock:
+            entry["result"] = result
+            entry["done_at"] = faults.monotonic()
+        entry["event"].set()
+
+    def fail(self, key: str, entry: dict, exc: BaseException) -> None:
+        with self._lock:
+            entry["err"] = exc
+            if self._entries.get(key) is entry:
+                del self._entries[key]
+        entry["event"].set()
+
+    def _sweep_locked(self) -> None:
+        if self.ttl_s <= 0:
+            return
+        now = faults.monotonic()
+        stale = [k for k, e in self._entries.items()
+                 if e["done_at"] is not None
+                 and now - e["done_at"] > self.ttl_s]
+        for k in stale:
+            del self._entries[k]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+
 class ModelServer:
     """Serves N named models, each from a versioned base path."""
 
@@ -173,7 +258,9 @@ class ModelServer:
                  reload_backoff_s: float = 0.5,
                  reload_backoff_cap_s: float = 60.0,
                  max_inflight: int = 0,
-                 overload_retry_after_s: float = 1.0):
+                 overload_retry_after_s: float = 1.0,
+                 dedup_capacity: int = 1024,
+                 dedup_ttl_s: float = 120.0):
         self._models: Dict[str, Dict[int, LoadedModel]] = {}
         self._base_paths: Dict[str, str] = {}
         self._lock = threading.RLock()
@@ -203,6 +290,10 @@ class ModelServer:
         self._max_inflight = max(0, int(max_inflight))
         self._overload_retry_after_s = overload_retry_after_s
         self._inflight_by_model: Dict[str, int] = {}
+        # Idempotency-key result dedup (see _DedupCache): both wire
+        # faces pass the x-kft-idempotency-key header/metadata through
+        # to predict(); the fleet router mints one per proxied POST.
+        self._dedup = _DedupCache(dedup_capacity, dedup_ttl_s)
 
     # -- loading ----------------------------------------------------------
 
@@ -484,12 +575,62 @@ class ModelServer:
         self, name: str, inputs: Dict[str, Any],
         version: Optional[int] = None,
         deadline: Optional[float] = None,
+        idem_key: Optional[str] = None,
     ) -> Dict[str, Any]:
         """``deadline`` is an absolute faults.monotonic() instant: the
         batching planes enforce it in their queues and (the engine) mid-
         generation; the direct path checks it at entry only — a jitted
         whole-generation program cannot be interrupted, which is exactly
-        why the engine owns the LM hot path."""
+        why the engine owns the LM hot path.
+
+        ``idem_key`` (the x-kft-idempotency-key header/metadata value)
+        dedups retried requests: the first presentation executes, an
+        in-flight duplicate attaches to that execution, and a completed
+        duplicate is answered from the TTL'd result cache — so a retry
+        after a dropped connection is answered, never re-run."""
+        if idem_key:
+            return self._predict_deduped(name, inputs, version,
+                                         deadline, idem_key)
+        return self._predict_admitted(name, inputs, version, deadline)
+
+    def _predict_deduped(self, name, inputs, version, deadline,
+                         idem_key):
+        from kubeflow_tpu.runtime.prom import REGISTRY
+
+        verdict, entry = self._dedup.begin(idem_key)
+        if verdict != "new":
+            with self._lock:
+                label = name if name in self._models else "_unknown_"
+            REGISTRY.counter(DEDUP_HITS_TOTAL, DEDUP_HITS_HELP).inc(
+                model=label)
+            if verdict == "inflight":
+                # Attach to the primary (no second execution, no
+                # second in-flight slot), bounded by OUR deadline —
+                # the primary enforces its own.
+                timeout = None if deadline is None else max(
+                    0.0, deadline - faults.monotonic())
+                if not entry["event"].wait(timeout):
+                    raise DeadlineExceeded(
+                        f"deadline expired waiting on the in-flight "
+                        f"twin of idempotency key {idem_key!r}")
+            if entry["err"] is not None:
+                raise entry["err"]
+            return entry["result"]
+        try:
+            result = self._predict_admitted(name, inputs, version,
+                                            deadline)
+        except BaseException as exc:
+            # Failures are not cached: waiters get the error, the key
+            # frees, and a later retry re-executes.
+            self._dedup.fail(idem_key, entry, exc)
+            raise
+        self._dedup.finish(idem_key, entry, result)
+        return result
+
+    def _predict_admitted(
+        self, name: str, inputs: Dict[str, Any],
+        version: Optional[int], deadline: Optional[float],
+    ) -> Dict[str, Any]:
         # Admission child span (trace context set by the transport
         # layer): covers the in-flight-cap verdict; a shed admission
         # records status="shed" so the trace is always tail-retained.
@@ -577,6 +718,47 @@ class ModelServer:
             raise DeadlineExceeded(
                 f"deadline expired before direct dispatch of {name!r}")
         return model.predict(inputs)
+
+    def generate_stream(
+        self, name: str, inputs: Dict[str, Any],
+        deadline: Optional[float] = None,
+    ):
+        """Streaming LM generation: (meta, iterator) from the model's
+        DecodeEngine (the only batching plane with a streaming
+        surface — see DecodeEngine.submit_stream).  Raises KeyError on
+        unknown models and ValueError when the model has no engine:
+        the static batchers dispatch whole-generation programs and
+        cannot stream.  The iterator is bracketed in the in-flight
+        counts (drain waits for live streams); callers must exhaust or
+        close() it."""
+        self.get(name)  # KeyError -> 404 on unknown names
+        with self._lock:
+            batcher = self._batchers.get(name)
+        stream_fn = getattr(batcher, "submit_stream", None)
+        if stream_fn is None:
+            raise ValueError(
+                f"model {name!r} has no streaming decode engine "
+                f"(:generate requires the continuous-batching engine)")
+        meta, stream = stream_fn(inputs, deadline=deadline)
+
+        def bracketed():
+            # Counted from first iteration (a generator closed before
+            # its first next() never runs its finally, so an eager
+            # increment could leak); the REST transport's own
+            # enter_request bracket covers the gap.
+            with self._lock:
+                self._inflight += 1
+                self._inflight_by_model[name] = \
+                    self._inflight_by_model.get(name, 0) + 1
+            try:
+                for chunk in stream:
+                    yield chunk
+            finally:
+                with self._lock:
+                    self._inflight -= 1
+                    self._inflight_by_model[name] -= 1
+
+        return meta, bracketed()
 
 
 class MicroBatcher:

@@ -1066,6 +1066,322 @@ def fleet_smoke(namespace: str = "kubeflow-test") -> None:
                 srv.stop()
 
 
+def survivable_smoke(namespace: str = "kubeflow-test") -> None:
+    """Hermetic survivable-inference scenario: a router in front of
+    THREE engine replicas under a seeded chaos schedule that kills a
+    replica MID-GENERATION and restarts it mid-burst.
+
+      1. control — an uninterrupted streaming :generate run records
+         the greedy token sequence (all replicas share one export, so
+         greedy is replica-independent);
+      2. chaos burst — concurrent streaming clients through the
+         router while a deterministic kill schedule fires: the moment
+         a client has received its 3rd token, the replica serving it
+         is killed (its live sockets severed — the in-process
+         equivalent of SIGKILL's socket signature).  EVERY accepted
+         greedy request must complete with a token stream
+         BIT-IDENTICAL to the control — zero duplicated, missing, or
+         reordered tokens, zero 502s — because the router replays
+         prompt + delivered tokens as a resume payload on a survivor
+         and splices the streams (the engine admits the resume as one
+         chunked prefill);
+      3. the dead replica is force-ejected immediately (no probe-
+         interval wait), then RESTARTED on the same port and readmits
+         via the half-open probe on the skewed policy clock, serving
+         post-restart traffic;
+      4. dedup — a double-submitted :predict with one idempotency key
+         executes ONCE and both submissions get the identical
+         payload;
+      5. kft_router_replays_total{outcome="ok"} > 0,
+         kft_router_resume_tokens observations, and
+         kft_serving_dedup_hits_total > 0 asserted as /metrics
+         deltas, plus the router.replay / engine.resume hook-site
+         encounters on the installed injector.
+    """
+    import json
+    import os
+    import socket
+    import tempfile
+    import threading
+    import urllib.request
+    from http.server import ThreadingHTTPServer
+
+    import jax
+    import numpy as np
+
+    from kubeflow_tpu.fleet.endpoints import (
+        Endpoint,
+        EndpointRegistry,
+        StaticEndpoints,
+    )
+    from kubeflow_tpu.fleet.router import FleetRouter, make_router_server
+    from kubeflow_tpu.models.transformer import Transformer
+    from kubeflow_tpu.runtime.prom import parse_metrics, sample_value
+    from kubeflow_tpu.serving.export import export
+    from kubeflow_tpu.serving.http import make_http_server
+    from kubeflow_tpu.serving.loaders import _model_config
+    from kubeflow_tpu.serving.main import batcher_factory
+    from kubeflow_tpu.serving.model_server import ModelServer
+    from kubeflow_tpu.testing import faults
+
+    class KillableServer(ThreadingHTTPServer):
+        """ThreadingHTTPServer that can sever its LIVE connections:
+        shutdown() only stops accepting, while a crashed process also
+        resets every established socket — kill() reproduces that
+        signature so a mid-generation stream actually breaks."""
+
+        def __init__(self, *a, **kw):
+            super().__init__(*a, **kw)
+            self._live = set()
+            self._live_lock = threading.Lock()
+
+        def process_request(self, request, client_address):
+            with self._live_lock:
+                self._live.add(request)
+            super().process_request(request, client_address)
+
+        def shutdown_request(self, request):
+            with self._live_lock:
+                self._live.discard(request)
+            super().shutdown_request(request)
+
+        def handle_error(self, request, client_address):
+            # The severed handler threads die on BrokenPipe by
+            # design; their tracebacks are not scenario output.
+            pass
+
+        def kill(self):
+            # Sever FIRST: shutdown() blocks up to serve_forever's
+            # 0.5 s poll, and a kill that waits that long lands after
+            # a short generation already finished.
+            with self._live_lock:
+                live = list(self._live)
+                self._live.clear()
+            for sock in live:
+                try:
+                    sock.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                sock.close()
+            self.shutdown()
+            self.server_close()
+
+    overrides = {
+        "vocab_size": 128, "d_model": 32, "n_layers": 2, "n_heads": 4,
+        "n_kv_heads": 2, "d_ff": 64, "head_dim": 8, "max_seq_len": 64,
+        "dtype": "float32",
+    }
+    max_new = 12
+    prompt = list(range(1, 9))
+    # Seeded schedule: the step sleep paces generation so the 3rd-token
+    # kill trigger always lands mid-generation, deterministically.
+    scenario = os.environ.get(faults.ENV) or \
+        "seed=20260804;engine.step:sleep=0.02"
+
+    def make_replica(base, port=0):
+        server = ModelServer()
+        server.add_model("lm", base)
+        server.enable_batching("lm", batcher_factory(
+            micro_batch_size=0, batch_timeout_s=0.005,
+            lm_engine=True, lm_engine_slots=2,
+            lm_engine_prefill_len=32, max_queue_depth=16))
+        httpd, _ = make_http_server(server, port=port, host="127.0.0.1",
+                                    server_cls=KillableServer)
+        return server, httpd
+
+    def stream_via(port, body, on_tokens=None, timeout=180):
+        """POST :generate, read the NDJSON stream; returns
+        (meta, tokens, terminal_msg)."""
+        import http.client
+
+        conn = http.client.HTTPConnection("127.0.0.1", port,
+                                          timeout=timeout)
+        conn.request("POST", "/model/lm:generate",
+                     json.dumps(body).encode(),
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        assert resp.status == 200, (resp.status, resp.read())
+        meta = terminal = None
+        tokens = []
+        while True:
+            line = resp.readline()
+            if not line:
+                break
+            line = line.strip()
+            if not line:
+                continue
+            msg = json.loads(line)
+            if "meta" in msg:
+                meta = msg["meta"]
+            elif "tokens" in msg:
+                tokens.extend(msg["tokens"])
+                if on_tokens is not None:
+                    on_tokens(tokens)
+            if "done" in msg or "error" in msg:
+                terminal = msg
+                break
+        conn.close()
+        return meta, tokens, terminal
+
+    def predict_via(port, body, headers=None, timeout=180):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/model/lm:predict",
+            data=json.dumps(body).encode(),
+            headers=headers or {})
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, resp.read()
+
+    def scrape(port):
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=30) as resp:
+            return parse_metrics(resp.read().decode())
+
+    model = Transformer(_model_config(overrides))
+    variables = model.init(jax.random.key(0), np.zeros((1, 4), np.int32))
+    replicas = []
+    router_httpd = None
+    with faults.injected(scenario) as inj, \
+            tempfile.TemporaryDirectory() as tmp:
+        export(f"{tmp}/lm", 1, variables,
+               loader="kubeflow_tpu.serving.loaders:lm_generate",
+               config={"model": overrides, "max_new_tokens": max_new,
+                       "temperature": 0.0})
+        try:
+            replicas = [list(make_replica(f"{tmp}/lm"))
+                        for _ in range(3)]
+            eps = [Endpoint(name=f"srv-{i}",
+                            url=f"http://127.0.0.1:"
+                                f"{h.server_address[1]}")
+                   for i, (_, h) in enumerate(replicas)]
+            registry = EndpointRegistry(
+                StaticEndpoints(eps), probe_interval_s=0.2,
+                eject_threshold=3, eject_backoff_s=2.0)
+            registry.refresh()
+            assert len(registry.routable()) == 3, registry.describe()
+            router = FleetRouter(registry, max_tries=3, max_replays=2,
+                                 try_timeout_s=180.0)
+            router_httpd, _ = make_router_server(router, port=0,
+                                                 host="127.0.0.1")
+            rport = router_httpd.server_address[1]
+            body = {"tokens": prompt, "max_new_tokens": max_new}
+
+            # -- 1. uninterrupted control run -------------------------
+            meta, control, terminal = stream_via(
+                replicas[0][1].server_address[1], body)
+            assert meta["resumable"] is True, meta
+            assert terminal.get("done") and len(control) == max_new, \
+                (control, terminal)
+
+            before = scrape(rport)
+
+            # -- 2. chaos burst: kill the serving replica at token 3 --
+            killed: dict = {}
+            kill_lock = threading.Lock()
+
+            def maybe_kill(tokens):
+                if len(tokens) < 3:
+                    return
+                with kill_lock:
+                    if killed:
+                        return
+                    for i, (srv, httpd) in enumerate(replicas):
+                        stats = srv.batcher_stats("lm") or {}
+                        if stats.get("in_flight_requests", 0) > 0:
+                            killed["index"] = i
+                            killed["port"] = httpd.server_address[1]
+                            httpd.kill()
+                            return
+
+            results: dict = {}
+
+            def client(i, on_tokens=None):
+                results[i] = stream_via(rport, body,
+                                        on_tokens=on_tokens)
+
+            threads = [threading.Thread(
+                target=client, args=(i, maybe_kill if i == 0 else None))
+                for i in range(5)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=180)
+            assert killed, "the kill schedule never fired"
+            for i, (meta, tokens, terminal) in results.items():
+                assert terminal is not None and terminal.get("done"), (
+                    f"client {i} stream did not complete: {terminal}")
+                assert tokens == control, (
+                    f"client {i} stream drifted from the uninterrupted "
+                    f"control: {tokens} != {control}")
+            assert inj.fired("router.replay") >= 1
+            assert inj.fired("engine.resume") >= 1
+
+            # -- 3. immediate ejection, then restart + readmission ----
+            victim = {s.name: s for s in registry.all()}[
+                f"srv-{killed['index']}"]
+            assert victim.breaker.open, registry.describe()
+            assert victim.breaker.state() in ("open", "half_open")
+            srv = replicas[killed["index"]][0]
+            new_httpd = make_http_server(
+                srv, port=killed["port"], host="127.0.0.1",
+                server_cls=KillableServer)[0]
+            replicas[killed["index"]][1] = new_httpd
+            inj.advance_clock(30)
+            registry.refresh()
+            assert victim.routable(), registry.describe()
+            _, tokens, terminal = stream_via(rport, body)
+            assert terminal.get("done") and tokens == control
+
+            # -- 4. dedup: double submit executes once ----------------
+            target_srv, target_httpd = replicas[(killed["index"] + 1)
+                                                % 3]
+            tport = target_httpd.server_address[1]
+            stats0 = target_srv.batcher_stats("lm") or {}
+            pbody = {"instances": [{"tokens": prompt}]}
+            hdrs = {"x-kft-idempotency-key": "survivable-e2e-1"}
+            s1, payload1 = predict_via(tport, pbody, hdrs)
+            s2, payload2 = predict_via(tport, pbody, hdrs)
+            assert (s1, s2) == (200, 200)
+            assert payload1 == payload2, "dedup hit changed the payload"
+            stats1 = target_srv.batcher_stats("lm") or {}
+            assert stats1.get("requests", 0) \
+                == stats0.get("requests", 0) + 1, (
+                "double submit executed twice", stats0, stats1)
+
+            # -- 5. /metrics deltas (shared in-process registry) ------
+            after = scrape(rport)
+
+            def delta(name, **labels):
+                return (sample_value(after, name, **labels) or 0) \
+                    - (sample_value(before, name, **labels) or 0)
+
+            assert delta("kft_router_replays_total", outcome="ok") \
+                >= 1, after.get("kft_router_replays_total")
+            assert delta("kft_serving_dedup_hits_total", model="lm") \
+                >= 1, after.get("kft_serving_dedup_hits_total")
+            assert delta("kft_router_resume_tokens_count") >= 1, \
+                after.get("kft_router_resume_tokens_count")
+            # Zero 502/504 THIS scenario (delta — an earlier in-process
+            # scenario may have driven deliberate failures).
+            prior = {tuple(sorted(labels.items())): v for labels, v in
+                     before.get("kft_router_requests_total", ())}
+            bad = {tuple(sorted(labels.items())): v for labels, v in
+                   after.get("kft_router_requests_total", ())
+                   if labels.get("code") in ("502", "504")
+                   and v > prior.get(
+                       tuple(sorted(labels.items())), 0)}
+            assert not bad, bad
+        finally:
+            if router_httpd is not None:
+                router_httpd.shutdown()
+            for srv, httpd in replicas:
+                try:
+                    httpd.shutdown()
+                    httpd.server_close()
+                except Exception:
+                    pass
+                srv.stop()
+
+
 def scheduler_smoke(namespace: str = "kubeflow-test") -> None:
     """Hermetic multi-tenant scheduler scenario: two tenants' TPUJobs
     through the fake apiserver (real sockets, HttpKube) against the
@@ -1597,6 +1913,7 @@ COMMANDS = {
     "engine": engine_smoke,
     "faults": fault_injection_smoke,
     "fleet": fleet_smoke,
+    "survivable": survivable_smoke,
     "scheduler": scheduler_smoke,
     "train": train_smoke,
     "train_resilience": train_resilience_smoke,

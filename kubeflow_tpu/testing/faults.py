@@ -27,6 +27,16 @@ Hook sites planted in production code (grep for ``faults.fire``):
                       connection failure, before the socket — the
                       retry/ejection layer sees it as a refused
                       connect)
+    router.replay     each replay/failover attempt the router grants
+                      for an idempotent POST — after the cap and the
+                      retry-budget withdrawal, before the new replica
+                      is picked (raise = failure of the failover path
+                      itself; the chaos e2e's deterministic replay
+                      observation point)
+    engine.resume     DecodeEngine admission of a resume request
+                      (prompt + tokens a prior attempt delivered,
+                      the router's mid-generation failover payload;
+                      sleep = slow failover, raise = resume rejected)
     fleet.probe       endpoint registry readiness probe attempt
     scheduler.admit   cluster scheduler admission-plan pass (skew =
                       age the queue / expire preemption windows,

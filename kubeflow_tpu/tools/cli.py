@@ -166,23 +166,35 @@ def cmd_fleet_status(args: argparse.Namespace) -> int:
 
     url = args.router.rstrip("/") + "/fleet/endpoints"
     with urllib.request.urlopen(url, timeout=args.timeout) as resp:
-        rows = json.loads(resp.read())
+        payload = json.loads(resp.read())
+    # Routers newer than PR 14 wrap the endpoint table with the
+    # router-wide replay/retry budget; older ones answer a bare list.
+    rows = payload.get("endpoints", []) \
+        if isinstance(payload, dict) else payload
     if not rows:
         print("no endpoints discovered")
         return 0
-    fmt = "{:<20} {:<10} {:>9} {:>12} {:>7} {:>9}"
-    print(fmt.format("ENDPOINT", "STATE", "INFLIGHT", "QUEUE_DEPTH",
-                     "CACHE%", "FAILURES"))
+    fmt = "{:<20} {:<10} {:<10} {:>9} {:>12} {:>7} {:>9}"
+    print(fmt.format("ENDPOINT", "STATE", "BREAKER", "INFLIGHT",
+                     "QUEUE_DEPTH", "CACHE%", "FAILURES"))
     for row in rows:
         # Prefix-cache effectiveness per replica (engine models only;
         # replicas that predate the metric report "-").
         ratio = row.get("cached_token_ratio")
         print(fmt.format(row["name"], row["state"],
+                         row.get("breaker_state", "-"),
                          int(row["inflight"]),
                          int(row["queue_depth"]),
                          f"{ratio * 100:.0f}%" if ratio is not None
                          else "-",
                          row["breaker_failures"]))
+    if isinstance(payload, dict):
+        budget = payload.get("retry_budget") or {}
+        tokens, cap = budget.get("tokens"), budget.get("cap")
+        if tokens is not None:
+            print(f"retry budget: {tokens:.1f}/{cap:.0f} tokens; "
+                  f"replay cap {payload.get('max_replays', '-')} "
+                  f"per request")
     return 0
 
 

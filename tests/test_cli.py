@@ -167,10 +167,56 @@ def test_fleet_status_renders_endpoint_table(capsys):
 
     rows = [{"name": "srv-0", "url": "http://10.0.0.5:8000",
              "state": "routable", "inflight": 3.0, "queue_depth": 1.0,
-             "local_inflight": 0, "breaker_failures": 0},
+             "local_inflight": 0, "breaker_failures": 0,
+             "breaker_state": "closed"},
             {"name": "srv-1", "url": "http://10.0.0.6:8000",
              "state": "ejected", "inflight": 0.0, "queue_depth": 0.0,
-             "local_inflight": 0, "breaker_failures": 4}]
+             "local_inflight": 0, "breaker_failures": 4,
+             "breaker_state": "half_open"}]
+    payload = {"endpoints": rows,
+               "retry_budget": {"tokens": 7.4, "cap": 10.0},
+               "max_replays": 2}
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            data = json.dumps(payload).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        rc = cli.main([
+            "fleet", "status", "--router",
+            f"http://127.0.0.1:{httpd.server_address[1]}"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "BREAKER" in out
+        assert "srv-0" in out and "routable" in out and "closed" in out
+        assert "srv-1" in out and "ejected" in out \
+            and "half_open" in out
+        # Router-wide failover budget footer.
+        assert "retry budget: 7.4/10 tokens" in out
+        assert "replay cap 2" in out
+    finally:
+        httpd.shutdown()
+
+
+def test_fleet_status_accepts_legacy_list_payload(capsys):
+    """Routers predating the budget wrapper answer a bare endpoint
+    list; the CLI renders it without the footer."""
+    import json
+    import threading
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    rows = [{"name": "srv-0", "url": "http://10.0.0.5:8000",
+             "state": "routable", "inflight": 0.0, "queue_depth": 0.0,
+             "local_inflight": 0, "breaker_failures": 0}]
 
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, *a):
@@ -191,8 +237,8 @@ def test_fleet_status_renders_endpoint_table(capsys):
             f"http://127.0.0.1:{httpd.server_address[1]}"])
         assert rc == 0
         out = capsys.readouterr().out
-        assert "srv-0" in out and "routable" in out
-        assert "srv-1" in out and "ejected" in out
+        assert "srv-0" in out
+        assert "retry budget" not in out
     finally:
         httpd.shutdown()
 

@@ -42,6 +42,15 @@ class _Replica:
         self.fail_gets = False  # hang up model GETs (stats/metadata)
         self.get_attempts = 0
         self.requests = []
+        self.headers_seen = []
+        # Streaming :generate script: the full "greedy continuation"
+        # this replica produces; a resume_tokens payload makes it emit
+        # only the suffix.  gen_die_after severs the connection after
+        # that many token lines (mid-generation death); gen_meta is
+        # the advertised failover contract.
+        self.gen_tokens = list(range(100, 115))
+        self.gen_die_after = None
+        self.gen_meta = {"resumable": True, "seeded": False}
         self.lock = threading.Lock()
         replica = self
 
@@ -91,15 +100,54 @@ class _Replica:
                         return
                     self._send(200, {"route": self.path})
 
+            def _die(self):
+                # A crashed process resets the socket; plain close()
+                # leaves rfile/wfile refs holding the fd open.
+                import socket as _socket
+
+                try:
+                    self.connection.shutdown(_socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                self.connection.close()
+
+            def _chunk(self, obj):
+                data = json.dumps(obj).encode() + b"\n"
+                self.wfile.write(b"%x\r\n" % len(data) + data
+                                 + b"\r\n")
+                self.wfile.flush()
+
             def do_POST(self):
                 n = int(self.headers.get("Content-Length", 0))
                 body = self.rfile.read(n) if n else b""
                 with replica.lock:
                     replica.requests.append((self.path, body))
+                    replica.headers_seen.append(
+                        dict(self.headers.items()))
                 if replica.hang_up:
                     # Bytes were received, then the connection dies —
-                    # the non-idempotent-retry case.
-                    self.connection.close()
+                    # the transport-failure (replay-eligible) case.
+                    self._die()
+                    return
+                if self.path.endswith(":generate"):
+                    payload = json.loads(body or b"{}")
+                    resume = payload.get("resume_tokens") or []
+                    out = replica.gen_tokens[len(resume):]
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "application/x-ndjson")
+                    self.send_header("Transfer-Encoding", "chunked")
+                    self.end_headers()
+                    self._chunk({"meta": dict(replica.gen_meta)})
+                    for i, tok in enumerate(out):
+                        if replica.gen_die_after is not None \
+                                and i >= replica.gen_die_after:
+                            self._die()
+                            return
+                        self._chunk({"tokens": [tok]})
+                    self._chunk({"done": True,
+                                 "tokens_emitted": len(out)})
+                    self.wfile.write(b"0\r\n\r\n")
                     return
                 headers = {}
                 if replica.retry_after is not None:
@@ -377,24 +425,95 @@ class TestRouter:
         states = {s.name: s for s in reg.all()}
         assert states["r0"].breaker.open
 
-    def test_post_not_replayed_after_bytes_reached_replica(
-            self, replicas):
-        replicas[0].hang_up = True
-        replicas[1].hang_up = True
-        replicas[2].hang_up = True
+    def test_post_transport_failure_replayed_with_same_key(self):
+        """A model POST whose bytes reached a replica IS replayed now:
+        every attempt carries one idempotency key (minted here — no
+        client header), so re-execution is dedup-safe, and the client
+        gets the answer a healthy replica produced."""
+        dying, healthy = _Replica(), _Replica()
+        dying.hang_up = True
+        # P2C always prefers the (lower-scored) dying replica first,
+        # so every request exercises the replay path.
+        healthy.inflight = 50
+        try:
+            reg = _registry([dying, healthy])
+            router = _router(reg)
+            status, _, body = _predict(router)
+            assert status == 200, body
+            assert len(dying.received()) == 1
+            assert len(healthy.received()) == 1
+            # One key, both attempts: the replica that died saw the
+            # SAME x-kft-idempotency-key the survivor answered under.
+            keys = {h.get("x-kft-idempotency-key")
+                    for r in (dying, healthy) for h in r.headers_seen}
+            assert len(keys) == 1 and None not in keys, keys
+            from kubeflow_tpu.runtime.prom import (
+                REGISTRY,
+                parse_metrics,
+                sample_value,
+            )
+
+            parsed = parse_metrics(REGISTRY.render())
+            assert (sample_value(parsed, "kft_router_replays_total",
+                                 outcome="ok") or 0) >= 1
+        finally:
+            dying.kill()
+            healthy.kill()
+
+    def test_post_client_key_forwarded_verbatim(self, replicas):
         reg = _registry(replicas)
         router = _router(reg)
-        status, _, body = _predict(router)
+        status, _, _ = router.handle(
+            "POST", "/model/m:predict",
+            json.dumps({"instances": [[1]]}).encode(),
+            {"X-KFT-Idempotency-Key": "client-key-7"})
+        assert status == 200
+        keys = [h.get("x-kft-idempotency-key")
+                for r in replicas for h in r.headers_seen]
+        assert keys == ["client-key-7"]
+
+    def test_post_replay_cap_zero_restores_502(self, replicas):
+        """max_replays=0 is the pre-replay contract: a transport
+        failure after bytes reached a replica answers 502 and exactly
+        ONE replica ever saw the request."""
+        for r in replicas:
+            r.hang_up = True
+        reg = _registry(replicas)
+        router = _router(reg, max_replays=0)
+        status, _, _ = _predict(router)
         assert status == 502
-        # Exactly ONE replica saw the request: a mid-flight failure of
-        # non-idempotent work must not be replayed elsewhere.
         assert sum(len(r.received()) for r in replicas) == 1
 
-    def test_post_on_reused_conn_death_not_replayed(self):
+    def test_post_replay_cap_bounds_attempts(self, replicas):
+        """Every replica dying caps the request at 1 original +
+        max_replays attempts, then 502."""
+        for r in replicas:
+            r.hang_up = True
+        reg = _registry(replicas)
+        router = _router(reg, max_replays=2)
+        status, _, _ = _predict(router)
+        assert status == 502
+        assert sum(len(r.received()) for r in replicas) == 3
+
+    def test_non_model_post_never_replayed(self, replicas):
+        """POSTs outside the model routes have unknown side effects:
+        the never-replay 502 contract is unchanged for them."""
+        for r in replicas:
+            r.hang_up = True
+        reg = _registry(replicas)
+        router = _router(reg)
+        status, _, _ = _predict(router, path="/admin/do-something")
+        assert status == 502
+        assert sum(len(r.received()) for r in replicas) == 1
+        # And no idempotency key was invented for it.
+        keys = [h.get("x-kft-idempotency-key")
+                for r in replicas for h in r.headers_seen]
+        assert keys == [None]
+
+    def test_post_on_reused_conn_death_recovers_via_replay(self):
         """A pooled keep-alive connection dying before the response is
-        indistinguishable from a replica crashing mid-generation on
-        OUR request — so a POST is NOT replayed (no RFC 7230 §6.3.1
-        close-race carve-out for non-idempotent work)."""
+        indistinguishable from a replica crashing mid-request — under
+        the idempotency key that is now REPLAYABLE instead of a 502."""
         rep, other = _Replica(), _Replica()
         try:
             reg = _registry([rep, other])
@@ -406,16 +525,10 @@ class TestRouter:
                 if rep.received() and other.received():
                     break
             assert rep.received(), "pool to rep never warmed"
-            before = sum(len(r.received()) for r in (rep, other))
             rep.hang_up = True
-            other.hang_up = True
-            # Drive until some request hits a REUSED conn that dies:
-            # the response must be 502 and the request must appear on
-            # exactly ONE replica (no replay).
+            other.hang_up = False
             status, _, _ = _predict(router)
-            after = sum(len(r.received()) for r in (rep, other))
-            assert status == 502
-            assert after == before + 1, (before, after)
+            assert status == 200
         finally:
             rep.kill()
             other.kill()
@@ -532,6 +645,217 @@ class TestRouter:
         status, _, body = _predict(router)
         assert status == 503
         assert b"no routable" in body
+
+
+class _Sink:
+    """Transport-independent client side for router.handle_stream."""
+
+    def __init__(self):
+        self.started = False
+        self.lines = []
+
+    def start(self):
+        self.started = True
+
+    def write_line(self, payload):
+        self.started = True
+        self.lines.append(payload)
+
+    def tokens(self):
+        return [t for m in self.lines for t in m.get("tokens", [])]
+
+
+def _stream(router, body=None, headers=None):
+    sink = _Sink()
+    plain = router.handle_stream(
+        "/model/m:generate",
+        json.dumps(body or {"tokens": [1, 2, 3]}).encode(),
+        headers or {}, sink)
+    return plain, sink
+
+
+class TestStreamingFailover:
+    """Mid-generation failover on the :generate stream proxy: resume
+    splicing, seeded skip-splicing, the unseeded-sampling 502, budget
+    and cap denials, immediate force-ejection, and the router.replay
+    trace spans."""
+
+    def _pair(self, die_after=5):
+        dying, survivor = _Replica(), _Replica()
+        dying.gen_die_after = die_after
+        # P2C deterministically offers the dying replica first.
+        survivor.inflight = 50
+        reg = _registry([dying, survivor])
+        return dying, survivor, reg
+
+    def test_resume_splice_is_gapless_and_duplicate_free(self):
+        dying, survivor, reg = self._pair(die_after=5)
+        try:
+            router = _router(reg)
+            plain, sink = _stream(router)
+            assert plain is None
+            assert sink.tokens() == dying.gen_tokens, sink.lines
+            assert sink.lines[-1] == {
+                "done": True, "tokens_emitted": len(dying.gen_tokens)}
+            # The survivor was asked to RESUME: prompt + the 5 tokens
+            # the client already held, same idempotency key.
+            path, body = survivor.received()[0]
+            payload = json.loads(body)
+            assert payload["resume_tokens"] == dying.gen_tokens[:5]
+            keys = {h.get("x-kft-idempotency-key")
+                    for r in (dying, survivor) for h in r.headers_seen}
+            assert len(keys) == 1 and None not in keys
+        finally:
+            dying.kill()
+            survivor.kill()
+
+    def test_seeded_sampling_replays_from_scratch_and_skips(self):
+        """No resume payload without determinism — but a recorded seed
+        reproduces the stream, so the router re-runs it and SKIPS the
+        delivered prefix."""
+        dying, survivor, reg = self._pair(die_after=4)
+        dying.gen_meta = {"resumable": False, "seeded": True}
+        survivor.gen_meta = {"resumable": False, "seeded": True}
+        try:
+            router = _router(reg)
+            plain, sink = _stream(router)
+            assert plain is None
+            assert sink.tokens() == dying.gen_tokens, sink.lines
+            # From scratch: the survivor got NO resume payload and
+            # re-emitted everything; the router dropped the overlap.
+            _, body = survivor.received()[0]
+            assert "resume_tokens" not in json.loads(body)
+        finally:
+            dying.kill()
+            survivor.kill()
+
+    def test_unseeded_sampling_keeps_502_semantics(self):
+        dying, survivor, reg = self._pair(die_after=5)
+        dying.gen_meta = {"resumable": False, "seeded": False}
+        try:
+            router = _router(reg)
+            plain, sink = _stream(router)
+            # Tokens already streamed: the failure is a terminal error
+            # line, and nothing ran on the survivor.
+            assert plain is None
+            err = sink.lines[-1]
+            assert err.get("code") == 502, sink.lines
+            assert survivor.received() == []
+        finally:
+            dying.kill()
+            survivor.kill()
+
+    def test_death_before_any_token_replays_fresh(self):
+        """Nothing delivered => any fresh attempt is safe even for an
+        unseeded sampler (the client holds no prefix to contradict)."""
+        dying, survivor, reg = self._pair(die_after=0)
+        dying.gen_meta = {"resumable": False, "seeded": False}
+        survivor.gen_meta = {"resumable": False, "seeded": False}
+        try:
+            router = _router(reg)
+            plain, sink = _stream(router)
+            assert plain is None
+            assert sink.tokens() == dying.gen_tokens
+            _, body = survivor.received()[0]
+            assert "resume_tokens" not in json.loads(body)
+        finally:
+            dying.kill()
+            survivor.kill()
+
+    def test_mid_generation_death_force_ejects_immediately(self):
+        dying, survivor, reg = self._pair(die_after=5)
+        try:
+            router = _router(reg)
+            _stream(router)
+            states = {s.name: s for s in reg.all()}
+            # No probe pass ran: the stream death itself ejected it.
+            assert states["r0"].breaker.open
+            assert states["r0"].breaker.state() in ("open",
+                                                    "half_open")
+            assert not states["r1"].breaker.open
+        finally:
+            dying.kill()
+            survivor.kill()
+
+    def test_replay_cap_zero_truncates_stream(self):
+        dying, survivor, reg = self._pair(die_after=5)
+        try:
+            router = _router(reg, max_replays=0)
+            plain, sink = _stream(router)
+            assert plain is None
+            assert sink.lines[-1].get("code") == 502
+            assert survivor.received() == []
+        finally:
+            dying.kill()
+            survivor.kill()
+
+    def test_replay_budget_exhaustion_denies_failover(self):
+        dying, survivor, reg = self._pair(die_after=5)
+        try:
+            router = _router(reg, retry_budget_ratio=0.0,
+                             retry_budget_cap=0.0)
+            plain, sink = _stream(router)
+            assert plain is None
+            assert sink.lines[-1].get("code") == 502
+            assert survivor.received() == []
+        finally:
+            dying.kill()
+            survivor.kill()
+
+    def test_pre_stream_failure_answers_plain_status(self):
+        """Failures before any stream byte keep ordinary status-code
+        responses — here: no routable replicas -> a plain 503, the
+        sink untouched."""
+        rep = _Replica()
+        try:
+            reg = _registry([rep])
+            router = _router(reg)
+            for r in reg.all():
+                with r._lock:
+                    r.ready = False
+            plain, sink = _stream(router)
+            assert plain is not None
+            assert plain[0] == 503
+            assert not sink.started
+        finally:
+            rep.kill()
+
+    def test_recovered_stream_trace_has_replay_span(self):
+        from kubeflow_tpu.runtime import tracing
+
+        dying, survivor, reg = self._pair(die_after=5)
+        tracing.enable(sample_rate=0.0, capacity=32)
+        try:
+            router = _router(reg)
+            plain, sink = _stream(router)
+            assert plain is None
+            assert sink.tokens() == dying.gen_tokens
+            traces = tracing.store().traces()
+            # sample_rate 0: only the error tier retains — and a
+            # failed-then-RECOVERED request rides it by design.
+            assert len(traces) == 1, [t["status"] for t in traces]
+            trace = traces[0]
+            assert trace["status"] == "recovered"
+            assert trace["retained"] == "error"
+            by_name = {}
+            for s in trace["spans"]:
+                by_name.setdefault(s["name"], s)
+            root = by_name["router.request"]
+            assert root["parent_id"] is None
+            fwd = by_name["router.forward"]
+            replay = by_name["router.replay"]
+            # Both attempts hang under the one root request span.
+            assert fwd["parent_id"] == root["span_id"]
+            assert replay["parent_id"] == root["span_id"]
+            # The replay span names the dead replica and the resume
+            # depth the survivor continued from.
+            assert replay["attrs"]["dead"] == "r0"
+            assert replay["attrs"]["replica"] == "r1"
+            assert replay["attrs"]["resume_tokens"] == 5
+        finally:
+            tracing.disable()
+            dying.kill()
+            survivor.kill()
 
 
 class TestAutoscaler:

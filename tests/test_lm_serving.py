@@ -1054,3 +1054,248 @@ def test_lm_logits_loader_serves_f32_regardless_of_ce_dtype(tmp_path):
     server.add_model("lm", str(tmp_path / "lm"))
     out = server.predict("lm", {"tokens": np.asarray([[1, 2, 3]], np.int32)})
     assert np.asarray(out["logits"]).dtype == np.float32
+
+
+class TestResumeAndStreaming:
+    """Survivable-inference engine surface (PR 14): a resume admission
+    (prompt + tokens a prior attempt delivered) must be token-identical
+    to an uninterrupted generate() at EVERY cut point — including cuts
+    landing mid-speculative-window and under a tight paged-KV pool —
+    and the streaming surface must emit exactly the suffix."""
+
+    def _engine(self, spec, decode=None, name="test-resume", **kw):
+        from kubeflow_tpu.serving.engine import DecodeEngine
+
+        kw.setdefault("slots", 2)
+        kw.setdefault("prefill_len", 24)
+        kw.setdefault("prefill_chunk_tokens", 8)
+        kw.setdefault("kv_block_tokens", 4)
+        return DecodeEngine(spec["cfg"], spec["params"],
+                            decode or spec["decode"], name=name, **kw)
+
+    def test_resume_matches_generate_at_every_cut(self, engine_model):
+        spec, _ = engine_model
+        prompt = _prompt()
+        want = _reference_rows(spec, [prompt], [NEW_TOKENS])[0]
+        suffix = want[len(prompt):]
+        engine = self._engine(spec, name="test-resume-cuts")
+        try:
+            for cut in range(NEW_TOKENS):
+                out = engine.submit({
+                    "tokens": np.asarray(prompt, np.int32),
+                    "resume_tokens": suffix[:cut],
+                    "max_new_tokens": NEW_TOKENS})
+                got = np.asarray(out["tokens"])[0].tolist()
+                assert got == want, (
+                    f"resume at cut {cut} drifted: {got} != {want}")
+            # A resume whose tokens already spend the whole budget is
+            # a COMPLETED generation (the prior attempt died between
+            # its last token and the done marker): resolved
+            # immediately, nothing re-generated.
+            stats_before = engine.stats()["requests"]
+            out = engine.submit({
+                "tokens": np.asarray(prompt, np.int32),
+                "resume_tokens": suffix,
+                "max_new_tokens": NEW_TOKENS})
+            assert np.asarray(out["tokens"])[0].tolist() == want
+            assert engine.stats()["requests"] == stats_before
+        finally:
+            engine.close()
+
+    def test_resume_ending_at_eos_is_complete(self, engine_model):
+        import dataclasses
+
+        spec, _ = engine_model
+        prompt = _prompt()
+        want = _reference_rows(spec, [prompt], [NEW_TOKENS])[0]
+        suffix = want[len(prompt):]
+        # Declare the 4th continuation token EOS: an uninterrupted run
+        # stops there, so a resume carrying it is already complete.
+        eos = suffix[3]
+        decode = dataclasses.replace(spec["decode"], eos_token=eos)
+        engine = self._engine(spec, decode=decode,
+                              name="test-resume-eos")
+        try:
+            out = engine.submit({
+                "tokens": np.asarray(prompt, np.int32),
+                "resume_tokens": suffix[:4],
+                "max_new_tokens": NEW_TOKENS})
+            got = np.asarray(out["tokens"])[0].tolist()
+            assert got == prompt + suffix[:4]
+        finally:
+            engine.close()
+
+    def test_resume_mid_speculative_window_identity(self, engine_model):
+        """A resume landing mid-speculative-window: the resumed engine
+        drafts from the identical history (prompt + resume), so the
+        suffix must still be bit-identical to the uninterrupted run."""
+        spec, _ = engine_model
+        rng = np.random.RandomState(SEED + 31)
+        pat = rng.randint(1, VOCAB, size=(4,))
+        prompt = np.tile(pat, 3).tolist()  # repetitive: drafts fire
+        want = _reference_rows(spec, [prompt], [NEW_TOKENS])[0]
+        suffix = want[len(prompt):]
+        engine = self._engine(spec, speculative_tokens=4,
+                              prefill_len=32,
+                              name="test-resume-spec")
+        try:
+            for cut in (2, 5, 9):
+                out = engine.submit({
+                    "tokens": np.asarray(prompt, np.int32),
+                    "resume_tokens": suffix[:cut],
+                    "max_new_tokens": NEW_TOKENS})
+                got = np.asarray(out["tokens"])[0].tolist()
+                assert got == want, (
+                    f"speculative resume at cut {cut} drifted")
+        finally:
+            engine.close()
+
+    def test_resume_under_tight_kv_pool(self, engine_model):
+        """Resume admissions reserve worst-case pages like any other:
+        under a pool barely covering one worst case they serialize
+        (never deadlock) and stay token-identical."""
+        import threading
+
+        spec, _ = engine_model
+        prompt = _prompt()
+        want = _reference_rows(spec, [prompt], [NEW_TOKENS])[0]
+        suffix = want[len(prompt):]
+        # Worst case: ceil((8 prompt + 6 resume + 6 new) / 4) = 5
+        # pages; pool of 6 fits ONE resumed request plus scraps.
+        engine = self._engine(spec, kv_pool_blocks=6,
+                              name="test-resume-tight")
+        try:
+            outs = [None] * 3
+
+            def client(i):
+                outs[i] = engine.submit({
+                    "tokens": np.asarray(prompt, np.int32),
+                    "resume_tokens": suffix[:6],
+                    "max_new_tokens": NEW_TOKENS})
+
+            threads = [threading.Thread(target=client, args=(i,))
+                       for i in range(3)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=120)
+            for i, out in enumerate(outs):
+                assert out is not None, f"client {i} hung"
+                assert np.asarray(out["tokens"])[0].tolist() == want
+        finally:
+            engine.close()
+        assert engine.stats()["kv_blocks_used"] == 0
+
+    def test_submit_stream_yields_exact_suffix(self, engine_model):
+        spec, _ = engine_model
+        prompt = _prompt()
+        want = _reference_rows(spec, [prompt], [NEW_TOKENS])[0]
+        engine = self._engine(spec, name="test-stream")
+        try:
+            meta, it = engine.submit_stream(
+                {"tokens": np.asarray(prompt, np.int32),
+                 "max_new_tokens": NEW_TOKENS})
+            assert meta["resumable"] is True  # greedy export
+            assert meta["seeded"] is False
+            assert meta["prompt_tokens"] == len(prompt)
+            assert meta["max_new_tokens"] == NEW_TOKENS
+            got = []
+            for chunk in it:
+                assert chunk, "empty emission chunk"
+                got.extend(chunk)
+            assert got == want[len(prompt):]
+            # Stream + resume: only the post-cut suffix is emitted.
+            meta, it = engine.submit_stream(
+                {"tokens": np.asarray(prompt, np.int32),
+                 "resume_tokens": want[len(prompt):len(prompt) + 5],
+                 "max_new_tokens": NEW_TOKENS})
+            assert meta["prompt_tokens"] == len(prompt) + 5
+            got = [t for chunk in it for t in chunk]
+            assert got == want[len(prompt) + 5:]
+        finally:
+            engine.close()
+
+    def test_rest_generate_route_streams_ndjson(self, engine_model):
+        """The :generate route end to end over a real socket: chunked
+        NDJSON with a meta line, token lines totaling the reference
+        continuation, and a done line — plus the resume payload."""
+        import http.client
+
+        from kubeflow_tpu.serving.http import make_http_server
+        from kubeflow_tpu.serving.main import batcher_factory
+
+        spec, server = engine_model
+        want = _reference_rows(spec, [_prompt()], [NEW_TOKENS])[0]
+        server.enable_batching("lm", batcher_factory(
+            micro_batch_size=0, batch_timeout_s=0.005,
+            lm_engine=True, lm_engine_slots=2,
+            lm_engine_prefill_len=24))
+        httpd = None
+        try:
+            httpd, _ = make_http_server(server, port=0,
+                                        host="127.0.0.1")
+            port = httpd.server_address[1]
+
+            def stream(body):
+                conn = http.client.HTTPConnection(
+                    "127.0.0.1", port, timeout=60)
+                conn.request("POST", "/model/lm:generate",
+                             json.dumps(body).encode(),
+                             {"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                status = resp.status
+                msgs = []
+                if status == 200:
+                    while True:
+                        line = resp.readline()
+                        if not line:
+                            break
+                        line = line.strip()
+                        if not line:
+                            continue
+                        msgs.append(json.loads(line))
+                        if "done" in msgs[-1] or "error" in msgs[-1]:
+                            break
+                else:
+                    msgs = [json.loads(resp.read() or b"{}")]
+                conn.close()
+                return status, msgs
+
+            status, msgs = stream({"tokens": _prompt(),
+                                   "max_new_tokens": NEW_TOKENS})
+            assert status == 200
+            assert msgs[0]["meta"]["resumable"] is True
+            assert msgs[0]["meta"]["model"] == "lm"
+            toks = [t for m in msgs for t in m.get("tokens", [])]
+            assert toks == want[PROMPT_LEN:]
+            assert msgs[-1] == {"done": True,
+                                "tokens_emitted": NEW_TOKENS}
+            # Resume over the wire: only the suffix streams back.
+            status, msgs = stream({
+                "tokens": _prompt(),
+                "resume_tokens": want[PROMPT_LEN:PROMPT_LEN + 4],
+                "max_new_tokens": NEW_TOKENS})
+            assert status == 200
+            toks = [t for m in msgs for t in m.get("tokens", [])]
+            assert toks == want[PROMPT_LEN + 4:]
+            # Bad request: a missing tokens key answers a plain 400
+            # BEFORE any stream bytes.
+            status, msgs = stream({"max_new_tokens": 4})
+            assert status == 400, msgs
+        finally:
+            if httpd is not None:
+                httpd.shutdown()
+            server.enable_batching("lm", lambda model: None)
+
+    def test_generate_requires_engine(self, engine_model):
+        """Without a streaming batching plane the route is a client
+        error, not a hang: the static batchers dispatch whole
+        generations and cannot stream."""
+        from kubeflow_tpu.serving.http import ServingAPI
+
+        spec, server = engine_model
+        api = ServingAPI(server)  # no batcher enabled: direct path
+        with pytest.raises(ValueError, match="streaming"):
+            api.generate("lm", {"tokens": _prompt()})
+        with pytest.raises(KeyError):
+            api.generate("nope", {"tokens": _prompt()})

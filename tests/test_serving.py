@@ -1028,3 +1028,211 @@ class TestBatcherLifecycleRaces:
                     == (1, 8 + config_new)
         finally:
             bmb.close()
+
+
+class TestIdempotencyDedup:
+    """ModelServer's idempotency-key result dedup (PR 14): a retried
+    key is answered, never re-executed — the survivable-inference
+    contract behind the router's POST replays."""
+
+    def _server(self, predict, **kw):
+        from kubeflow_tpu.serving.model_server import LoadedModel
+
+        server = ModelServer(**kw)
+        server._models["m"] = {1: LoadedModel(
+            name="m", version=1, predict=predict, meta={})}
+        return server
+
+    def test_completed_duplicate_answered_from_cache(self):
+        calls = []
+
+        def predict(inputs):
+            calls.append(1)
+            return {"y": np.asarray([len(calls)])}
+
+        server = self._server(predict)
+        inp = {"x": np.asarray([[1.0]])}
+        r1 = server.predict("m", inp, idem_key="k1")
+        r2 = server.predict("m", inp, idem_key="k1")
+        assert len(calls) == 1
+        # The IDENTICAL payload, not a fresh execution's.
+        assert r1 is r2
+        # A different key is a different request.
+        server.predict("m", inp, idem_key="k2")
+        assert len(calls) == 2
+        # No key = no dedup (the pre-PR-14 path, unchanged).
+        server.predict("m", inp)
+        assert len(calls) == 3
+        from kubeflow_tpu.runtime.prom import (
+            REGISTRY,
+            parse_metrics,
+            sample_value,
+        )
+
+        parsed = parse_metrics(REGISTRY.render())
+        assert (sample_value(parsed, "kft_serving_dedup_hits_total",
+                             model="m") or 0) >= 1
+
+    def test_concurrent_double_submit_executes_once(self):
+        import time as _time
+
+        started = threading.Event()
+        release = threading.Event()
+        calls = []
+
+        def predict(inputs):
+            calls.append(1)
+            started.set()
+            release.wait(timeout=10)
+            return {"y": np.asarray([7])}
+
+        server = self._server(predict)
+        inp = {"x": np.asarray([[1.0]])}
+        results = {}
+
+        def submit(i):
+            results[i] = server.predict("m", inp, idem_key="dup")
+
+        t1 = threading.Thread(target=submit, args=(0,))
+        t1.start()
+        assert started.wait(timeout=10)
+        # The duplicate arrives while the primary is mid-execution:
+        # it must ATTACH, not run predict a second time.
+        t2 = threading.Thread(target=submit, args=(1,))
+        t2.start()
+        _time.sleep(0.05)
+        release.set()
+        t1.join(timeout=10)
+        t2.join(timeout=10)
+        assert len(calls) == 1, "double submit executed twice"
+        assert results[0] is results[1]
+
+    def test_failures_are_not_cached(self):
+        calls = []
+
+        def predict(inputs):
+            calls.append(1)
+            if len(calls) == 1:
+                raise RuntimeError("transient")
+            return {"y": np.asarray([1])}
+
+        server = self._server(predict)
+        inp = {"x": np.asarray([[1.0]])}
+        with pytest.raises(RuntimeError):
+            server.predict("m", inp, idem_key="k")
+        # The key freed with the failure: the retry re-executes.
+        out = server.predict("m", inp, idem_key="k")
+        assert len(calls) == 2
+        assert int(np.asarray(out["y"])[0]) == 1
+
+    def test_ttl_expires_completed_results(self):
+        from kubeflow_tpu.testing import faults
+
+        calls = []
+
+        def predict(inputs):
+            calls.append(1)
+            return {"y": np.asarray([len(calls)])}
+
+        server = self._server(predict, dedup_ttl_s=30.0)
+        inp = {"x": np.asarray([[1.0]])}
+        with faults.injected("seed=0") as inj:
+            server.predict("m", inp, idem_key="k")
+            server.predict("m", inp, idem_key="k")
+            assert len(calls) == 1
+            # Past the TTL (policy clock) the key re-executes: a
+            # cached result must not outlive its usefulness window.
+            inj.advance_clock(31)
+            server.predict("m", inp, idem_key="k")
+            assert len(calls) == 2
+
+    def test_capacity_evicts_completed_not_inflight(self):
+        from kubeflow_tpu.serving.model_server import _DedupCache
+
+        cache = _DedupCache(capacity=2, ttl_s=0)
+        v1, e1 = cache.begin("a")
+        cache.finish("a", e1, {"r": 1})
+        v2, e2 = cache.begin("b")  # in flight
+        v3, e3 = cache.begin("c")  # overflows: evicts completed "a"
+        assert (v1, v2, v3) == ("new", "new", "new")
+        assert cache.begin("a")[0] == "new"  # evicted
+        # The in-flight entry is pinned (waiters hold it).
+        assert cache.begin("b")[0] == "inflight"
+
+    def test_grpc_metadata_key_dedups(self, exported):
+        """The gRPC face's x-kft-idempotency-key metadata reaches the
+        same dedup cache the REST header feeds."""
+        from kubeflow_tpu.serving.grpc_server import (
+            PredictionClient,
+            make_grpc_server,
+        )
+
+        base, _, _ = exported
+        calls = []
+        server = ModelServer()
+        server.add_model("resnet", str(base))
+        real = server.get("resnet").predict
+
+        def counting(inputs):
+            calls.append(1)
+            return real(inputs)
+
+        server.get("resnet").predict = counting
+        grpc_server = make_grpc_server(server, port=0,
+                                       host="127.0.0.1")
+        client = PredictionClient(
+            f"127.0.0.1:{grpc_server.bound_port}")
+        try:
+            img = np.zeros((1, 32, 32, 3), np.float32)
+            r1 = client.predict("resnet", {"image": img},
+                                idem_key="g1")
+            r2 = client.predict("resnet", {"image": img},
+                                idem_key="g1")
+            assert len(calls) == 1
+            for k in r1:
+                assert np.array_equal(r1[k], r2[k])
+        finally:
+            client.close()
+            grpc_server.stop(grace=0)
+            server.stop()
+
+    def test_rest_header_key_dedups(self, exported):
+        """The REST x-kft-idempotency-key header reaches the dedup
+        cache and the duplicate answers BYTE-identical."""
+        from kubeflow_tpu.serving.http import make_http_server
+
+        base, _, _ = exported
+        calls = []
+        server = ModelServer()
+        server.add_model("resnet", str(base))
+        real = server.get("resnet").predict
+
+        def counting(inputs):
+            calls.append(1)
+            return real(inputs)
+
+        server.get("resnet").predict = counting
+        httpd = None
+        try:
+            httpd, _ = make_http_server(server, port=0,
+                                        host="127.0.0.1")
+            port = httpd.server_address[1]
+            body = json.dumps({"instances": [
+                {"image": np.zeros((32, 32, 3)).tolist()}]}).encode()
+
+            def post():
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{port}/model/resnet:predict",
+                    data=body,
+                    headers={"X-KFT-Idempotency-Key": "rest-1"})
+                with urllib.request.urlopen(req, timeout=60) as resp:
+                    return resp.read()
+
+            p1 = post()
+            p2 = post()
+            assert len(calls) == 1
+            assert p1 == p2
+        finally:
+            if httpd is not None:
+                httpd.shutdown()
+            server.stop()

@@ -10,6 +10,7 @@ from kubeflow_tpu.testing.e2e import (
     fleet_smoke,
     scheduler_smoke,
     serving_smoke,
+    survivable_smoke,
     tpujob_smoke,
     train_resilience_smoke,
 )
@@ -101,6 +102,19 @@ class TestE2EDrivers:
         # drain-aware rolling restart with zero lost accepted
         # requests (see kubeflow_tpu/testing/e2e.py fleet_smoke).
         fleet_smoke()
+
+    def test_survivable_smoke(self):
+        # The ci/e2e_config.yaml hermetic `survivable` step: router +
+        # 3 engine replicas under a seeded kill-mid-generation
+        # schedule — every accepted greedy :generate stream completes
+        # bit-identical to an uninterrupted control (resume-based
+        # failover + stream splicing), the dead replica force-ejects
+        # and readmits after restart, a double-submitted :predict with
+        # one idempotency key executes once, and
+        # kft_router_replays_total{outcome="ok"} /
+        # kft_serving_dedup_hits_total move as /metrics deltas (see
+        # kubeflow_tpu/testing/e2e.py survivable_smoke).
+        survivable_smoke()
 
     def test_train_resilience_smoke(self):
         # The ci/e2e_config.yaml hermetic `train_resilience` step:
