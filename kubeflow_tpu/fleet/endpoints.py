@@ -156,6 +156,10 @@ class EndpointState:
         self.ready = False
         self.draining = False
         self.reachable = False
+        # Disaggregation tier advertised by the replica's /readyz
+        # ("prefill" / "decode" / "unified"): the router's two-tier
+        # :generate pipeline keys off this — see FleetRouter.
+        self.tier = "unified"
         # Scraped load gauges (refresh) + router-local outstanding
         # count: the P2C score adds both — the scrape is stale by up to
         # one refresh interval, and the local count covers exactly the
@@ -419,14 +423,16 @@ class EndpointRegistry:
                 # replica reads as not_ready here.  Routing behavior
                 # is identical either way — no NEW work — only the
                 # state label/metric is coarser than the REST probe's.
-                ready, draining = check_health(
-                    ep.grpc_target, timeout=self._probe_timeout_s), False
+                ready, draining, tier = check_health(
+                    ep.grpc_target,
+                    timeout=self._probe_timeout_s), False, "unified"
             else:
-                ready, draining = self._probe_http(ep.url)
+                ready, draining, tier = self._probe_http(ep.url)
             with state._lock:
                 state.reachable = True
                 state.ready = ready
                 state.draining = draining
+                state.tier = tier
             if ready or draining:
                 state.note_success()
             else:
@@ -445,25 +451,39 @@ class EndpointRegistry:
                 self.on_eject(state)
 
     def _probe_http(self, url: str):
-        """GET /readyz -> (ready, draining).  503 is a VALID answer —
-        the replica is alive and telling us not to route to it; only
-        transport failures count against the breaker."""
+        """GET /readyz -> (ready, draining, tier).  503 is a VALID
+        answer — the replica is alive and telling us not to route to
+        it; only transport failures count against the breaker.  The
+        body's ``role`` key (replicas started with --role) is the
+        disaggregation tier; replicas that predate it — or whose body
+        is unparsable — read as "unified", so a mixed-version fleet
+        degrades to the single-tier path instead of misrouting."""
+        tier = "unified"
         try:
             with urllib.request.urlopen(
                     url + "/readyz",
                     timeout=self._probe_timeout_s) as resp:
-                resp.read()
-                return resp.status == 200, False
+                body = resp.read()
+                try:
+                    role = json.loads(body).get("role")
+                    if role in ("prefill", "decode", "unified"):
+                        tier = role
+                except (ValueError, AttributeError):
+                    pass
+                return resp.status == 200, False, tier
         except urllib.error.HTTPError as e:
             body = e.read()
             draining = False
             if e.code == 503:
                 try:
-                    draining = json.loads(body).get("status") \
-                        == "draining"
+                    payload = json.loads(body)
+                    draining = payload.get("status") == "draining"
+                    role = payload.get("role")
+                    if role in ("prefill", "decode", "unified"):
+                        tier = role
                 except (ValueError, AttributeError):
                     pass
-            return False, draining
+            return False, draining, tier
 
     def _scrape(self, state: EndpointState) -> None:
         """Parse the replica's /metrics for the load gauges the P2C
@@ -556,6 +576,7 @@ class EndpointRegistry:
                 out.append({
                     "name": s.name, "url": s.endpoint.url,
                     "state": label,
+                    "tier": s.tier,
                     "inflight": s.inflight,
                     "queue_depth": s.queue_depth,
                     "local_inflight": s.local_inflight,

@@ -50,12 +50,23 @@ a dumb round-robin LB lacks:
   drain       a draining replica (/readyz 503 "draining") receives no
               new work but keeps its in-flight — rolling restarts lose
               zero accepted requests.
+  tiering     with BOTH a prefill and a decode pool routable (replicas
+              advertise --role on /readyz), a :generate pipelines:
+              one prefill replica computes the prompt's KV pages
+              (:prefill), the handoff payload rides the body, and the
+              stream dispatches to decode-tier replicas only — each
+              pool runs at its own roofline (prefill compute-bound,
+              decode HBM-bound) and keeps its collectives on its own
+              ICI links.  Any prefill-leg failure falls back to the
+              untiered path; an exhausted decode pool sheds typed 429
+              Overloaded (capacity, not fleet death).  Unified
+              replicas keep today's path — strictly additive.
 
 Metrics: kft_router_requests_total{outcome,code},
 kft_router_retries_total{reason}, kft_router_retry_budget_exhausted_
 total, kft_router_replays_total{outcome}, kft_router_resume_tokens,
-kft_router_request_seconds, plus the registry's endpoint-state gauges
-and ejection counters.
+kft_router_tier_requests_total{tier}, kft_router_request_seconds,
+plus the registry's endpoint-state gauges and ejection counters.
 """
 
 from __future__ import annotations
@@ -94,6 +105,12 @@ REPLAYS_HELP = ("idempotent-POST replays by outcome: ok/failed = a "
 RESUME_DEPTH = "kft_router_resume_tokens"
 RESUME_DEPTH_HELP = ("tokens already delivered to the client when a "
                      "mid-generation failover resumed")
+TIER_REQUESTS_TOTAL = "kft_router_tier_requests_total"
+TIER_REQUESTS_HELP = (
+    "disaggregated :generate dispatches by tier: prefill = a "
+    "prefill-pool handoff attempt, decode = a decode-pool stream "
+    "dispatch, unified = the single-tier path (no tiered topology, "
+    "or fallback after a prefill failure)")
 _RESUME_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0,
                    256.0, 512.0)
 # The idempotency-key header: accepted from clients, minted otherwise,
@@ -222,22 +239,39 @@ class FleetRouter:
         self._replays = REGISTRY.counter(REPLAYS_TOTAL, REPLAYS_HELP)
         self._resume_hist = REGISTRY.histogram(
             RESUME_DEPTH, RESUME_DEPTH_HELP, buckets=_RESUME_BUCKETS)
+        self._tier_requests = REGISTRY.counter(TIER_REQUESTS_TOTAL,
+                                               TIER_REQUESTS_HELP)
 
     # -- balancing ---------------------------------------------------------
 
-    def pick(self, exclude: Tuple[str, ...] = ()) -> \
+    def pick(self, exclude: Tuple[str, ...] = (),
+             tiers: Optional[Tuple[str, ...]] = None) -> \
             Optional[EndpointState]:
         """Power-of-two-choices among routable endpoints not already
         tried this request: two uniform draws, lower load score wins
-        (one candidate short-circuits; zero returns None)."""
+        (one candidate short-circuits; zero returns None).  ``tiers``
+        restricts candidates to those disaggregation tiers (None =
+        any — the single-tier path)."""
         candidates = [s for s in self.registry.routable()
-                      if s.name not in exclude]
+                      if s.name not in exclude
+                      and (tiers is None
+                           or getattr(s, "tier", "unified") in tiers)]
         if not candidates:
             return None
         if len(candidates) == 1:
             return candidates[0]
         a, b = self._rng.sample(candidates, 2)
         return a if a.score() <= b.score() else b
+
+    def _tier_topology(self) -> bool:
+        """True when the fleet has BOTH a routable prefill pool and a
+        routable decode pool — the precondition for pipelining a
+        :generate across tiers.  Anything less (mixed-version fleet,
+        a whole tier down at dispatch time) keeps the single-tier
+        path, so disaggregation is strictly additive."""
+        tiers = {getattr(s, "tier", "unified")
+                 for s in self.registry.routable()}
+        return "prefill" in tiers and "decode" in tiers
 
     # -- request handling --------------------------------------------------
 
@@ -460,6 +494,20 @@ class FleetRouter:
         self.budget.deposit()
         deadline, body = self._extract_deadline("POST", path, body)
         _, headers = self._idem_key(headers)
+        # Disaggregated topology: with BOTH tiers routable, pipeline
+        # prefill-then-decode — the prefill pool computes the prompt's
+        # KV pages, the payload rides the :generate body, and the
+        # stream dispatches to the decode pool only.  Any prefill-leg
+        # failure falls back to the untiered path (strictly additive).
+        tiered = False
+        if self._tier_topology():
+            try:
+                body, tiered = self._tiered_prefill(
+                    path, body, headers, deadline, span)
+            except faults.FaultInjected as e:
+                log.warning("tier dispatch fault injected: %s", e)
+        if not tiered:
+            self._tier_requests.inc(tier="unified")
         tried: List[str] = []
         retry_after_hints: List[float] = []
         delivered: List[int] = []   # tokens forwarded to the client
@@ -486,10 +534,23 @@ class FleetRouter:
                     and faults.monotonic() >= deadline:
                 return fail(504, "deadline expired in router",
                             "deadline_exceeded")
-            state = self.pick(exclude=tuple(tried))
+            state = self.pick(exclude=tuple(tried),
+                              tiers=("decode",) if tiered else None)
             if state is None:
+                if tiered:
+                    # The decode pool is exhausted (every decode
+                    # replica tried, ejected, or down) while prefill
+                    # capacity exists: that is OVERLOAD of one tier,
+                    # not fleet death — shed typed 429 so the client
+                    # retries into recovered capacity, never hangs on
+                    # a half-finished handoff.
+                    return fail(
+                        429, "no routable decode-tier replicas",
+                        "shed", extra_headers={"Retry-After": "1"})
                 break
             tried.append(state.name)
+            if tiered:
+                self._tier_requests.inc(tier="decode")
             att_span = self._attempt_span(
                 span, state, dead=dead,
                 resume_tokens=len(delivered) if dead else None)
@@ -607,6 +668,56 @@ class FleetRouter:
             return fail(503, "no routable replicas", "no_endpoints")
         return fail(502, f"upstream failed: {last_error}",
                     "upstream_error")
+
+    def _tiered_prefill(self, path, body, headers, deadline, parent):
+        """The prefill leg of a tiered :generate: POST the prompt to
+        one prefill-tier replica's :prefill route and fold the
+        answered ``kv_handoff`` payload (a wire-encoded block-page
+        list — the router never decodes it) into the :generate body.
+        Returns (body, True) on success; ANY failure — no prefill
+        replica, transport death, non-200, a prompt too short to
+        cover one page — returns the original body with False and the
+        caller runs the untiered path.  One attempt by design: the
+        fallback is always correct, so the prefill leg never burns
+        the retry budget the decode stream may need."""
+        # Chaos hook: the tier-routing decision point (raise = tiered
+        # dispatch failure — the :generate must fall back to the
+        # untiered path, never hang or 500).
+        faults.fire("router.tier_dispatch")
+        state = self.pick(tiers=("prefill",))
+        if state is None:
+            return body, False
+        self._tier_requests.inc(tier="prefill")
+        span = tracing.start_span(
+            "router.prefill", parent=parent,
+            attrs={"replica": state.name})
+        fwd_headers = headers
+        if span:
+            fwd_headers = {
+                k: v for k, v in headers.items()
+                if k.lower() != tracing.TRACEPARENT}
+            fwd_headers[tracing.TRACEPARENT] = span.traceparent()
+        prefill_path = path[:-len(":generate")] + ":prefill"
+        verdict = self._forward_once(state, "POST", prefill_path,
+                                     body, fwd_headers, deadline)
+        if verdict[0] != "response":
+            span.end(status=verdict[0], error=verdict[1])
+            return body, False
+        _, status, _, payload = verdict
+        if status != 200:
+            span.end(status="upstream_error" if status >= 500
+                     else "ok", code=status)
+            return body, False
+        reply = _json_obj(payload)
+        handoff = reply.get("kv_handoff") if reply else None
+        request = _json_obj(body) if handoff else None
+        if not isinstance(handoff, dict) or request is None:
+            span.end(status="ok", code=status)
+            return body, False
+        request["kv_handoff"] = handoff
+        span.end(status="ok", code=status,
+                 tokens_covered=int(handoff.get("tokens_covered", 0)))
+        return json.dumps(request).encode(), True
 
     def _stream_attempt(self, state: EndpointState, path, body,
                         headers, deadline, sink, delivered, meta):
@@ -870,6 +981,17 @@ class FleetRouter:
 
 def _jerr(message: str) -> bytes:
     return json.dumps({"error": message}).encode()
+
+
+def _json_obj(data: bytes):
+    """Parse a JSON object, or None (malformed / not an object) —
+    the tiered-prefill leg's tolerant decode: junk means 'fall back
+    to the untiered path', never an exception."""
+    try:
+        obj = json.loads(data)
+    except (ValueError, TypeError):
+        return None
+    return obj if isinstance(obj, dict) else None
 
 
 def _copy_headers(headers) -> Dict[str, str]:

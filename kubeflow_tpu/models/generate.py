@@ -549,6 +549,39 @@ def _pool_block_tokens(cache) -> int:
     return vals.shape[2]
 
 
+@partial(jax.jit, donate_argnums=(0,))
+def import_kv_pages(state, pages_k, pages_v, ids):
+    """Disaggregated-serving KV handoff, device side: scatter a list
+    of transferred block PAGES into this engine's pool at physical
+    blocks ``ids`` ([n] int32; entries holding the pool-size sentinel
+    are padding and drop).  ``pages_k``/``pages_v`` are
+    [layers, n, block_tokens, hkv, d] page stacks (QTensor values +
+    scale for int8 pools) — exactly the prefill replica's pool rows,
+    so after the scatter the decode replica's pool holds bit-identical
+    k/v and the slot resumes through the ordinary cached-prefix path
+    (chunked prefill from the covered offset).  ``n`` is static (the
+    engine pads to its table span), so one compiled program covers
+    every handoff; it runs once per imported request, never in the
+    step loop."""
+    nb = (state["cache_k"].values if isinstance(state["cache_k"], QTensor)
+          else state["cache_k"]).shape[1]
+    ids = jnp.where(ids < nb, ids, nb)
+
+    def scatter(pool, pages):
+        if isinstance(pool, QTensor):
+            return QTensor(
+                pool.values.at[:, ids].set(pages.values, mode="drop"),
+                pool.scale.at[:, ids].set(pages.scale, mode="drop"),
+                pool.axes)
+        return pool.at[:, ids].set(pages.astype(pool.dtype),
+                                   mode="drop")
+
+    state = dict(state)
+    state["cache_k"] = scatter(state["cache_k"], pages_k)
+    state["cache_v"] = scatter(state["cache_v"], pages_v)
+    return state
+
+
 @partial(jax.jit, static_argnums=(0, 3, 4), donate_argnums=(2,))
 def decode_step(cfg: TransformerConfig, params, state,
                 decode: DecodeConfig, steps: int, tables: jax.Array):

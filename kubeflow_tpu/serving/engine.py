@@ -56,7 +56,21 @@ over ONE persistent PAGED KV block pool shared by ``slots`` sequences:
   - every shape is static, so the engine's whole lifetime compiles at
     most THREE programs (chunked prefill, step, verify — the third
     only when speculation is enabled; prefix reuse needs no copy
-    program at all).
+    program at all; a decode-tier engine that imports disaggregated
+    KV handoffs adds a fourth, ``kv_import``, run once per imported
+    request);
+  - with ``mesh`` set (serving/sharding.py) the SAME programs compile
+    tensor-parallel: params and the block pool are placed with
+    NamedShardings at construction (heads / MLP hidden / vocab split,
+    the pool on its kv-head dim) and XLA partitions every program
+    from the argument shardings — host-owned block tables, admission,
+    and the step loop are untouched, and greedy tokens are identical
+    to the single-device engine;
+  - disaggregated serving rides the same block pool: a prefill-tier
+    request (``kv_export``) returns its finished full-block pages as
+    a handoff payload, and a decode-tier admission (``kv_handoff``)
+    scatters transferred pages into reserved blocks and resumes
+    through the ordinary cached-prefix chunked-prefill path.
 
 The host loop reads sampled tokens with a small LAG (``sync_lag``
 steps): step N+lag is dispatched before step N's tokens are
@@ -124,6 +138,14 @@ SPEC_DRAFTED_TOTAL = "kft_engine_spec_drafted_total"
 SPEC_DRAFTED_HELP = "draft tokens proposed to verify_step, by engine"
 SPEC_ACCEPTED_TOTAL = "kft_engine_spec_accepted_total"
 SPEC_ACCEPTED_HELP = "draft tokens accepted by verify_step, by engine"
+MESH_DEVICES_GAUGE = "kft_engine_mesh_devices"
+MESH_DEVICES_HELP = \
+    "devices the engine's serving mesh spans (1 = single-device), " \
+    "by engine"
+HANDOFF_PAGES_TOTAL = "kft_engine_handoff_pages_total"
+HANDOFF_PAGES_HELP = \
+    "paged-KV pages transferred for disaggregated prefill/decode " \
+    "handoff, by engine and direction (export/import)"
 
 # N-gram drafter bounds: suffixes of up to _SPEC_NGRAM_MAX tokens are
 # matched against the request's own history, down to _SPEC_NGRAM_MIN.
@@ -291,6 +313,18 @@ class DecodeEngine:
         (sync_lag 0): the drafter reads each slot's materialized
         history, and the k-token verify window amortizes dispatch
         the way the read lag otherwise would.
+      mesh: a ``jax.sharding.Mesh`` (serving/sharding.py build_mesh)
+        to run tensor-parallel over: params and the paged KV block
+        pool are placed with NamedShardings at construction (heads /
+        MLP hidden / vocab split under ``partition_rules``; the pool
+        shards its kv-head dim) and the SAME three AOT programs
+        compile SPMD from the argument shardings — the host-owned
+        block tables, the step loop, and every admission path are
+        untouched.  None (the default) is the single-device engine,
+        bit-for-bit the pre-mesh behavior.
+      partition_rules: regex partition rules over the param tree
+        (default serving/sharding.py LM_PARTITION_RULES); only
+        consulted when ``mesh`` is set.
     """
 
     def __init__(
@@ -312,6 +346,8 @@ class DecodeEngine:
         max_queue_depth: int = 0,
         overload_retry_after_s: float = 1.0,
         speculative_tokens: int = 0,
+        mesh=None,
+        partition_rules=None,
         name: str = "engine",
     ):
         from kubeflow_tpu.models.generate import init_paged_state
@@ -320,6 +356,16 @@ class DecodeEngine:
         if slots < 1:
             raise ValueError(f"slots must be >= 1, got {slots}")
         self.cfg = cfg
+        self.mesh = mesh
+        if mesh is not None:
+            # Tensor-parallel placement (serving/sharding.py): a
+            # one-time device_put of params + pool; the AOT programs
+            # below compile SPMD from these shardings alone.
+            from kubeflow_tpu.serving import sharding
+
+            params = sharding.shard_params(
+                params, mesh,
+                partition_rules or sharding.LM_PARTITION_RULES)
         self.params = params
         self.decode = decode
         self.slots = slots
@@ -384,6 +430,10 @@ class DecodeEngine:
         self._state = init_paged_state(cfg, slots, self.kv_pool_blocks,
                                        self.kv_block_tokens,
                                        decode.kv_cache_dtype)
+        if mesh is not None:
+            from kubeflow_tpu.serving import sharding
+
+            self._state = sharding.shard_paged_state(self._state, mesh)
         # Host-owned per-slot block tables, passed into every program
         # call; the sentinel value (== pool size) parks writes and
         # reads of unallocated logical pages.  Loop-thread-owned.
@@ -409,6 +459,10 @@ class DecodeEngine:
         self._chunk_exec = None
         self._step_exec = None
         self._verify_exec = None
+        # Disaggregated-serving KV import program (kv_import): built
+        # the first time a handoff payload arrives; runs once per
+        # imported request, never in the step loop.
+        self._import_exec = None
         # Drafting-scan backoff (loop-thread-owned): consecutive empty
         # scans stretch the scan period toward _SPEC_SCAN_STRIDE_MAX.
         self._spec_stride = 1
@@ -447,6 +501,7 @@ class DecodeEngine:
             "prefill_chunks": 0, "cached_tokens": 0, "prompt_tokens": 0,
             "spec_drafted": 0, "spec_accepted": 0, "spec_steps": 0,
             "kv_evictions": 0, "kv_shed_no_blocks": 0,
+            "handoff_pages_out": 0, "handoff_pages_in": 0,
         }
         self._step_times: List[float] = []   # bounded reservoirs
         self._chunk_times: List[float] = []
@@ -488,6 +543,10 @@ class DecodeEngine:
             SPEC_DRAFTED_TOTAL, SPEC_DRAFTED_HELP)
         self._spec_accepted_ctr = REGISTRY.counter(
             SPEC_ACCEPTED_TOTAL, SPEC_ACCEPTED_HELP)
+        self._mesh_gauge = REGISTRY.gauge(
+            MESH_DEVICES_GAUGE, MESH_DEVICES_HELP)
+        self._handoff_ctr = REGISTRY.counter(
+            HANDOFF_PAGES_TOTAL, HANDOFF_PAGES_HELP)
         # Fault-layer series: same names as the static batchers', so
         # shed/expired rates read uniformly across batching planes.
         self._shed_ctr = REGISTRY.counter(SHED_TOTAL, SHED_HELP)
@@ -496,6 +555,9 @@ class DecodeEngine:
         self._queue_gauge.set(0, engine=name)
         self._kv_blocks_gauge.set(self.kv_pool_blocks, engine=name)
         self._kv_used_gauge.set(0, engine=name)
+        from kubeflow_tpu.serving.sharding import mesh_devices
+
+        self._mesh_gauge.set(mesh_devices(mesh), engine=name)
         # Last values pushed to the gauges — the step loop only touches
         # the (locked) registry when a value actually changes.
         self._occ_last = 0
@@ -566,6 +628,22 @@ class DecodeEngine:
         if entry["err"] is not None:
             raise entry["err"]
         return entry["out"]
+
+    def prefill_export(self, inputs: Dict[str, Any],
+                       deadline: Optional[float] = None
+                       ) -> Dict[str, Any]:
+        """Disaggregated serving, prefill tier: admit the prompt as an
+        ordinary request clamped to ONE generated token (prefill is
+        the whole job — the single sampled token proves the pages are
+        complete and is recomputed by the decode tier anyway) and
+        return the result with its finished full-block pages attached
+        under ``kv_handoff`` (see _attach_export).  Prompts too short
+        to cover one full page return no payload — the caller falls
+        back to the untiered path."""
+        fwd = dict(inputs)
+        fwd["kv_export"] = True
+        fwd["max_new_tokens"] = 1
+        return self.submit(fwd, deadline=deadline)
 
     def submit_stream(self, inputs: Dict[str, Any],
                       deadline: Optional[float] = None):
@@ -677,6 +755,13 @@ class DecodeEngine:
         # headroom caps it further — both against the TRUE length.
         new = min(total_budget - resume_len, self.max_len - length)
         seed = int(np.asarray(inputs.get("seed", 0)).reshape(()))
+        # Disaggregated serving: ``kv_export`` marks a prefill-tier
+        # request whose result must carry its finished KV pages
+        # (:prefill route); ``kv_handoff`` is the decode-tier import
+        # payload those pages arrive as.  Both validated HERE so a
+        # malformed payload answers 400 before any device work.
+        export = bool(inputs.get("kv_export"))
+        handoff = self._parse_handoff(inputs.get("kv_handoff"), length)
         if deadline is not None and faults.monotonic() >= deadline:
             with self._lock:
                 self._counters["expired"] += 1
@@ -704,6 +789,7 @@ class DecodeEngine:
             "prefilling": False, "pos": 0, "cached": 0,
             "res_blocks": res_blocks, "res_left": 0, "blocks": [],
             "released": False,
+            "export": export, "handoff": handoff,
             # Adaptive draft width: grows on full accepts, shrinks on
             # full rejects; 0 = backed off (re-probes after cooldown).
             "spec_k": self.speculative_tokens, "spec_cool": 0,
@@ -797,10 +883,17 @@ class DecodeEngine:
         {"chunked_prefill": 1, "step": 1, "verify": 1} for its whole
         lifetime ("verify" stays 0 unless speculation is enabled AND a
         slot actually drafted).  There is no prefix-copy program:
-        shared-prefix reuse is host-side block-table aliasing."""
-        return {"chunked_prefill": int(self._chunk_exec is not None),
-                "step": int(self._step_exec is not None),
-                "verify": int(self._verify_exec is not None)}
+        shared-prefix reuse is host-side block-table aliasing.  A
+        decode-tier engine that has imported a disaggregated KV
+        handoff additionally reports ``kv_import`` (once compiled) —
+        the one-per-request page-scatter program; engines that never
+        see a handoff keep the exact three-key shape."""
+        out = {"chunked_prefill": int(self._chunk_exec is not None),
+               "step": int(self._step_exec is not None),
+               "verify": int(self._verify_exec is not None)}
+        if self._import_exec is not None:
+            out["kv_import"] = 1
+        return out
 
     def stats(self) -> Dict[str, Any]:
         """Locked snapshot of the engine counters: occupancy, queue
@@ -882,6 +975,13 @@ class DecodeEngine:
             "kv_utilization": round(
                 extra["kv_used"] / self.kv_pool_blocks, 4)
             if self.kv_pool_blocks else 0.0,
+            # Multi-chip serving: how many devices this engine's mesh
+            # spans (1 = single-device) and how many paged-KV pages
+            # have crossed the disaggregated prefill/decode boundary
+            # in each direction.
+            "mesh_devices": self._mesh_devices(),
+            "handoff_pages_out": c["handoff_pages_out"],
+            "handoff_pages_in": c["handoff_pages_in"],
             # Speculative decoding: drafted vs accepted tokens and the
             # per-verify-call yield.  accepted_per_step is the mean
             # EXTRA tokens a verify call delivered beyond the one a
@@ -956,6 +1056,12 @@ class DecodeEngine:
         self._set_queue_gauge(0)
         self._kv_blocks_gauge.set(0, engine=self._metric_name)
         self._set_kv_used_gauge(0)
+        self._mesh_gauge.set(0, engine=self._metric_name)
+
+    def _mesh_devices(self) -> int:
+        from kubeflow_tpu.serving.sharding import mesh_devices
+
+        return mesh_devices(self.mesh)
 
     # -- step loop --------------------------------------------------------
 
@@ -1067,10 +1173,173 @@ class DecodeEngine:
         longest cached prefix for free); None = the pool cannot cover
         it yet, leave the request at the queue head — retirements free
         pages, and FIFO order means a starving big request is never
-        jumped into starvation."""
+        jumped into starvation.  A request carrying a KV-handoff
+        payload skips the local prefix lookup (limit 0): its pages
+        arrive from the prefill tier and land in PRIVATE blocks, so
+        the whole worst case reserves."""
         prompt = entry["tokens"][0]
-        return self._mgr.admit(prompt, int(prompt.shape[0]) - 1,
-                               entry["res_blocks"])
+        limit = 0 if entry.get("handoff") else int(prompt.shape[0]) - 1
+        return self._mgr.admit(prompt, limit, entry["res_blocks"])
+
+    # -- disaggregated prefill/decode handoff -----------------------------
+
+    def _parse_handoff(self, payload, length: int):
+        """Validate + normalize a KV-handoff payload against THIS
+        engine's pool geometry; returns {"covered", "k", "v"} (pages
+        trimmed to the full blocks covering at most ``length - 1``
+        positions — at least one prompt token always recomputes
+        locally, which is what arms the slot's scalars through the
+        ordinary final prefill chunk), or None when there is nothing
+        importable.  Raises ValueError on a geometry/dtype mismatch —
+        a payload from a differently-configured prefill replica must
+        answer 400, not corrupt the pool."""
+        if payload is None:
+            return None
+        if not isinstance(payload, dict):
+            raise ValueError("kv_handoff must be an object")
+        bt = int(payload.get("block_tokens", 0))
+        if bt != self.kv_block_tokens:
+            raise ValueError(
+                f"kv_handoff block_tokens {bt} != engine page size "
+                f"{self.kv_block_tokens}")
+        int8 = self.decode.kv_cache_dtype == "int8"
+        page_shape = (self.cfg.n_layers, self.kv_block_tokens,
+                      self.cfg.n_kv_heads, self.cfg.head_dim)
+
+        def norm(side, raw):
+            if int8:
+                if not isinstance(raw, dict) or "values" not in raw \
+                        or "scale" not in raw:
+                    raise ValueError(
+                        f"kv_handoff {side}: engine pool is int8 — "
+                        f"payload needs values + scale")
+                vals = np.asarray(raw["values"], np.int8)
+                scale = np.asarray(raw["scale"], np.float32)
+                if scale.shape != vals.shape[:-1]:
+                    raise ValueError(
+                        f"kv_handoff {side}: scale {scale.shape} "
+                        f"must match values {vals.shape} minus the "
+                        f"trailing dim")
+                return vals, scale
+            if isinstance(raw, dict):
+                raise ValueError(
+                    f"kv_handoff {side}: engine pool is "
+                    f"{self.cfg.dtype} — got a quantized payload")
+            return np.asarray(raw), None
+
+        k_vals, k_scale = norm("k", payload.get("k"))
+        v_vals, v_scale = norm("v", payload.get("v"))
+        for side, vals in (("k", k_vals), ("v", v_vals)):
+            if vals.ndim != 5 or (vals.shape[0],) + vals.shape[2:] \
+                    != page_shape:
+                raise ValueError(
+                    f"kv_handoff {side} pages {vals.shape} do not "
+                    f"match pool pages [layers={page_shape[0]}, n, "
+                    f"block_tokens={page_shape[1]}, "
+                    f"hkv={page_shape[2]}, d={page_shape[3]}]")
+        if k_vals.shape[1] != v_vals.shape[1]:
+            raise ValueError("kv_handoff k/v page counts differ")
+        n = min(int(k_vals.shape[1]),
+                (int(length) - 1) // self.kv_block_tokens)
+        if n <= 0:
+            return None
+        return {
+            "covered": n * self.kv_block_tokens,
+            "k": (k_vals[:, :n], None if k_scale is None
+                  else k_scale[:, :n]),
+            "v": (v_vals[:, :n], None if v_scale is None
+                  else v_scale[:, :n]),
+        }
+
+    def _pad_pages(self, pages, span: int):
+        """Page stack [L, n, bt, hkv(, d)] -> the import program's
+        static [L, span, ...] shape (zero padding rides sentinel ids
+        and drops on device)."""
+        from kubeflow_tpu.ops.quantize import QTensor
+
+        vals, scale = pages
+        n = vals.shape[1]
+        dtype = (self.cfg.dtype if scale is None else np.int8)
+        pad = np.zeros(
+            (vals.shape[0], span) + vals.shape[2:], dtype)
+        pad[:, :n] = vals
+        if scale is None:
+            return pad
+        pad_s = np.zeros(
+            (scale.shape[0], span) + scale.shape[2:], np.float32)
+        pad_s[:, :n] = scale
+        return QTensor(pad, pad_s, (-1,))
+
+    def _import_handoff(self, entry: dict) -> None:
+        """Admission, handoff side (loop thread, slot claimed): take
+        the covered pages from the entry's reservation, scatter the
+        transferred page data into them (ONE kv_import program call —
+        the transfer unit is a block-page list, never a contiguous
+        slot region), and start chunked prefill at the covered offset
+        — from there the request is indistinguishable from a local
+        prefix-cache resume, which is what makes handoff import
+        token-identical to local prefill at every chunk boundary."""
+        from kubeflow_tpu.models.generate import import_kv_pages
+
+        handoff = entry["handoff"]
+        # Chaos hook: the decode-tier import path (sleep = slow
+        # cross-replica transfer, raise = import failure — the router
+        # surfaces it rather than hanging the tiered dispatch).
+        faults.fire("engine.kv_handoff")
+        self._ensure_cover(entry, handoff["covered"] - 1)
+        n = handoff["covered"] // self.kv_block_tokens
+        span = self._table_blocks
+        ids = np.full((span,), self.kv_pool_blocks, np.int32)
+        ids[:n] = entry["blocks"][:n]
+        pages_k = self._pad_pages(handoff["k"], span)
+        pages_v = self._pad_pages(handoff["v"], span)
+        if self._import_exec is None:
+            self._import_exec = import_kv_pages.lower(
+                self._state, pages_k, pages_v, ids).compile()
+        self._state = self._import_exec(
+            self._state, pages_k, pages_v, ids)
+        entry["pos"] = handoff["covered"]
+        with self._lock:
+            self._counters["handoff_pages_in"] += n
+        self._handoff_ctr.inc(n, engine=self._metric_name,
+                              direction="import")
+
+    def _attach_export(self, entry: dict) -> None:
+        """Delivery, prefill side (loop thread, pages still held):
+        gather the finished full-block prompt pages off the pool into
+        the response payload — the same normalized form
+        ``kv_handoff`` imports, so prefill and decode tiers stay
+        wire-symmetric.  Runs before release: the pages are still
+        slot-referenced, so nothing can overwrite them mid-gather."""
+        from kubeflow_tpu.ops.quantize import QTensor
+
+        true_len = int(entry["tokens"].shape[1])
+        n = min((true_len - 1) // self.kv_block_tokens,
+                len(entry["blocks"]))
+        if n <= 0:
+            return
+        # Chaos hook: the prefill-tier export path (raise = export
+        # failure at delivery; the router's tiered dispatch falls back
+        # to the untiered path).
+        faults.fire("engine.kv_handoff")
+        ids = np.asarray(entry["blocks"][:n], np.int32)
+
+        def gather(pool):
+            if isinstance(pool, QTensor):
+                return {"values": np.asarray(pool.values[:, ids]),
+                        "scale": np.asarray(pool.scale[:, ids])}
+            return np.asarray(pool[:, ids])
+
+        entry["out"]["kv_handoff"] = {
+            "block_tokens": self.kv_block_tokens,
+            "tokens_covered": n * self.kv_block_tokens,
+            "k": gather(self._state["cache_k"]),
+            "v": gather(self._state["cache_v"]),
+        }
+        with self._lock:
+            self._counters["handoff_pages_out"] += n
+        self._handoff_ctr.inc(n, engine=self._metric_name,
+                              direction="export")
 
     def _ensure_cover(self, entry: dict, upto_pos: int) -> None:
         """Grow the slot's block table to cover position ``upto_pos``,
@@ -1187,6 +1456,13 @@ class DecodeEngine:
                        "prompt_tokens": true_len,
                        "cached_tokens": cached,
                        "prefix": "hit" if cached else "miss"})
+        if entry.get("handoff"):
+            # Disaggregated decode tier: scatter the prefill tier's
+            # transferred pages into the reserved blocks, then chunk-
+            # prefill only the uncovered suffix (>= 1 token — the
+            # final chunk arms the slot exactly as a local prefill
+            # would).
+            self._import_handoff(entry)
         entry["prefilling"] = True
         self._prefill_chunk(entry)  # claim-time freeze + first chunk
         if entry["prefilling"]:
@@ -1264,6 +1540,11 @@ class DecodeEngine:
             [entry["tokens"],
              np.asarray(entry["emitted"], np.int32)[None]], axis=1)
         entry["out"] = {"tokens": out}
+        if entry.get("export"):
+            # Prefill-tier delivery: the finished pages ride the
+            # response (gathered before release, while the slot still
+            # holds them).
+            self._attach_export(entry)
         if entry["want_timing"]:
             now = faults.monotonic()
             entry["out"]["ttft_s"] = (

@@ -46,6 +46,12 @@ _ROUTES = [
     # with (docs §5.6).
     ("POST", re.compile(r"^/model/(?P<name>[^/:]+):generate$"),
      "generate"),
+    # Disaggregated serving, prefill tier: run the prompt's chunked
+    # prefill and answer with the finished KV pages as a wire-encoded
+    # ``kv_handoff`` payload (block-page list, docs §5.9) the router
+    # forwards into a decode-tier :generate body.
+    ("POST", re.compile(r"^/model/(?P<name>[^/:]+):prefill$"),
+     "prefill"),
     ("POST", re.compile(
         r"^/model/(?P<name>[^/:]+)/version/(?P<version>\d+):predict$"),
      "predict"),
@@ -92,6 +98,59 @@ def parse_deadline_ms(body: Dict[str, Any]) -> Optional[float]:
             f"deadline_ms must be a positive finite number, "
             f"got {deadline_ms}")
     return faults.monotonic() + deadline_ms / 1e3
+
+
+def _enc_arr(a: np.ndarray) -> Dict[str, Any]:
+    return {"b64": base64.b64encode(
+                np.ascontiguousarray(a).tobytes()).decode(),
+            "shape": list(a.shape), "dtype": str(a.dtype)}
+
+
+def _dec_arr(d: Any) -> np.ndarray:
+    if not isinstance(d, dict) or "b64" not in d:
+        raise ValueError("kv_handoff array must be "
+                         "{b64, shape, dtype}")
+    try:
+        raw = base64.b64decode(d["b64"])
+        arr = np.frombuffer(raw, dtype=np.dtype(str(d["dtype"])))
+        return arr.reshape([int(s) for s in d["shape"]])
+    except (ValueError, TypeError, KeyError) as e:
+        raise ValueError(f"malformed kv_handoff array: {e}") from None
+
+
+def encode_kv_handoff(payload: Dict[str, Any]) -> Dict[str, Any]:
+    """Engine-form KV handoff (numpy page stacks, serving/engine.py
+    _attach_export) -> JSON wire form: each array becomes
+    {b64, shape, dtype}.  The router never decodes this — it forwards
+    the :prefill response's payload verbatim into the decode-tier
+    :generate body; only the two engines' ends touch the bytes."""
+    def enc_side(side):
+        if isinstance(side, dict):  # int8: values + scale
+            return {"values": _enc_arr(side["values"]),
+                    "scale": _enc_arr(side["scale"])}
+        return _enc_arr(side)
+
+    return {"block_tokens": int(payload["block_tokens"]),
+            "tokens_covered": int(payload["tokens_covered"]),
+            "k": enc_side(payload["k"]),
+            "v": enc_side(payload["v"])}
+
+
+def decode_kv_handoff(wire: Any) -> Dict[str, Any]:
+    """Wire form -> the engine's normalized import form (the engine
+    re-validates geometry/dtype against its own pool)."""
+    if not isinstance(wire, dict):
+        raise ValueError("kv_handoff must be an object")
+
+    def dec_side(side):
+        if isinstance(side, dict) and "values" in side:
+            return {"values": _dec_arr(side.get("values")),
+                    "scale": _dec_arr(side.get("scale"))}
+        return _dec_arr(side)
+
+    return {"block_tokens": int(wire.get("block_tokens", 0)),
+            "k": dec_side(wire.get("k")),
+            "v": dec_side(wire.get("v"))}
 
 
 def decode_b64_if_needed(value: Any) -> Any:
@@ -207,8 +266,45 @@ class ServingAPI:
                     "resume_tokens"):
             if body.get(key) is not None:
                 inputs[key] = body[key]
+        if body.get("kv_handoff") is not None:
+            # Disaggregated decode tier: a prefill replica's exported
+            # pages ride the body; the engine imports them and chunk-
+            # prefills only the uncovered suffix.
+            inputs["kv_handoff"] = decode_kv_handoff(
+                body["kv_handoff"])
         return self.server.generate_stream(name, inputs,
                                            deadline=deadline)
+
+    def prefill(
+        self, name: str, body: Dict[str, Any],
+        version: Optional[int] = None,
+        idem_key: Optional[str] = None,
+    ) -> Dict[str, Any]:
+        """Disaggregated serving, prefill tier: chunk-prefill the
+        prompt on this replica's engine and answer with the finished
+        pages as a wire-encoded ``kv_handoff`` (block-page list).
+        ``kv_handoff`` is null when the prompt is too short to cover
+        one full page — the router then falls back to the untiered
+        path.  ``idem_key`` is accepted for signature parity with the
+        generic dispatch; prefill is pure, so replays are harmless
+        without dedup."""
+        tokens = body.get("tokens")
+        if tokens is None:
+            raise ValueError("Request json object must use the key: tokens")
+        deadline = parse_deadline_ms(body)
+        inputs: Dict[str, Any] = {"tokens": np.asarray(tokens, np.int32)}
+        for key in ("seed", "prompt_len"):
+            if body.get(key) is not None:
+                inputs[key] = body[key]
+        out = self.server.prefill_handoff(name, inputs,
+                                          deadline=deadline)
+        payload = out.get("kv_handoff")
+        return {
+            "kv_handoff": None if payload is None
+            else encode_kv_handoff(payload),
+            "tokens_covered": 0 if payload is None
+            else int(payload["tokens_covered"]),
+        }
 
     def classify(
         self, name: str, body: Dict[str, Any],
@@ -309,13 +405,19 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(200, {"status": "ok", "models": self.api.server.models()})
         elif action == "ready":
             server = self.api.server
+            # ``role`` advertises the disaggregation tier (prefill /
+            # decode / unified): the fleet registry's readiness probe
+            # reads it off this route, which is how the router learns
+            # the two-tier topology without any extra discovery hop.
             if server.is_ready():
                 self._send(200, {"status": "ready",
+                                 "role": server.role,
                                  "models": server.models()})
             else:
                 self._send(503, {
                     "status": "draining" if server.draining()
-                    else "no models loaded"})
+                    else "no models loaded",
+                    "role": server.role})
         elif action == "metrics":
             from kubeflow_tpu.runtime.prom import REGISTRY
 

@@ -39,7 +39,8 @@ def batcher_factory(*, micro_batch_size: int, batch_timeout_s: float,
                     prefix_caching: bool = True,
                     max_queue_depth: int = 0,
                     overload_retry_after_s: float = 1.0,
-                    speculative_tokens: int = 0):
+                    speculative_tokens: int = 0,
+                    mesh: str = ""):
     """ModelServer.enable_batching factory: picks the batcher per model.
 
     lm_generate models default to the continuous-batching DecodeEngine
@@ -51,6 +52,7 @@ def batcher_factory(*, micro_batch_size: int, batch_timeout_s: float,
     (build returns None -> direct predict path).  Rebuilt around every
     hot-swapped version by ModelServer.
     """
+    from kubeflow_tpu.serving import sharding
     from kubeflow_tpu.serving.engine import DecodeEngine
     from kubeflow_tpu.serving.model_server import (
         BucketedLMBatcher,
@@ -62,6 +64,10 @@ def batcher_factory(*, micro_batch_size: int, batch_timeout_s: float,
     if not sizes or sizes[-1] != micro_batch_size:
         sizes.append(micro_batch_size)
     buckets = [int(b) for b in lm_buckets.split(",") if b.strip()]
+    # Parsed once (fail fast on a typo'd --mesh), built per engine:
+    # the mesh object itself is cheap, and a rebuilt engine after
+    # hot-swap must re-place its params on the same devices anyway.
+    mesh_axes = sharding.parse_mesh_flag(mesh)
 
     def build(model):
         spec = getattr(model.predict, "engine_spec", None)
@@ -104,6 +110,7 @@ def batcher_factory(*, micro_batch_size: int, batch_timeout_s: float,
                     max_queue_depth=max_queue_depth,
                     overload_retry_after_s=overload_retry_after_s,
                     speculative_tokens=speculative_tokens,
+                    mesh=sharding.build_mesh(mesh_axes),
                     name=f"{model.name}-v{model.version}")
             logging.warning(
                 "decode engine disabled for %r: max_new_tokens %d "
@@ -230,6 +237,24 @@ def main(argv=None) -> int:
                          "traffic.  Greedy exports only (sampling "
                          "exports fall back to plain decode); 0 "
                          "disables")
+    ap.add_argument("--mesh", default="",
+                    help="serving mesh spec, e.g. 'tensor=4': shard "
+                         "the DecodeEngine's params and paged KV pool "
+                         "over that many local devices (regex "
+                         "partition rules, serving/sharding.py) so "
+                         "one model spans a pod slice.  Empty = "
+                         "single-device.  On CPU, simulate chips "
+                         "with XLA_FLAGS="
+                         "--xla_force_host_platform_device_count=N")
+    ap.add_argument("--role", default="unified",
+                    choices=("unified", "prefill", "decode"),
+                    help="disaggregated-serving tier, advertised on "
+                         "/readyz: 'prefill' replicas serve :prefill "
+                         "(chunked prefill into KV handoff pages), "
+                         "'decode' replicas import handoffs and "
+                         "stream; the fleet router pipelines "
+                         ":generate across the two pools.  'unified' "
+                         "(default) keeps the single-tier path")
     ap.add_argument("--max_queue_depth", type=int, default=256,
                     help="bounded admission: submissions beyond this "
                          "many pending requests per model fail fast "
@@ -286,7 +311,8 @@ def main(argv=None) -> int:
         max_inflight=args.max_inflight,
         overload_retry_after_s=args.overload_retry_after_s,
         dedup_capacity=args.dedup_capacity,
-        dedup_ttl_s=args.dedup_ttl_s)
+        dedup_ttl_s=args.dedup_ttl_s,
+        role=args.role)
     server.add_model(args.model_name, args.model_base_path)
     # The factory is installed whenever ANY batching path might apply:
     # lm_generate models default to the continuous DecodeEngine even
@@ -313,6 +339,7 @@ def main(argv=None) -> int:
                 max_queue_depth=args.max_queue_depth,
                 overload_retry_after_s=args.overload_retry_after_s,
                 speculative_tokens=args.speculative_tokens,
+                mesh=args.mesh,
             ),
         )
         logging.info(

@@ -260,7 +260,19 @@ class ModelServer:
                  max_inflight: int = 0,
                  overload_retry_after_s: float = 1.0,
                  dedup_capacity: int = 1024,
-                 dedup_ttl_s: float = 120.0):
+                 dedup_ttl_s: float = 120.0,
+                 role: str = "unified"):
+        if role not in ("unified", "prefill", "decode"):
+            raise ValueError(
+                f"role must be unified/prefill/decode, got {role!r}")
+        # Disaggregated-serving tier (--role): advertised on /readyz so
+        # the fleet registry learns the two-tier topology — "prefill"
+        # replicas serve :prefill into KV handoff payloads, "decode"
+        # replicas import them, "unified" (default) replicas keep
+        # today's single-tier path.  The role is an ADVERTISEMENT, not
+        # a gate: every replica still answers every route, so a
+        # degraded fleet can always fall back to the untiered path.
+        self.role = role
         self._models: Dict[str, Dict[int, LoadedModel]] = {}
         self._base_paths: Dict[str, str] = {}
         self._lock = threading.RLock()
@@ -718,6 +730,36 @@ class ModelServer:
             raise DeadlineExceeded(
                 f"deadline expired before direct dispatch of {name!r}")
         return model.predict(inputs)
+
+    def prefill_handoff(
+        self, name: str, inputs: Dict[str, Any],
+        deadline: Optional[float] = None,
+    ) -> Dict[str, Any]:
+        """Disaggregated serving, prefill tier: run the prompt's
+        chunked prefill on this replica's DecodeEngine and return the
+        result WITH its finished KV pages (``kv_handoff``) so a
+        decode-tier replica can import them and stream the completion
+        (the :prefill route).  Raises KeyError on unknown models and
+        ValueError when the model has no engine.  Bracketed in the
+        in-flight counts like any predict."""
+        self.get(name)  # KeyError -> 404 on unknown names
+        with self._lock:
+            batcher = self._batchers.get(name)
+        export_fn = getattr(batcher, "prefill_export", None)
+        if export_fn is None:
+            raise ValueError(
+                f"model {name!r} has no decode engine "
+                f"(:prefill requires the continuous-batching engine)")
+        with self._lock:
+            self._inflight += 1
+            self._inflight_by_model[name] = \
+                self._inflight_by_model.get(name, 0) + 1
+        try:
+            return export_fn(inputs, deadline=deadline)
+        finally:
+            with self._lock:
+                self._inflight -= 1
+                self._inflight_by_model[name] -= 1
 
     def generate_stream(
         self, name: str, inputs: Dict[str, Any],

@@ -1,0 +1,260 @@
+"""Serving-side mesh construction and param/KV-pool partitioning.
+
+The training stack already shards over a ``jax.sharding.Mesh``
+(parallel/mesh.py); this module is the SERVING half of that story: one
+model spanning several chips of a pod slice, so per-replica batch
+capacity multiplies and a model bigger than one chip's HBM still
+serves.  Three pieces:
+
+  mesh        ``build_mesh({"tensor": N})`` — a 1-D serving mesh over
+              the first N local devices (the ``--mesh tensor=N`` flag;
+              multi-device on CPU via
+              ``XLA_FLAGS=--xla_force_host_platform_device_count``,
+              the same trick the MULTICHIP dryruns and the test
+              conftest use).  Tensor parallelism is latency-bound and
+              must ride adjacent-ICI links, which is why serving
+              exposes exactly one axis: ``jax.devices()`` orders
+              contiguous runs ICI-adjacent, so a 1-D reshape lands
+              the whole axis on neighbouring chips (and a
+              disaggregated fleet keeps each pool's collectives on
+              its OWN links instead of contending across tiers).
+
+  rules       ``match_partition_rules(rules, params)`` — regex rules
+              over '/'-joined param-tree paths to PartitionSpecs, the
+              pattern the big JAX LM codebases converged on.
+              ``LM_PARTITION_RULES`` is the megatron-style layout for
+              models/generate.py's param tree: attention heads and MLP
+              hidden column-split, output projections row-split (XLA
+              inserts the all-reduce after the row-parallel matmul),
+              vocab split on the embedding table.  A dim that does not
+              divide the mesh axis degrades to replicated (with a
+              warning) instead of erroring — tiny smoke models shard
+              what they can.
+
+  placement   ``shard_params`` / ``shard_paged_state`` device_put the
+              param tree and the engine's paged KV block pool with
+              NamedShardings.  The pool ([layers, blocks, block_tokens,
+              hkv, d], fp or int8 QTensor) shards on the KV-HEAD dim:
+              block indices stay replicated, so the HOST-owned block
+              tables — and every scatter/gather through them — are
+              unchanged, and the three AOT programs (chunked prefill /
+              step / verify) compile tensor-parallel from the argument
+              shardings alone.  Per-slot scalars replicate.
+
+Everything here is host-side setup that runs once at engine
+construction; nothing touches the step loop.
+"""
+
+from __future__ import annotations
+
+import logging
+import re
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from kubeflow_tpu.ops.quantize import QTensor
+
+log = logging.getLogger(__name__)
+
+# The serving mesh axis.  parallel/mesh.py's 6-axis order exists for
+# training; decode wants exactly the innermost (tensor) axis.
+TENSOR = "tensor"
+
+PartitionRules = Sequence[Tuple[str, PartitionSpec]]
+
+# Megatron-style tensor-parallel layout for the LM param tree
+# (models/generate.py _forward_with_cache; layers stacked on a leading
+# scan axis).  Column-parallel projections split their OUTPUT dim
+# (wq heads, wkv kv-heads, MLP hidden); row-parallel projections split
+# their INPUT dim (wo heads, MLP down) so the all-reduce lands after
+# the matmul; the (tied) embedding table splits on vocab.  Norm scales
+# and anything unmatched replicate via the catch-all.
+LM_PARTITION_RULES: PartitionRules = (
+    # [L, e, h, d] — attention query projection, heads split.
+    (r"layers/attn/wq$", PartitionSpec(None, None, TENSOR, None)),
+    # [L, 2, e, hkv, d] — fused k/v projection, kv-heads split (must
+    # match the KV pool's head sharding: the cache columns a head
+    # writes live on the shard that computed them).
+    (r"layers/attn/wkv$", PartitionSpec(None, None, None, TENSOR, None)),
+    # [L, h, d, e] — output projection, row-parallel over heads.
+    (r"layers/attn/wo$", PartitionSpec(None, TENSOR, None, None)),
+    # [L, 2, e, f] — gate/up projections, hidden split.
+    (r"layers/mlp/wi$", PartitionSpec(None, None, None, TENSOR)),
+    # [L, f, e] — down projection, row-parallel over hidden.
+    (r"layers/mlp/wo$", PartitionSpec(None, TENSOR, None)),
+    # [V, e] — embedding (and tied LM head), vocab split.
+    (r"embed$", PartitionSpec(TENSOR, None)),
+    # [e, V] — untied LM head, vocab split.
+    (r"w_out$", PartitionSpec(None, TENSOR)),
+)
+
+
+def parse_mesh_flag(spec: str) -> Dict[str, int]:
+    """``--mesh`` grammar: ``axis=N[,axis=N...]`` — today the only
+    serving axis is ``tensor`` (``"tensor=4"``).  Empty string means
+    no mesh (single-device engine, exactly the pre-mesh behavior)."""
+    out: Dict[str, int] = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise ValueError(
+                f"mesh axis {part!r} must be axis=N (e.g. tensor=4)")
+        axis, _, n = part.partition("=")
+        axis = axis.strip()
+        if axis != TENSOR:
+            raise ValueError(
+                f"unknown serving mesh axis {axis!r} (serving shards "
+                f"over {TENSOR!r} only; training meshes live in "
+                f"parallel/mesh.py)")
+        try:
+            size = int(n)
+        except ValueError:
+            raise ValueError(
+                f"mesh axis size {n!r} is not an integer") from None
+        if size < 1:
+            raise ValueError(f"mesh axis size must be >= 1, got {size}")
+        out[axis] = size
+    return out
+
+
+def build_mesh(axes: Dict[str, int],
+               devices: Optional[Sequence[jax.Device]] = None,
+               ) -> Optional[Mesh]:
+    """A 1-D serving mesh over the first ``tensor`` local devices, or
+    None when the spec is empty / size 1 (single-device engines take
+    the untouched pre-mesh path — the mesh layer is strictly
+    additive)."""
+    size = int(axes.get(TENSOR, 1)) if axes else 1
+    if size <= 1:
+        return None
+    devs = list(devices if devices is not None else jax.devices())
+    if size > len(devs):
+        raise ValueError(
+            f"mesh tensor={size} exceeds the {len(devs)} visible "
+            f"devices (on CPU, force more with XLA_FLAGS="
+            f"--xla_force_host_platform_device_count={size})")
+    return Mesh(np.asarray(devs[:size]), (TENSOR,))
+
+
+def _path_str(path) -> str:
+    """'/'-joined tree path: dict keys, dataclass attrs, and sequence
+    indices all normalize to bare tokens so the regex rules read like
+    file paths (``layers/attn/wq``)."""
+    parts: List[str] = []
+    for key in path:
+        s = jax.tree_util.keystr((key,))
+        parts.append(s.strip(".[]'\""))
+    return "/".join(parts)
+
+
+def match_partition_rules(rules: PartitionRules, params):
+    """PartitionSpec per leaf by first regex match over the leaf's
+    '/'-joined path (the fmengine/EasyLM pattern).  Scalars and
+    unmatched leaves replicate; a matched spec whose rank exceeds the
+    leaf's (e.g. a QTensor ``scale`` companion riding its values
+    rule) degrades to replicated rather than erroring."""
+    compiled = [(re.compile(pat), spec) for pat, spec in rules]
+
+    def leaf_spec(path, leaf):
+        ndim = getattr(leaf, "ndim", 0)
+        if ndim == 0 or int(np.prod(leaf.shape)) == 1:
+            return PartitionSpec()
+        pstr = _path_str(path)
+        for pat, spec in compiled:
+            if pat.search(pstr):
+                if len(spec) > ndim:
+                    return PartitionSpec()
+                return spec
+        return PartitionSpec()
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    return jax.tree_util.tree_unflatten(
+        treedef, [leaf_spec(p, l) for p, l in flat])
+
+
+def _divisible(spec: PartitionSpec, shape, mesh: Mesh,
+               what: str) -> PartitionSpec:
+    """Degrade each sharded dim that does not divide its mesh-axis
+    size to replicated: tiny models (smoke configs, CPU e2e) shard
+    the dims they can and replicate the rest, instead of failing the
+    whole engine construction."""
+    out = []
+    changed = False
+    for i, axis in enumerate(spec):
+        if axis is None:
+            out.append(None)
+            continue
+        size = int(np.prod([mesh.shape[a] for a in (
+            (axis,) if isinstance(axis, str) else axis)]))
+        if shape[i] % size:
+            log.warning(
+                "sharding %s: dim %d (size %d) does not divide mesh "
+                "axis %r (size %d); replicating that dim", what, i,
+                shape[i], axis, size)
+            out.append(None)
+            changed = True
+        else:
+            out.append(axis)
+    return PartitionSpec(*out) if changed else spec
+
+
+def shard_params(params, mesh: Mesh,
+                 rules: PartitionRules = LM_PARTITION_RULES):
+    """device_put the param tree onto the mesh under the rule table.
+    Int8-quantized weights ride along: a QTensor's ``values`` leaf
+    matches its param's rule (the path ends ``.../wq/values``) and its
+    lower-rank ``scale`` replicates via the rank guard."""
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    spec_flat = jax.tree_util.tree_leaves(
+        match_partition_rules(rules, params))
+    placed = []
+    for (path, leaf), spec in zip(flat, spec_flat):
+        spec = _divisible(spec, leaf.shape, mesh, _path_str(path))
+        placed.append(jax.device_put(leaf, NamedSharding(mesh, spec)))
+    return jax.tree_util.tree_unflatten(treedef, placed)
+
+
+def _pool_spec(arr, mesh: Mesh, what: str) -> NamedSharding:
+    """Paged-pool sharding: [L, blocks, block_tokens, hkv(, d)] —
+    shard the KV-HEAD dim (index 3), replicate block geometry so the
+    host-owned block tables address every shard identically.  An int8
+    pool's ``scale`` companion ([L, blocks, bt, hkv]) shards the same
+    head dim at rank 4."""
+    spec = [None, None, None, TENSOR] + [None] * (arr.ndim - 4)
+    return NamedSharding(
+        mesh, _divisible(PartitionSpec(*spec), arr.shape, mesh, what))
+
+
+def shard_paged_state(state: Dict, mesh: Mesh) -> Dict:
+    """Place the engine's paged state dict (models/generate.py
+    init_paged_state): the KV block pool shards on kv-heads, per-slot
+    scalars replicate.  Donation-compatible — every program's output
+    sharding matches its input's, so the buffers recycle in place."""
+    out = {}
+    for key, value in state.items():
+        if key in ("cache_k", "cache_v"):
+            if isinstance(value, QTensor):
+                out[key] = QTensor(
+                    jax.device_put(value.values, _pool_spec(
+                        value.values, mesh, f"{key}.values")),
+                    jax.device_put(value.scale, _pool_spec(
+                        value.scale, mesh, f"{key}.scale")),
+                    value.axes)
+            else:
+                out[key] = jax.device_put(
+                    value, _pool_spec(value, mesh, key))
+        else:
+            out[key] = jax.device_put(
+                value, NamedSharding(mesh, PartitionSpec()))
+    return out
+
+
+def mesh_devices(mesh: Optional[Mesh]) -> int:
+    """Device count an engine spans (1 = single-device) — the
+    kft_engine_mesh_devices gauge value."""
+    return int(mesh.devices.size) if mesh is not None else 1

@@ -1382,6 +1382,279 @@ def survivable_smoke(namespace: str = "kubeflow-test") -> None:
                 srv.stop()
 
 
+def multichip_serving_smoke(namespace: str = "kubeflow-test") -> None:
+    """Hermetic multi-chip serving scenario (§5.9) over a forced
+    multi-device host platform:
+
+      1. topology — a PREFILL-role replica and a DECODE-role replica
+         (its engine tensor-parallel over a 2-device mesh,
+         serving/sharding.py) behind the fleet router; the registry
+         learns both tiers off /readyz;
+      2. tiered :generate — streams through the router pipeline
+         prefill-then-decode (the prompt's KV pages cross as a
+         block-page handoff payload) and every token stream is
+         IDENTICAL to a unified single-tier control replica's;
+      3. handoff counters — kft_engine_handoff_pages_total
+         {direction="export"} on the prefill replica and
+         {direction="import"} on the decode replica move as /metrics
+         deltas, as do kft_router_tier_requests_total{tier};
+      4. decode-pool death mid-handoff — with the only decode
+         replica dead, a tiered :generate sheds typed 429 Overloaded
+         (Retry-After set) instead of hanging or 502ing.
+
+    Needs >= 4 local devices; when the current process initialized
+    JAX single-device (standalone CI runs), it re-execs itself in a
+    subprocess with ``--xla_force_host_platform_device_count=4`` —
+    the same trick the test conftest and MULTICHIP dryruns use.
+    """
+    import os
+    import sys
+
+    import jax
+
+    if jax.device_count() < 4:
+        import subprocess
+
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        flags = env.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            env["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=4"
+            ).strip()
+        proc = subprocess.run(
+            [sys.executable, "-m", "kubeflow_tpu.testing.e2e",
+             "multichip_serving", "--namespace", namespace],
+            env=env, timeout=600)
+        assert proc.returncode == 0, (
+            f"multichip_serving re-exec failed rc={proc.returncode}")
+        return
+
+    import json
+    import tempfile
+    import urllib.request
+
+    import numpy as np
+
+    from kubeflow_tpu.fleet.endpoints import (
+        Endpoint,
+        EndpointRegistry,
+        StaticEndpoints,
+    )
+    from kubeflow_tpu.fleet.router import FleetRouter, make_router_server
+    from kubeflow_tpu.models.transformer import Transformer
+    from kubeflow_tpu.runtime.prom import parse_metrics, sample_value
+    from kubeflow_tpu.serving.export import export
+    from kubeflow_tpu.serving.http import make_http_server
+    from kubeflow_tpu.serving.loaders import _model_config
+    from kubeflow_tpu.serving.main import batcher_factory
+    from kubeflow_tpu.serving.model_server import ModelServer
+
+    overrides = {"vocab_size": 96, "d_model": 32, "n_layers": 2,
+                 "n_heads": 4, "n_kv_heads": 4, "d_ff": 64,
+                 "head_dim": 8, "max_seq_len": 64, "dtype": "float32"}
+    max_new = 10
+    rng = np.random.RandomState(20260804)
+    prompts = [rng.randint(1, 96, size=(n,)).tolist()
+               for n in (9, 12, 16)]
+
+    import socket
+    import threading
+    from http.server import ThreadingHTTPServer
+
+    class KillableServer(ThreadingHTTPServer):
+        """shutdown() only stops accepting; a dead pod also resets
+        every ESTABLISHED socket (including the router's pooled
+        keep-alive upstreams) — kill() reproduces that signature."""
+
+        def __init__(self, *a, **kw):
+            super().__init__(*a, **kw)
+            self._live = set()
+            self._live_lock = threading.Lock()
+
+        def process_request(self, request, client_address):
+            with self._live_lock:
+                self._live.add(request)
+            super().process_request(request, client_address)
+
+        def shutdown_request(self, request):
+            with self._live_lock:
+                self._live.discard(request)
+            super().shutdown_request(request)
+
+        def kill(self):
+            self.shutdown()
+            self.server_close()
+            with self._live_lock:
+                live = list(self._live)
+            for sock in live:
+                try:
+                    sock.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+
+    def make_replica(base, role, mesh=""):
+        server = ModelServer(role=role)
+        server.add_model("lm", base)
+        server.enable_batching("lm", batcher_factory(
+            micro_batch_size=0, batch_timeout_s=0.005,
+            lm_engine=True, lm_engine_slots=2,
+            lm_engine_prefill_len=32, kv_block_tokens=4,
+            max_queue_depth=16, mesh=mesh))
+        httpd, _ = make_http_server(server, port=0, host="127.0.0.1",
+                                    server_cls=KillableServer)
+        return server, httpd
+
+    def stream_via(port, body, path="/model/lm:generate",
+                   timeout=180):
+        import http.client
+
+        conn = http.client.HTTPConnection("127.0.0.1", port,
+                                          timeout=timeout)
+        conn.request("POST", path, json.dumps(body).encode(),
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        if resp.status != 200:
+            payload = resp.read()
+            conn.close()
+            return resp.status, dict(resp.headers.items()), payload
+        tokens = []
+        terminal = None
+        while True:
+            line = resp.readline()
+            if not line:
+                break
+            line = line.strip()
+            if not line:
+                continue
+            msg = json.loads(line)
+            if "tokens" in msg:
+                tokens.extend(msg["tokens"])
+            if "done" in msg or "error" in msg:
+                terminal = msg
+                break
+        conn.close()
+        return 200, tokens, terminal
+
+    def scrape(port):
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics",
+                timeout=30) as resp:
+            return parse_metrics(resp.read().decode())
+
+    model = Transformer(_model_config(overrides))
+    variables = model.init(jax.random.key(0),
+                           np.zeros((1, 4), np.int32))
+    servers = []
+    router_httpd = None
+    with tempfile.TemporaryDirectory() as tmp:
+        export(f"{tmp}/lm", 1, variables,
+               loader="kubeflow_tpu.serving.loaders:lm_generate",
+               config={"model": overrides, "max_new_tokens": max_new,
+                       "temperature": 0.0})
+        try:
+            pre_srv, pre_httpd = make_replica(f"{tmp}/lm", "prefill")
+            dec_srv, dec_httpd = make_replica(f"{tmp}/lm", "decode",
+                                              mesh="tensor=2")
+            uni_srv, uni_httpd = make_replica(f"{tmp}/lm", "unified")
+            servers = [(pre_srv, pre_httpd), (dec_srv, dec_httpd),
+                       (uni_srv, uni_httpd)]
+            pre_port = pre_httpd.server_address[1]
+            dec_port = dec_httpd.server_address[1]
+            uni_port = uni_httpd.server_address[1]
+            # The fleet is the two TIERS; the unified replica stays
+            # outside as the single-tier control.
+            registry = EndpointRegistry(StaticEndpoints([
+                Endpoint(name="pre-0",
+                         url=f"http://127.0.0.1:{pre_port}"),
+                Endpoint(name="dec-0",
+                         url=f"http://127.0.0.1:{dec_port}"),
+            ]), probe_interval_s=0.2, eject_threshold=2)
+            registry.refresh()
+            tiers = {s.name: s.tier for s in registry.all()}
+            assert tiers == {"pre-0": "prefill", "dec-0": "decode"}, (
+                f"registry failed to learn tiers: {tiers}")
+            router = FleetRouter(registry, max_tries=3,
+                                 try_timeout_s=60.0)
+            router_httpd, _ = make_router_server(router, port=0,
+                                                 host="127.0.0.1")
+            rport = router_httpd.server_address[1]
+
+            pre0 = scrape(pre_port)
+            dec0 = scrape(dec_port)
+            r0 = scrape(rport)
+
+            # --- tiered streams match the unified control exactly ---
+            for prompt in prompts:
+                body = {"tokens": prompt}
+                st, want, wterm = stream_via(uni_port, body)
+                assert st == 200 and wterm.get("done"), (st, wterm)
+                st, got, gterm = stream_via(rport, body)
+                assert st == 200, (st, got)
+                assert gterm.get("done"), gterm
+                assert got == want, (
+                    f"tiered stream diverged from unified control "
+                    f"for len {len(prompt)}: {got} != {want}")
+
+            # --- handoff + tier counters moved as deltas ------------
+            def delta(before, after, name, **labels):
+                b = sample_value(before, name, **labels) or 0
+                a = sample_value(after, name, **labels) or 0
+                return a - b
+
+            pre1, dec1, r1 = (scrape(pre_port), scrape(dec_port),
+                              scrape(rport))
+            exported = delta(pre0, pre1,
+                             "kft_engine_handoff_pages_total",
+                             engine="lm-v1", direction="export")
+            imported = delta(dec0, dec1,
+                             "kft_engine_handoff_pages_total",
+                             engine="lm-v1", direction="import")
+            assert exported > 0, "no pages exported by prefill tier"
+            assert imported > 0, "no pages imported by decode tier"
+            assert delta(r0, r1, "kft_router_tier_requests_total",
+                         tier="prefill") == len(prompts)
+            assert delta(r0, r1, "kft_router_tier_requests_total",
+                         tier="decode") == len(prompts)
+            # Per-replica (the three in-process replicas share one
+            # prom registry, so the engine-labeled gauge aliases —
+            # the :stats route is per-server truth).
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{dec_port}/model/lm:stats",
+                    timeout=30) as resp:
+                dec_stats = json.loads(resp.read())["batcher"]
+            assert dec_stats["mesh_devices"] == 2, dec_stats
+            assert dec_stats["handoff_pages_in"] > 0
+            assert dec_stats["compiled_programs"]["kv_import"] == 1
+
+            # --- decode-pool death mid-handoff: typed Overloaded ----
+            dec_httpd.kill()
+            # The registry still lists the decode tier as routable
+            # (no probe ran since the kill), so the router commits to
+            # the tiered path, the prefill leg succeeds, and the dead
+            # decode pool must shed typed 429 — never hang or 502.
+            st, headers, payload = stream_via(rport,
+                                              {"tokens": prompts[0]})
+            assert st == 429, (st, payload)
+            assert "Retry-After" in headers, headers
+            r2 = scrape(rport)
+            assert delta(r1, r2, "kft_router_requests_total",
+                         outcome="shed", code="429") >= 1
+        finally:
+            if router_httpd is not None:
+                router_httpd.shutdown()
+            for srv, httpd in servers:
+                try:
+                    httpd.shutdown()
+                except Exception:
+                    pass
+                srv.stop()
+
+
 def scheduler_smoke(namespace: str = "kubeflow-test") -> None:
     """Hermetic multi-tenant scheduler scenario: two tenants' TPUJobs
     through the fake apiserver (real sockets, HttpKube) against the
@@ -1914,6 +2187,7 @@ COMMANDS = {
     "faults": fault_injection_smoke,
     "fleet": fleet_smoke,
     "survivable": survivable_smoke,
+    "multichip_serving": multichip_serving_smoke,
     "scheduler": scheduler_smoke,
     "train": train_smoke,
     "train_resilience": train_resilience_smoke,

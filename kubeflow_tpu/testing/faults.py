@@ -37,6 +37,17 @@ Hook sites planted in production code (grep for ``faults.fire``):
                       (prompt + tokens a prior attempt delivered,
                       the router's mid-generation failover payload;
                       sleep = slow failover, raise = resume rejected)
+    engine.kv_handoff disaggregated prefill/decode page transfer —
+                      fired on the prefill tier's export gather and
+                      the decode tier's import scatter (sleep = slow
+                      cross-replica transfer, raise = handoff
+                      failure; the router surfaces it rather than
+                      hanging the tiered dispatch)
+    router.tier_dispatch
+                      the router's tiered prefill-then-decode
+                      dispatch decision for a :generate (raise =
+                      tier routing failure — the request must fall
+                      back to the untiered path, never hang or 500)
     fleet.probe       endpoint registry readiness probe attempt
     scheduler.admit   cluster scheduler admission-plan pass (skew =
                       age the queue / expire preemption windows,

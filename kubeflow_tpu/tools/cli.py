@@ -174,14 +174,17 @@ def cmd_fleet_status(args: argparse.Namespace) -> int:
     if not rows:
         print("no endpoints discovered")
         return 0
-    fmt = "{:<20} {:<10} {:<10} {:>9} {:>12} {:>7} {:>9}"
-    print(fmt.format("ENDPOINT", "STATE", "BREAKER", "INFLIGHT",
-                     "QUEUE_DEPTH", "CACHE%", "FAILURES"))
+    fmt = "{:<20} {:<10} {:<8} {:<10} {:>9} {:>12} {:>7} {:>9}"
+    print(fmt.format("ENDPOINT", "STATE", "TIER", "BREAKER",
+                     "INFLIGHT", "QUEUE_DEPTH", "CACHE%", "FAILURES"))
     for row in rows:
         # Prefix-cache effectiveness per replica (engine models only;
-        # replicas that predate the metric report "-").
+        # replicas that predate the metric report "-").  TIER is the
+        # disaggregation role the replica advertises on /readyz
+        # (prefill/decode/unified — §5.9); pre-tier routers report "-".
         ratio = row.get("cached_token_ratio")
         print(fmt.format(row["name"], row["state"],
+                         row.get("tier", "-"),
                          row.get("breaker_state", "-"),
                          int(row["inflight"]),
                          int(row["queue_depth"]),

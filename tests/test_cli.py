@@ -166,11 +166,13 @@ def test_fleet_status_renders_endpoint_table(capsys):
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
     rows = [{"name": "srv-0", "url": "http://10.0.0.5:8000",
-             "state": "routable", "inflight": 3.0, "queue_depth": 1.0,
+             "state": "routable", "tier": "prefill",
+             "inflight": 3.0, "queue_depth": 1.0,
              "local_inflight": 0, "breaker_failures": 0,
              "breaker_state": "closed"},
             {"name": "srv-1", "url": "http://10.0.0.6:8000",
-             "state": "ejected", "inflight": 0.0, "queue_depth": 0.0,
+             "state": "ejected", "tier": "decode",
+             "inflight": 0.0, "queue_depth": 0.0,
              "local_inflight": 0, "breaker_failures": 4,
              "breaker_state": "half_open"}]
     payload = {"endpoints": rows,
@@ -197,7 +199,11 @@ def test_fleet_status_renders_endpoint_table(capsys):
         assert rc == 0
         out = capsys.readouterr().out
         assert "BREAKER" in out
+        # Disaggregation tier column (§5.9): the role each replica
+        # advertises on /readyz, probed by the router's registry.
+        assert "TIER" in out
         assert "srv-0" in out and "routable" in out and "closed" in out
+        assert "prefill" in out and "decode" in out
         assert "srv-1" in out and "ejected" in out \
             and "half_open" in out
         # Router-wide failover budget footer.

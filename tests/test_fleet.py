@@ -51,6 +51,16 @@ class _Replica:
         self.gen_tokens = list(range(100, 115))
         self.gen_die_after = None
         self.gen_meta = {"resumable": True, "seeded": False}
+        # Disaggregation tier advertised on /readyz (None = omit the
+        # key, the pre-tier wire shape) and the scripted :prefill
+        # answer — the payload is OPAQUE to the router, which only
+        # forwards it into the decode-tier :generate body.
+        self.role = None
+        self.prefill_status = 200
+        self.prefill_payload = {
+            "block_tokens": 4, "tokens_covered": 8,
+            "k": {"b64": "AA==", "shape": [1], "dtype": "uint8"},
+            "v": {"b64": "AA==", "shape": [1], "dtype": "uint8"}}
         self.lock = threading.Lock()
         replica = self
 
@@ -74,12 +84,15 @@ class _Replica:
 
             def do_GET(self):
                 if self.path == "/readyz":
+                    extra = {} if replica.role is None \
+                        else {"role": replica.role}
                     if replica.ready and not replica.draining:
-                        self._send(200, {"status": "ready"})
+                        self._send(200, dict(
+                            {"status": "ready"}, **extra))
                     else:
-                        self._send(503, {
-                            "status": "draining" if replica.draining
-                            else "no models loaded"})
+                        self._send(503, dict(
+                            {"status": "draining" if replica.draining
+                             else "no models loaded"}, **extra))
                 elif self.path == "/metrics":
                     text = (
                         f"kft_serving_inflight {replica.inflight}\n"
@@ -128,6 +141,14 @@ class _Replica:
                     # Bytes were received, then the connection dies —
                     # the transport-failure (replay-eligible) case.
                     self._die()
+                    return
+                if self.path.endswith(":prefill"):
+                    self._send(replica.prefill_status, {
+                        "kv_handoff": replica.prefill_payload,
+                        "tokens_covered": 0 if not
+                        replica.prefill_payload else
+                        replica.prefill_payload.get(
+                            "tokens_covered", 0)})
                     return
                 if self.path.endswith(":generate"):
                     payload = json.loads(body or b"{}")
@@ -856,6 +877,147 @@ class TestStreamingFailover:
             tracing.disable()
             dying.kill()
             survivor.kill()
+
+
+def _tier_ctr(tier):
+    from kubeflow_tpu.runtime.prom import (
+        REGISTRY,
+        parse_metrics,
+        sample_value,
+    )
+
+    return sample_value(parse_metrics(REGISTRY.render()),
+                        "kft_router_tier_requests_total",
+                        tier=tier) or 0
+
+
+class TestTieredRouting:
+    """Disaggregated prefill/decode topology (§5.9): replicas
+    advertise --role on /readyz, the registry learns the tier, and the
+    router pipelines :generate prefill-then-decode — falling back to
+    the untiered path on ANY prefill-leg failure and shedding typed
+    429 Overloaded when the decode pool dies mid-handoff."""
+
+    def _fleet(self):
+        pre, dec, uni = _Replica(), _Replica(), _Replica()
+        pre.role = "prefill"
+        dec.role = "decode"
+        reg = _registry([pre, dec, uni])
+        return pre, dec, uni, reg
+
+    def _kill(self, *reps):
+        for r in reps:
+            try:
+                r.kill()
+            except Exception:
+                pass
+
+    def test_registry_learns_tiers(self):
+        pre, dec, uni, reg = self._fleet()
+        try:
+            tiers = {s.name: s.tier for s in reg.all()}
+            assert tiers == {"r0": "prefill", "r1": "decode",
+                             "r2": "unified"}
+            rows = {r["name"]: r["tier"] for r in reg.describe()}
+            assert rows == tiers
+        finally:
+            self._kill(pre, dec, uni)
+
+    def test_generate_pipelines_prefill_then_decode(self):
+        pre, dec, uni, reg = self._fleet()
+        try:
+            router = _router(reg)
+            p0, d0 = _tier_ctr("prefill"), _tier_ctr("decode")
+            plain, sink = _stream(router)
+            assert plain is None
+            assert sink.tokens() == dec.gen_tokens
+            # The prefill pool got exactly the :prefill leg...
+            assert [p for p, _ in pre.received()] \
+                == ["/model/m:prefill"]
+            # ...and the decode replica's :generate body carries the
+            # handoff payload VERBATIM (the router never decodes it).
+            path, body = dec.received()[0]
+            assert path == "/model/m:generate"
+            assert json.loads(body)["kv_handoff"] \
+                == pre.prefill_payload
+            # The unified replica stayed out of the tiered pipeline.
+            assert uni.received() == []
+            assert _tier_ctr("prefill") == p0 + 1
+            assert _tier_ctr("decode") == d0 + 1
+        finally:
+            self._kill(pre, dec, uni)
+
+    def test_prefill_failure_falls_back_untiered(self):
+        pre, dec, uni, reg = self._fleet()
+        pre.prefill_status = 500
+        try:
+            router = _router(reg)
+            u0 = _tier_ctr("unified")
+            plain, sink = _stream(router)
+            assert plain is None
+            assert sink.tokens() == dec.gen_tokens
+            # Untiered fallback: no :generate body grew a handoff key.
+            for r in (pre, dec, uni):
+                for path, body in r.received():
+                    if path.endswith(":generate"):
+                        assert "kv_handoff" not in json.loads(body)
+            assert _tier_ctr("unified") == u0 + 1
+        finally:
+            self._kill(pre, dec, uni)
+
+    def test_short_prompt_null_handoff_falls_back(self):
+        pre, dec, uni, reg = self._fleet()
+        pre.prefill_payload = None  # prompt under one full page
+        try:
+            router = _router(reg)
+            plain, sink = _stream(router)
+            assert plain is None
+            assert sink.tokens() == dec.gen_tokens
+            for r in (pre, dec, uni):
+                for path, body in r.received():
+                    if path.endswith(":generate"):
+                        assert "kv_handoff" not in json.loads(body)
+        finally:
+            self._kill(pre, dec, uni)
+
+    def test_decode_death_mid_handoff_sheds_429_not_hang(self):
+        """The ONLY decode replica dies mid-handoff: force-ejected,
+        the replay pick finds no decode-tier candidate, and the
+        stream terminates with a typed 429 Overloaded line — one-tier
+        overload is capacity to retry into, never a hang or a 502."""
+        pre, dec, uni, reg = self._fleet()
+        dec.gen_die_after = 2
+        try:
+            router = _router(reg)
+            plain, sink = _stream(router)
+            assert plain is None  # the 200 stream had begun
+            last = sink.lines[-1]
+            assert last.get("code") == 429, sink.lines
+            # Proof of death, not weather: ejected immediately.
+            state = [s for s in reg.all() if s.name == "r1"][0]
+            assert state.breaker.open
+        finally:
+            self._kill(pre, dec, uni)
+
+    def test_tier_dispatch_fault_falls_back(self):
+        pre, dec, uni, reg = self._fleet()
+        try:
+            router = _router(reg)
+            inj = faults.parse("router.tier_dispatch:raise")
+            faults.install(inj)
+            try:
+                plain, sink = _stream(router)
+            finally:
+                faults.install(None)
+            assert inj.fired("router.tier_dispatch") == 1
+            assert plain is None
+            assert sink.tokens() == dec.gen_tokens
+            # The scripted tier failure skipped the prefill leg
+            # entirely; the request served untiered.
+            assert pre.received() == [] or not any(
+                p.endswith(":prefill") for p, _ in pre.received())
+        finally:
+            self._kill(pre, dec, uni)
 
 
 class TestAutoscaler:

@@ -8,6 +8,7 @@ from kubeflow_tpu.testing.e2e import (
     engine_smoke,
     fault_injection_smoke,
     fleet_smoke,
+    multichip_serving_smoke,
     scheduler_smoke,
     serving_smoke,
     survivable_smoke,
@@ -115,6 +116,17 @@ class TestE2EDrivers:
         # kft_serving_dedup_hits_total move as /metrics deltas (see
         # kubeflow_tpu/testing/e2e.py survivable_smoke).
         survivable_smoke()
+
+    def test_multichip_serving_smoke(self):
+        # The ci/e2e_config.yaml hermetic `multichip_serving` step:
+        # prefill + decode tiers behind the router over the forced
+        # multi-device host platform (the conftest's 8 fake chips) —
+        # tiered :generate streams identical to a unified control,
+        # block-page handoff counters moving as /metrics deltas, the
+        # decode replica's engine tensor-parallel over a 2-device
+        # mesh, and decode-pool death shedding typed 429 (see
+        # kubeflow_tpu/testing/e2e.py multichip_serving_smoke).
+        multichip_serving_smoke()
 
     def test_train_resilience_smoke(self):
         # The ci/e2e_config.yaml hermetic `train_resilience` step:
