@@ -1737,6 +1737,223 @@ def _bench_speculative(spec, rng, cfg, on_tpu, DecodeEngine):
     }
 
 
+def _bench_fused_decode(spec, rng, cfg, on_tpu, DecodeEngine):
+    """Fused-decode probe: device-resident multi-step rounds
+    (``decode_rounds``, docs §5.2e) vs the per-step dispatch loop.
+
+    Two measurements:
+
+      * dispatch_overhead — raw AOT programs, no engine: at batch
+        width 1/4/8, run the same N×k decode steps as k dispatches of
+        ``decode_step`` vs ONE ``decode_rounds`` dispatch per round.
+        perf_counter brackets split each round into host-dispatch wall
+        (time for the call(s) to return — async enqueue cost) and
+        total wall including the final ``block_until_ready``.  The
+        per-round delta unfused-minus-fused is the per-step dispatch
+        tax the while_loop eliminates.
+      * engine-level headline — fused (decode_rounds=8) vs unfused
+        (decode_rounds=1) engines on the same seeded concurrent
+        workload, interleaved windows (ordering-bias discipline from
+        the speculation probe): delivered tok/s ratio, plus a
+        token-IDENTITY check over the full request set (greedy fused
+        decode must be bit-for-bit the per-step loop).
+
+    On the CPU smoke box a decode step is compute-bound and XLA runs
+    the while_loop body at the same per-step cost, so the engine
+    ratio hovers near parity there — the number that moves is the
+    dispatch-overhead fraction; on real accelerators the eliminated
+    per-step host round trips multiply delivered tok/s (same caveat
+    discipline as the paged-KV probe's cpu_compute_bound_note)."""
+    import dataclasses
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kubeflow_tpu.models.generate import (
+        decode_rounds,
+        decode_step,
+        init_paged_state,
+        prefill_chunk_into_slot,
+    )
+
+    k = 8
+    if on_tpu:
+        rounds_n, probe_new = 16, 64
+        eng_slots, prefill, n_requests, windows, workers = 8, 64, 24, 2, 8
+    else:
+        rounds_n, probe_new = 6, 32
+        eng_slots, prefill, n_requests, windows, workers = 4, 16, 12, 3, 4
+    probe_new = min(probe_new, cfg.max_seq_len - prefill)
+    dec = dataclasses.replace(spec["decode"], temperature=0.0,
+                              eos_token=-1,
+                              max_new_tokens=rounds_n * k + 1)
+
+    # --- dispatch-overhead probe: raw programs, one pool per batch
+    # width.  Budget rounds_n*k+1 and eos -1 keep every slot live for
+    # the whole sweep, so fused rounds run full width (the early-exit
+    # path is the tests' job; here both sides execute identical
+    # step counts).
+    bt = 16
+    tb = cfg.max_seq_len // bt
+    steps_room = min(rounds_n * k, cfg.max_seq_len - prefill - 1)
+    sweep_rounds = max(1, steps_room // k)
+
+    def dispatch_probe(b):
+        state = init_paged_state(cfg, b, b * tb, bt)
+        tables = np.arange(b * tb, dtype=np.int32).reshape(b, tb)
+        for s in range(b):
+            prompt = rng.randint(1, cfg.vocab_size,
+                                 size=(1, prefill)).astype(np.int32)
+            state, _ = prefill_chunk_into_slot(
+                cfg, spec["params"], state, dec, prompt,
+                np.int32(0), np.int32(prefill),
+                np.int32(steps_room + 1), np.int32(s), np.int32(0),
+                jnp.asarray(tables[s:s + 1]))
+        tab = jnp.asarray(tables)
+        step_exec = decode_step.lower(
+            cfg, spec["params"], state, dec, 1, tab).compile()
+        rounds_exec = decode_rounds.lower(
+            cfg, spec["params"], state, dec, k, tab,
+            np.int32(k)).compile()
+
+        def timed(fused, st):
+            dispatch = total = 0.0
+            for _ in range(sweep_rounds):
+                t0 = time.perf_counter()
+                if fused:
+                    st, toks, _, _ = rounds_exec(
+                        spec["params"], st, tab, np.int32(k))
+                else:
+                    for _ in range(k):
+                        st, toks = step_exec(spec["params"], st, tab)
+                dispatch += time.perf_counter() - t0
+                jax.block_until_ready(toks)
+                total += time.perf_counter() - t0
+            return st, dispatch, total
+
+        # Warm each executable on its own fresh copy (a shared warmup
+        # state would arrive at the fused warm already done and
+        # early-exit without ever running the loop body).
+        timed(False, jax.tree_util.tree_map(lambda x: x.copy(), state))
+        timed(True, jax.tree_util.tree_map(lambda x: x.copy(), state))
+        st = jax.tree_util.tree_map(lambda x: x.copy(), state)
+        st, unf_disp, unf_total = timed(False, st)
+        st = jax.tree_util.tree_map(lambda x: x.copy(), state)
+        st, fus_disp, fus_total = timed(True, st)
+        per_round = 1000.0 / sweep_rounds
+        return {
+            "rounds": sweep_rounds,
+            "steps_per_round": k,
+            "unfused_ms_per_round": round(unf_total * per_round, 3),
+            "fused_ms_per_round": round(fus_total * per_round, 3),
+            "unfused_dispatch_ms_per_round":
+                round(unf_disp * per_round, 3),
+            "fused_dispatch_ms_per_round":
+                round(fus_disp * per_round, 3),
+            # The per-step dispatch tax fusing eliminates, as a
+            # fraction of the unfused round.
+            "dispatch_overhead_fraction": round(
+                max(0.0, unf_total - fus_total) / unf_total, 3)
+            if unf_total else 0.0,
+            "fused_round_speedup": round(unf_total / fus_total, 3)
+            if fus_total else 0.0,
+        }
+
+    overhead = {f"batch_{b}": dispatch_probe(b) for b in (1, 4, 8)}
+
+    # --- engine-level headline: fused vs unfused engines, same
+    # seeded request set, interleaved windows.
+    eng_dec = dataclasses.replace(spec["decode"],
+                                  max_new_tokens=probe_new)
+    prompts = [rng.randint(1, cfg.vocab_size,
+                           size=(prefill,)).astype(np.int32)
+               for _ in range(n_requests)]
+
+    def make_engine(rounds, label):
+        engine = DecodeEngine(
+            spec["cfg"], spec["params"], eng_dec, slots=eng_slots,
+            prefill_len=prefill, prefill_chunk_tokens=prefill,
+            prefix_caching=False, sync_lag=0, decode_rounds=rounds,
+            name=f"bench-fused-{label}")
+        engine.submit({"tokens": prompts[0], "max_new_tokens": 4})
+        return engine
+
+    def window(engine):
+        sem = threading.Semaphore(workers)
+
+        def client(prompt):
+            with sem:
+                engine.submit({"tokens": prompt,
+                               "max_new_tokens": probe_new})
+
+        threads = [threading.Thread(target=client, args=(p,))
+                   for p in prompts]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return n_requests * probe_new / (time.perf_counter() - t0)
+
+    fused_engine = make_engine(k, "on")
+    plain_engine = make_engine(1, "off")
+    fused_rates, plain_rates = [], []
+    try:
+        for w in range(windows):
+            first, second = ((fused_engine, plain_engine) if w % 2 == 0
+                             else (plain_engine, fused_engine))
+            r1, r2 = window(first), window(second)
+            if first is fused_engine:
+                fused_rates += [r1]
+                plain_rates += [r2]
+            else:
+                plain_rates += [r1]
+                fused_rates += [r2]
+        # Token identity over the whole request set, OUTSIDE the timed
+        # windows: greedy fused decode is bit-for-bit the k=1 loop.
+        identical = all(
+            np.array_equal(
+                fused_engine.submit({"tokens": p,
+                                     "max_new_tokens": probe_new}
+                                    )["tokens"],
+                plain_engine.submit({"tokens": p,
+                                     "max_new_tokens": probe_new}
+                                    )["tokens"])
+            for p in prompts[:4])
+        fused_stats = fused_engine.stats()
+        programs = fused_engine.compiled_programs()
+    finally:
+        fused_engine.close()
+        plain_engine.close()
+
+    fused_tok_s, plain_tok_s = max(fused_rates), max(plain_rates)
+    speedup = fused_tok_s / plain_tok_s if plain_tok_s else 0.0
+    print(f"fused decode: {fused_tok_s:.1f} tok/s fused(k={k}) vs "
+          f"{plain_tok_s:.1f} unfused ({speedup:.2f}x), "
+          f"{fused_stats['fused_rounds']} rounds, steps/round p50 "
+          f"{fused_stats['steps_per_round_p50']}, batch-8 dispatch "
+          f"overhead "
+          f"{overhead['batch_8']['dispatch_overhead_fraction']}, "
+          f"identity={'OK' if identical else 'FAIL'}",
+          file=sys.stderr)
+    return {
+        "decode_rounds": k,
+        "tok_s_fused": round(fused_tok_s, 1),
+        "tok_s_unfused": round(plain_tok_s, 1),
+        "speedup": round(speedup, 3),
+        "tokens_identical": identical,
+        "fused_rounds": fused_stats["fused_rounds"],
+        "fused_steps_wasted": fused_stats["fused_steps_wasted"],
+        "steps_per_round_p50": fused_stats["steps_per_round_p50"],
+        "steps_per_round_p99": fused_stats["steps_per_round_p99"],
+        "compiled_programs_fused": programs,
+        "dispatch_overhead": overhead,
+        **({} if on_tpu else {"cpu_compute_bound_note": True}),
+    }
+
+
 def bench_lm_engine(args, devices, n_chips, on_tpu):
     """Continuous-batching DecodeEngine vs the static BucketedLMBatcher
     on ONE mixed open-loop workload.
@@ -1960,6 +2177,13 @@ def bench_lm_engine(args, devices, n_chips, on_tpu):
         multichip_serving = _bench_multichip_serving(
             spec, rng, cfg, on_tpu, DecodeEngine)
 
+        # --- fused-decode probe: decode_rounds while_loop rounds vs
+        # the per-step dispatch loop — raw-program dispatch-overhead
+        # brackets at batch 1/4/8 plus the engine-level delivered
+        # tok/s ratio with a token-identity check (§5.2e).
+        fused_decode = _bench_fused_decode(
+            spec, rng, cfg, on_tpu, DecodeEngine)
+
     eng_rates = [w["rate"] for w in engine_windows]
     bat_rates = [w["rate"] for w in batcher_windows]
     eng_tok_s, bat_tok_s = max(eng_rates), max(bat_rates)
@@ -2013,6 +2237,8 @@ def bench_lm_engine(args, devices, n_chips, on_tpu):
             "paged_kv": paged_kv,
             "tracing_overhead": tracing_overhead,
             "multichip_serving": multichip_serving,
+            "fused_decode": fused_decode,
+            "dispatch_overhead": fused_decode["dispatch_overhead"],
             "mean_slot_occupancy": engine_stats["mean_occupancy"],
             "slots": slots,
             "steps_per_call": spc,

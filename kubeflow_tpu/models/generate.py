@@ -582,6 +582,48 @@ def import_kv_pages(state, pages_k, pages_v, ids):
     return state
 
 
+def _advance_slots(cfg: TransformerConfig, params, decode: DecodeConfig,
+                   tables: jax.Array, park, state):
+    """One batched decode step over every slot: the shared body of
+    ``decode_step`` and ``decode_rounds``.  Returns (state, nxt [S])
+    where ``nxt`` is the sampled token per slot (0 for frozen slots).
+    ``park`` is the column past the table span where retired slots
+    aim their dropped cache writes."""
+    lengths, done = state["lengths"], state["done"]
+    advance = ~done
+    # Retired slots park their write past the table span; the
+    # block scatter drops it.
+    write_cols = jnp.where(advance, lengths, park)
+    logits, (ck, cv) = _forward_with_cache(
+        cfg, params, state["last_token"][:, None],
+        (state["cache_k"], state["cache_v"]), lengths,
+        write_cols=write_cols, tables=tables)
+    last = logits[:, -1]
+    if decode.temperature <= 0.0:
+        nxt = jnp.argmax(last, axis=-1)
+        keys = state["keys"]
+    else:
+        # Per-slot keys, split per step: slot r's sample stream
+        # depends only on its own seed and step index, never on
+        # which other requests happen to share the batch.
+        split = jax.vmap(jax.random.split)(state["keys"])
+        keys, subs = split[:, 0], split[:, 1]
+        nxt = jax.vmap(jax.random.categorical)(
+            subs, _filter_logits(decode, last))
+    nxt = jnp.where(advance, nxt.astype(jnp.int32), 0)
+    new_lengths = lengths + advance.astype(jnp.int32)
+    new_done = done | (new_lengths >= state["stop_len"])
+    if decode.eos_token >= 0:
+        new_done = new_done | (advance & (nxt == decode.eos_token))
+    state = dict(state)
+    state["cache_k"], state["cache_v"] = ck, cv
+    state["lengths"] = new_lengths
+    state["last_token"] = nxt
+    state["done"] = new_done
+    state["keys"] = keys
+    return state, nxt
+
+
 @partial(jax.jit, static_argnums=(0, 3, 4), donate_argnums=(2,))
 def decode_step(cfg: TransformerConfig, params, state,
                 decode: DecodeConfig, steps: int, tables: jax.Array):
@@ -605,45 +647,68 @@ def decode_step(cfg: TransformerConfig, params, state,
     park = tables.shape[1] * _pool_block_tokens(state["cache_k"])
 
     def one(state, _):
-        lengths, done = state["lengths"], state["done"]
-        advance = ~done
-        # Retired slots park their write past the table span; the
-        # block scatter drops it.
-        write_cols = jnp.where(advance, lengths, park)
-        logits, (ck, cv) = _forward_with_cache(
-            cfg, params, state["last_token"][:, None],
-            (state["cache_k"], state["cache_v"]), lengths,
-            write_cols=write_cols, tables=tables)
-        last = logits[:, -1]
-        if decode.temperature <= 0.0:
-            nxt = jnp.argmax(last, axis=-1)
-            keys = state["keys"]
-        else:
-            # Per-slot keys, split per step: slot r's sample stream
-            # depends only on its own seed and step index, never on
-            # which other requests happen to share the batch.
-            split = jax.vmap(jax.random.split)(state["keys"])
-            keys, subs = split[:, 0], split[:, 1]
-            nxt = jax.vmap(jax.random.categorical)(
-                subs, _filter_logits(decode, last))
-        nxt = jnp.where(advance, nxt.astype(jnp.int32), 0)
-        new_lengths = lengths + advance.astype(jnp.int32)
-        new_done = done | (new_lengths >= state["stop_len"])
-        if decode.eos_token >= 0:
-            new_done = new_done | (advance & (nxt == decode.eos_token))
-        state = dict(state)
-        state["cache_k"], state["cache_v"] = ck, cv
-        state["lengths"] = new_lengths
-        state["last_token"] = nxt
-        state["done"] = new_done
-        state["keys"] = keys
-        return state, nxt
+        return _advance_slots(cfg, params, decode, tables, park, state)
 
     if steps == 1:  # skip the scan wrapper on the canonical path
         state, toks = one(state, None)
         return state, toks[None]
     state, toks = jax.lax.scan(one, state, None, length=steps)
     return state, toks
+
+
+@partial(jax.jit, static_argnums=(0, 3, 4), donate_argnums=(2,))
+def decode_rounds(cfg: TransformerConfig, params, state,
+                  decode: DecodeConfig, k: int, tables: jax.Array,
+                  max_steps: jax.Array):
+    """Device-resident multi-step decode: up to ``k`` decode steps in
+    ONE dispatch via ``lax.while_loop``, with device-side early exit
+    the moment every slot is done (EOS/budget) — the host never pays
+    per-step dispatch, and a round that finishes all slots at step 3
+    stops at step 3 instead of burning k-3 dead forwards.
+
+    Returns ``(state, toks, counts, steps_run)``:
+
+    - ``toks`` [S, k] int32, slot-major: slot s's tokens for this
+      round occupy ``toks[s, :counts[s]]`` contiguously (a live slot
+      advances every step from round start until it freezes, so its
+      emissions never leave gaps), matching the verify drain's
+      ``(arr, snapshot, counts)`` stream shape.
+    - ``counts`` [S] int32: tokens emitted per slot (EOS included).
+    - ``steps_run`` scalar int32: loop iterations actually executed.
+
+    ``k`` is static (it sizes the output buffer and is the ceiling one
+    compiled program serves); ``max_steps`` is a TRACED operand the
+    host clamps per round, so adaptive round width reuses this single
+    executable instead of compiling one program per width.  Block
+    tables ride in unchanged as the host-owned snapshot — the host
+    must pre-cover every slot for the worst case (``k`` new positions)
+    before dispatch.  Per-step math is ``_advance_slots``, the same
+    body ``decode_step`` runs, so greedy tokens are bit-identical to
+    k single-step dispatches; under a mesh the loop body partitions
+    exactly like ``decode_step`` does.
+    """
+    park = tables.shape[1] * _pool_block_tokens(state["cache_k"])
+    slots = state["done"].shape[0]
+    len0 = state["lengths"]
+    cap = jnp.minimum(jnp.asarray(max_steps, jnp.int32),
+                      jnp.int32(k))
+
+    def cond(carry):
+        i, state, _ = carry
+        return (i < cap) & ~jnp.all(state["done"])
+
+    def body(carry):
+        i, state, out = carry
+        state, nxt = _advance_slots(cfg, params, decode, tables, park,
+                                    state)
+        return i + 1, state, out.at[:, i].set(nxt)
+
+    steps_run, state, toks = jax.lax.while_loop(
+        cond, body,
+        (jnp.zeros((), jnp.int32), state,
+         jnp.zeros((slots, k), jnp.int32)))
+    counts = state["lengths"] - len0
+    return state, toks, counts, steps_run
 
 
 @partial(jax.jit, static_argnums=(0, 3, 4), donate_argnums=(2,))

@@ -146,6 +146,14 @@ HANDOFF_PAGES_TOTAL = "kft_engine_handoff_pages_total"
 HANDOFF_PAGES_HELP = \
     "paged-KV pages transferred for disaggregated prefill/decode " \
     "handoff, by engine and direction (export/import)"
+FUSED_ROUNDS_TOTAL = "kft_engine_fused_rounds_total"
+FUSED_ROUNDS_HELP = \
+    "fused multi-step decode rounds dispatched (decode_rounds > 1), " \
+    "by engine"
+FUSED_WASTED_TOTAL = "kft_engine_fused_steps_wasted_total"
+FUSED_WASTED_HELP = \
+    "fused-round slot-steps dispatched but not delivered (early-exit " \
+    "waste past a slot's EOS/budget/deadline), by engine"
 
 # N-gram drafter bounds: suffixes of up to _SPEC_NGRAM_MAX tokens are
 # matched against the request's own history, down to _SPEC_NGRAM_MIN.
@@ -177,6 +185,17 @@ _SPEC_SCAN_STRIDE_MAX = 8
 _SPEC_RATE_MARGIN = 0.95
 _SPEC_PROBE_EVERY = 4
 _SPEC_RATE_ALPHA = 0.3
+
+# Fused decode rounds (decode_rounds > 1): shrink the adaptive round
+# width when more than this fraction of a round's dispatched slot-steps
+# delivered nothing (early-exit waste: slots frozen at EOS/budget while
+# co-resident slots keep stepping), or when an admission is queued
+# (smaller rounds reach the admission boundary sooner); grow back one
+# step per full, waste-free round — the PR 7 adaptive-width discipline
+# applied to the round dimension.  The pace EMA smooths the per-token
+# step latency used to clamp the width under live deadlines.
+_ROUND_WASTE_FRAC = 0.25
+_ROUND_PACE_ALPHA = 0.2
 
 
 _NO_DRAFT = np.empty((0,), np.int32)
@@ -338,6 +357,7 @@ class DecodeEngine:
         max_len: Optional[int] = None,
         sync_lag: int = 2,
         steps_per_call: int = 1,
+        decode_rounds: int = 1,
         admit_width: int = 4,
         prefill_chunk_tokens: int = 64,
         kv_block_tokens: int = 16,
@@ -389,6 +409,14 @@ class DecodeEngine:
                 f"{cfg.max_seq_len}")
         self.sync_lag = max(0, int(sync_lag))
         self.steps_per_call = max(1, int(steps_per_call))
+        # Fused multi-step decode (docs §5.2e): > 1 replaces the
+        # per-step dispatch loop with ONE decode_rounds program call
+        # advancing every slot up to decode_rounds steps, draining
+        # synchronously at each round boundary (sync_lag applies only
+        # to the k=1 path — the round's overlap window supersedes the
+        # lagged read).  1 keeps the classic loop bit-for-bit and
+        # compiles no new program.
+        self.decode_rounds = max(1, int(decode_rounds))
         self.admit_width = max(1, min(int(admit_width), slots))
         self.prefill_chunk_tokens = max(1, int(prefill_chunk_tokens))
         self.chunk_w = min(self.prefill_chunk_tokens, self.prefill_len)
@@ -463,6 +491,21 @@ class DecodeEngine:
         # the first time a handoff payload arrives; runs once per
         # imported request, never in the step loop.
         self._import_exec = None
+        # Fused decode rounds (decode_rounds > 1): the while_loop
+        # executable, the double-buffered device-side block-table
+        # snapshot (re-uploaded in the overlap window; any host-table
+        # mutation marks it dirty), the table sharding the SPMD
+        # executable expects (None = pass the host array per dispatch),
+        # the adaptive round width, the realized steps-per-round
+        # reservoir, and the per-token pace EMA the deadline clamp
+        # reads.  All loop-thread-owned.
+        self._rounds_exec = None
+        self._tables_dev = None
+        self._tables_dirty = True
+        self._tables_sharding = None
+        self._round_k = self.decode_rounds
+        self._round_steps: List[int] = []
+        self._step_pace_ema: Optional[float] = None
         # Drafting-scan backoff (loop-thread-owned): consecutive empty
         # scans stretch the scan period toward _SPEC_SCAN_STRIDE_MAX.
         self._spec_stride = 1
@@ -502,6 +545,7 @@ class DecodeEngine:
             "spec_drafted": 0, "spec_accepted": 0, "spec_steps": 0,
             "kv_evictions": 0, "kv_shed_no_blocks": 0,
             "handoff_pages_out": 0, "handoff_pages_in": 0,
+            "fused_rounds": 0, "fused_steps_wasted": 0,
         }
         self._step_times: List[float] = []   # bounded reservoirs
         self._chunk_times: List[float] = []
@@ -547,6 +591,10 @@ class DecodeEngine:
             MESH_DEVICES_GAUGE, MESH_DEVICES_HELP)
         self._handoff_ctr = REGISTRY.counter(
             HANDOFF_PAGES_TOTAL, HANDOFF_PAGES_HELP)
+        self._fused_rounds_ctr = REGISTRY.counter(
+            FUSED_ROUNDS_TOTAL, FUSED_ROUNDS_HELP)
+        self._fused_wasted_ctr = REGISTRY.counter(
+            FUSED_WASTED_TOTAL, FUSED_WASTED_HELP)
         # Fault-layer series: same names as the static batchers', so
         # shed/expired rates read uniformly across batching planes.
         self._shed_ctr = REGISTRY.counter(SHED_TOTAL, SHED_HELP)
@@ -887,12 +935,18 @@ class DecodeEngine:
         decode-tier engine that has imported a disaggregated KV
         handoff additionally reports ``kv_import`` (once compiled) —
         the one-per-request page-scatter program; engines that never
-        see a handoff keep the exact three-key shape."""
+        see a handoff keep the exact three-key shape.  An engine built
+        with ``decode_rounds > 1`` reports ``decode_rounds`` once the
+        fused while_loop program compiles (ONE executable serves every
+        adaptive width — the per-round step cap is a traced operand);
+        the k=1 path never compiles it."""
         out = {"chunked_prefill": int(self._chunk_exec is not None),
                "step": int(self._step_exec is not None),
                "verify": int(self._verify_exec is not None)}
         if self._import_exec is not None:
             out["kv_import"] = 1
+        if self._rounds_exec is not None:
+            out["decode_rounds"] = 1
         return out
 
     def stats(self) -> Dict[str, Any]:
@@ -910,6 +964,7 @@ class DecodeEngine:
                 "chunk_times": list(self._chunk_times),
                 "gap_times": list(self._gap_times),
                 "ttft_times": list(self._ttft_times),
+                "round_steps": list(self._round_steps),
             })
         steps = c["steps"]
 
@@ -922,6 +977,7 @@ class DecodeEngine:
         gaps = sorted(extra["gap_times"])
         chunks = sorted(extra["chunk_times"])
         ttfts = sorted(extra["ttft_times"])
+        rounds = sorted(extra["round_steps"])
 
         def pct(sorted_values, q):
             if not sorted_values:
@@ -929,6 +985,12 @@ class DecodeEngine:
             return round(sorted_values[min(len(sorted_values) - 1,
                                            int(len(sorted_values) * q))]
                          * 1e3, 3)
+
+        def pct_raw(sorted_values, q):
+            if not sorted_values:
+                return 0
+            return sorted_values[min(len(sorted_values) - 1,
+                                     int(len(sorted_values) * q))]
 
         prompt_toks = c["prompt_tokens"]
         return {
@@ -997,6 +1059,17 @@ class DecodeEngine:
             "accepted_per_step": round(
                 c["spec_accepted"] / c["spec_steps"], 3)
             if c["spec_steps"] else 0.0,
+            # Fused decode rounds (docs §5.2e): rounds dispatched,
+            # early-exit slot-steps that delivered nothing, and the
+            # realized steps-per-round distribution — how much of the
+            # configured width the device actually ran before every
+            # slot froze.  decode_rounds == 1 is the classic per-step
+            # dispatch loop (all three stay at zero).
+            "decode_rounds": self.decode_rounds,
+            "fused_rounds": c["fused_rounds"],
+            "fused_steps_wasted": c["fused_steps_wasted"],
+            "steps_per_round_p50": pct_raw(rounds, 0.50),
+            "steps_per_round_p99": pct_raw(rounds, 0.99),
             # Which AOT programs exist — the four-program guarantee,
             # observable over the :stats route (the hermetic engine
             # e2e asserts it end to end).
@@ -1102,6 +1175,7 @@ class DecodeEngine:
                 # advancing harmlessly, but every write now drops —
                 # its freed pages can be reallocated immediately.
                 self._tables[i][:] = self.kv_pool_blocks
+                self._tables_dirty = True
                 self._release_entry_locked(entry)
                 self._counters["in_flight"] -= 1
                 expired.append(entry)
@@ -1363,6 +1437,7 @@ class DecodeEngine:
                 entry["blocks"].append(blk)
                 entry["res_left"] -= 1
             rec_d, blk_d = self._flush_evictions_locked()
+            self._tables_dirty = True
         if rec_d:
             self._evict_ctr.inc(rec_d, engine=self._metric_name)
         if blk_d:
@@ -1381,6 +1456,7 @@ class DecodeEngine:
         row = self._tables[entry["slot"]]
         row[target:n] = self.kv_pool_blocks
         with self._lock:
+            self._tables_dirty = True
             tail = entry["blocks"][target:]
             del entry["blocks"][target:]
             entry["res_left"] += len(tail)
@@ -1575,10 +1651,12 @@ class DecodeEngine:
         Three emission shapes ride the one stream: a prefill's [1]
         first token (counts None, col 0), a decode call's
         [steps, slots] grid (counts None — every live slot emitted one
-        token per fused step), and a verify call's [slots, k+1] grid
-        with a per-slot ``counts`` vector (speculation emits a
-        VARIABLE number of tokens per slot per call — the accepted
-        prefix plus its free token, cut at EOS/budget on device)."""
+        token per fused step), and a slot-major grid with a per-slot
+        ``counts`` vector for the VARIABLE-count programs — a verify
+        call's [slots, k+1] accepted prefixes plus free token, and a
+        fused decode round's [slots, k] per-step emissions (both cut
+        at EOS/budget on device, so row s carries counts[s] real
+        tokens)."""
         arr, snapshot, counts = self._pending.pop(0)
         host = np.asarray(arr)
         emitted = 0
@@ -1649,21 +1727,35 @@ class DecodeEngine:
             (1 - _SPEC_RATE_ALPHA) * ema + _SPEC_RATE_ALPHA * rate)
 
     def _record_step_timing(self, t0, end, norm, steps, occupancy,
-                            extra=None):
-        """Shared per-round accounting for BOTH step programs (decode
-        and verify): busy time, step/occupancy counters, the per-token
-        latency and inter-token-gap reservoirs, and the step
-        histogram — one discipline, so the percentiles the bench and
-        e2e assert on mean the same thing on either path.  ``norm`` is
+                            extra=None, delivered=None, program="step",
+                            round_steps=None):
+        """Shared per-round accounting for ALL step programs (decode,
+        fused decode rounds, and verify): busy time, step/occupancy
+        counters, the per-token latency and inter-token-gap
+        reservoirs, the step histogram, AND the throughput-gate EMAs —
+        one discipline, so the percentiles the bench and e2e assert on
+        mean the same thing on every path and the speculation gate
+        compares decode and verify in the same currency.  ``norm`` is
         tokens-per-slot-stream this call (fused steps for decode, mean
         emissions of advancing slots for verify); ``extra`` merges
         additional counters under the same lock (a scrape must never
-        see spec_steps ahead of steps)."""
+        see spec_steps ahead of steps); ``delivered`` (tokens the
+        round actually delivered, post-EOS/budget) feeds the
+        ``program``'s rate EMA per ROUND — a fused dispatch of k steps
+        is one EMA sample, not k, so the spec gate prices fused decode
+        by its delivered rate, not its call rate; ``round_steps``
+        appends to the steps-per-round reservoir (fused rounds
+        only)."""
         dt = end - t0
         per_tok = dt / norm
         gap = (end - self._last_step_end
                if self._last_step_end is not None else None)
         self._last_step_end = end
+        # Pace EMA (loop-thread-owned): the fused-round deadline clamp
+        # reads this as its step-latency estimate.
+        self._step_pace_ema = per_tok if self._step_pace_ema is None \
+            else ((1 - _ROUND_PACE_ALPHA) * self._step_pace_ema
+                  + _ROUND_PACE_ALPHA * per_tok)
         with self._lock:
             self._counters["steps"] += steps
             self._counters["occupancy_sum"] += occupancy
@@ -1678,7 +1770,269 @@ class DecodeEngine:
                 self._gap_times.append(gap / norm)
                 if len(self._gap_times) > 4096:
                     del self._gap_times[:2048]
+            if round_steps is not None:
+                self._round_steps.append(round_steps)
+                if len(self._round_steps) > 4096:
+                    del self._round_steps[:2048]
         self._step_hist.observe(per_tok, engine=self._metric_name)
+        if delivered is not None and delivered > 0 and dt > 0:
+            rate = delivered / dt
+            if program == "verify":
+                self._rate_verify_ema = self._blend_rate(
+                    self._rate_verify_ema, rate)
+            else:
+                self._rate_step_ema = self._blend_rate(
+                    self._rate_step_ema, rate)
+
+    def _round_width(self) -> int:
+        """Current fused-round step width: the adaptive value, clamped
+        so ``width x pace`` stays under the tightest live deadline's
+        remaining tolerance.  Deadline expiry granularity is the ROUND
+        — the sweep only runs between dispatches — so an unclamped
+        width could schedule a whole round past the soonest deadline
+        and deliver nothing but a late 504 (docs §5.2e)."""
+        width = self._round_k
+        pace = self._step_pace_ema
+        if width > 1 and pace and pace > 0:
+            now = faults.monotonic()
+            tightest = None
+            for r in self._slot_req:
+                if r is None or r["deadline"] is None:
+                    continue
+                rem = r["deadline"] - now
+                tightest = rem if tightest is None \
+                    else min(tightest, rem)
+            if tightest is not None:
+                width = min(width, max(1, int(tightest / pace)))
+        return max(1, min(width, self.decode_rounds))
+
+    def _refresh_tables_dev(self) -> None:
+        """Upload the host block tables to the device (double buffer).
+        Called from the overlap window right after next-round cover
+        growth, so the transfer rides alongside the in-flight round's
+        compute; a table mutation after that point (admission row
+        reset, expiry parking, speculative trim) re-marks dirty and
+        the next dispatch re-uploads before launching.  Under a mesh
+        whose table placement could not be introspected from the
+        compiled executable, keep passing the host array instead — the
+        runtime then transfers per dispatch, exactly as the unfused
+        ``decode_step`` path always has (correctness first, the
+        overlap win is opt-in)."""
+        import jax
+
+        with self._lock:
+            self._tables_dirty = False
+            tables = self._tables.copy()
+        if self.mesh is not None and self._tables_sharding is None:
+            self._tables_dev = None
+            return
+        if self._tables_sharding is not None:
+            self._tables_dev = jax.device_put(
+                tables, self._tables_sharding)
+        else:
+            self._tables_dev = jax.device_put(tables)
+
+    def _draft_ahead(self, snapshot, width: int) -> None:
+        """Overlapped drafting: while the fused round computes, run
+        the n-gram scan against each slot's DISPATCH-TIME history and
+        stash the proposal on the entry.  The proposal must survive
+        the in-flight round, so it is drafted ``width`` tokens deeper
+        than the verify window; at the next round boundary
+        ``_harvest_ahead_drafts`` checks the round's delivered tokens
+        against the proposal's head — a matching prefix means the tail
+        is still a valid draft at the new frontier, a divergence drops
+        it (the next fused round simply runs undrafted).  Either way a
+        verify dispatch never waits on a drafting scan.  Scan-stride
+        backoff and the per-slot width cooldown tick here — this IS
+        the scan site in fused mode, mirroring ``_collect_drafts``."""
+        k = self.speculative_tokens
+        self._spec_tick += 1
+        if self._spec_tick < self._spec_stride:
+            return
+        self._spec_tick = 0
+        proposed = False
+        for i, entry in snapshot:
+            if self._slot_req[i] is not entry \
+                    or entry["event"].is_set():
+                # Deterministically retired at this round's dispatch
+                # (or already resolved): it will not verify next round.
+                continue
+            if entry["spec_k"] <= 0:
+                entry["spec_cool"] -= 1
+                if entry["spec_cool"] <= 0:
+                    entry["spec_k"] = max(1, k // 2)
+                continue
+            room = entry["new"] - len(entry["emitted"]) - 1
+            if room <= 0:
+                continue
+            depth = width + min(k, entry["spec_k"], room)
+            proposal = _ngram_propose(
+                entry["hist"][:entry["hist_len"]], depth)
+            if proposal.size:
+                proposed = True
+                entry["draft_ahead"] = (entry["hist_len"], proposal)
+        if proposed:
+            self._spec_stride = 1
+        else:
+            self._spec_stride = min(self._spec_stride * 2,
+                                    _SPEC_SCAN_STRIDE_MAX)
+
+    def _harvest_ahead_drafts(self):
+        """Boundary-side half of overlapped drafting (see
+        ``_draft_ahead``): rebuild ``_collect_drafts``'s
+        (snapshot, draft, draft_len) contract from the ahead-proposals
+        whose heads matched the tokens the fused round actually
+        delivered, clipped to the verify window at the NEW frontier.
+        Returns None when nothing survived — the loop then runs a
+        plain fused round, which re-drafts in its overlap window.
+        Greedy token identity is unaffected either way: verify accepts
+        exact argmax matches only, so a stale-but-lucky draft and a
+        fresh one deliver the same tokens."""
+        k = self.speculative_tokens
+        draft = draft_len = None
+        snapshot: List[tuple] = []
+        for i, entry in enumerate(self._slot_req):
+            if entry is None or entry["prefilling"]:
+                continue
+            snapshot.append((i, entry))
+            ahead = entry.pop("draft_ahead", None)
+            if ahead is None:
+                continue
+            at_len, proposal = ahead
+            grown = entry["hist_len"] - at_len
+            if grown < 0 or grown >= proposal.size:
+                continue
+            if grown and not np.array_equal(
+                    entry["hist"][at_len:entry["hist_len"]],
+                    proposal[:grown]):
+                continue
+            room = entry["new"] - len(entry["emitted"]) - 1
+            width = min(int(proposal.size) - grown, k,
+                        entry["spec_k"], room)
+            if width <= 0:
+                continue
+            if draft is None:
+                draft = np.zeros((self.slots, k), np.int32)
+                draft_len = np.zeros((self.slots,), np.int32)
+            draft[i, :width] = proposal[grown:grown + width]
+            draft_len[i] = width
+        if draft is None:
+            return None
+        return snapshot, draft, draft_len
+
+    def _fused_round(self, live: int) -> None:
+        """One fused decode round (decode_rounds > 1): a single
+        ``decode_rounds`` dispatch advances every live slot up to
+        ``width`` steps with device-side early exit the moment all are
+        done, and the host work for the NEXT round — cover growth, the
+        double-buffered block-table upload, the n-gram drafting scan —
+        runs in the overlap window while the device computes.  Drains
+        synchronously at the round boundary: admissions and expiries
+        join between rounds, and deadline expiry granularity becomes
+        the round (``_round_width`` clamps the width under the
+        tightest live deadline).  Greedy tokens are bit-identical to
+        the k=1 loop: the device math is ``decode_step``'s body and
+        slot math is per-row independent, so scheduling granularity
+        cannot change any slot's token stream."""
+        from kubeflow_tpu.models.generate import decode_rounds
+
+        kmax = self.decode_rounds
+        width = self._round_width()
+        snapshot = [(i, r) for i, r in enumerate(self._slot_req)
+                    if r is not None and not r["prefilling"]]
+        # Worst-case cover for the WHOLE round before dispatch: the
+        # device may write `width` new positions per slot and the
+        # block tables ride in as one host-owned snapshot.  The
+        # admission reservation guarantees the pages, so this never
+        # blocks.
+        for _, r in snapshot:
+            self._ensure_cover(
+                r, r["tokens"].shape[1] + r["scheduled"] + width - 1)
+        if self._rounds_exec is None:
+            # One executable serves EVERY adaptive width: the buffer
+            # size k is static, the per-round step cap is a traced
+            # operand.  Built outside the timed window (compile must
+            # not pollute the step percentiles).
+            self._rounds_exec = decode_rounds.lower(
+                self.cfg, self.params, self._state, self.decode, kmax,
+                self._tables, np.int32(kmax)).compile()
+            if self.mesh is not None:
+                # The double-buffered upload must land the tables
+                # exactly where the SPMD executable expects them;
+                # when that sharding is not introspectable, fall back
+                # to passing the host array per dispatch (see
+                # _refresh_tables_dev).
+                try:
+                    self._tables_sharding = \
+                        self._rounds_exec.input_shardings[0][2]
+                except Exception:
+                    self._tables_sharding = None
+        if self._tables_dirty:
+            self._refresh_tables_dev()
+        tables = (self._tables_dev if self._tables_dev is not None
+                  else self._tables)
+        # Chaos hook: the same site as the unfused step — injected
+        # stalls/deaths hit fused rounds identically (deadlines expire
+        # mid-round, _abort resolves waiters).
+        faults.fire("engine.step")
+        tok_before = self._counters["tokens"]
+        t0 = time.perf_counter()
+        self._state, toks, counts, steps_run = self._rounds_exec(
+            self.params, self._state, tables, np.int32(width))
+        # ---- overlap window: the dispatch returned as soon as the
+        # round was enqueued; everything until the np.asarray below
+        # runs while the device computes.
+        # Deterministic retirement at dispatch: with no EOS a slot
+        # whose remaining budget fits this round is KNOWN to finish —
+        # the loop early-exits only when EVERY slot is done, so it can
+        # never stop short of a still-advancing slot's budget.
+        for i, r in snapshot:
+            r["scheduled"] = min(r["new"], r["scheduled"] + width)
+            if not self._eos and r["scheduled"] >= r["new"]:
+                # Loop-thread-owned (see _drain_one).
+                # kft: allow=lock-guard
+                self._slot_req[i] = None
+        # Double buffer: grow the NEXT round's covers and start their
+        # table upload now, so the next dispatch finds the transfer
+        # already done (or at least in flight) instead of paying it on
+        # the critical path.
+        for i, r in snapshot:
+            if self._slot_req[i] is r:
+                self._ensure_cover(
+                    r, r["tokens"].shape[1] + r["scheduled"] + kmax - 1)
+        if self._tables_dirty:
+            self._refresh_tables_dev()
+        # Overlapped drafting for the next boundary's verify round.
+        if self.speculative_tokens:
+            self._draft_ahead(snapshot, width)
+        # ---- round boundary: materialize ONCE, deliver, account.
+        toks_np = np.asarray(toks)
+        counts_np = np.asarray(counts)
+        steps = int(steps_run)
+        self._pending.append((toks_np, snapshot, counts_np))
+        while self._pending:
+            self._drain_one()
+        end = time.perf_counter()
+        delivered = self._counters["tokens"] - tok_before
+        dispatched = steps * len(snapshot)
+        wasted = max(0, dispatched - delivered)
+        # Adaptive width (the PR 7 discipline on the round dimension):
+        # shrink on early-exit waste or a waiting admission, grow one
+        # step per full, waste-free round.
+        if dispatched and (self._queue
+                           or wasted > _ROUND_WASTE_FRAC * dispatched):
+            self._round_k = max(1, self._round_k // 2)
+        elif steps >= width and not wasted:
+            self._round_k = min(kmax, self._round_k + 1)
+        norm = max(1, steps)
+        self._record_step_timing(
+            t0, end, norm, steps=norm, occupancy=live * norm,
+            extra={"fused_rounds": 1, "fused_steps_wasted": wasted},
+            delivered=delivered, round_steps=steps)
+        self._fused_rounds_ctr.inc(1, engine=self._metric_name)
+        if wasted:
+            self._fused_wasted_ctr.inc(wasted,
+                                       engine=self._metric_name)
 
     def _collect_drafts(self):
         """Host-side n-gram drafting pass over the live slots.
@@ -1803,7 +2157,6 @@ class DecodeEngine:
         while len(self._pending) > self.sync_lag:  # sync: drains all
             self._drain_one()
         end = time.perf_counter()
-        dt = end - t0
         drafted = int(draft_len.sum())
         accepted = 0
         for col, entry in snapshot:
@@ -1847,17 +2200,17 @@ class DecodeEngine:
                     entry["tokens"].shape[1] + len(entry["emitted"]))
         total = int(counts_np.sum())
         advancing = int(np.count_nonzero(counts_np))
-        if dt > 0:
-            self._rate_verify_ema = self._blend_rate(
-                self._rate_verify_ema, total / dt)
         # Per-TOKEN latency/gap samples: one verify call delivers a
         # variable token count, so normalize by the mean emissions of
         # the slots that advanced — the client-visible stream pace.
+        # The verify-rate EMA rides the shared accounting path
+        # (delivered tokens per round, same currency as fused decode).
         norm = max(1.0, total / advancing) if advancing else 1.0
         self._record_step_timing(
             t0, end, norm, steps=1, occupancy=live,
             extra={"spec_steps": 1, "spec_drafted": drafted,
-                   "spec_accepted": accepted})
+                   "spec_accepted": accepted},
+            delivered=total, program="verify")
         if drafted:
             self._spec_drafted_ctr.inc(drafted,
                                        engine=self._metric_name)
@@ -1924,6 +2277,7 @@ class DecodeEngine:
                             row = self._tables[slot]
                             row[:] = self.kv_pool_blocks
                             row[:len(shared)] = shared
+                            self._tables_dirty = True
                             self._slot_req[slot] = entry
                             self._counters["in_flight"] += 1
                             admissions.append((entry, slot))
@@ -1967,7 +2321,27 @@ class DecodeEngine:
                     sum(r is not None for r in self._slot_req))
                 live = sum(1 for r in self._slot_req
                            if r is not None and not r["prefilling"])
-                if live and self.speculative_tokens:
+                if live and self.speculative_tokens \
+                        and self.decode_rounds > 1:
+                    # Fused mode: the drafting scan already ran in the
+                    # PREVIOUS round's overlap window (_draft_ahead
+                    # owns the stride backoff there); harvest the
+                    # proposals that survived the in-flight round and
+                    # dispatch verify with no drafting stall on the
+                    # critical path.  Nothing harvested => plain fused
+                    # round below, which re-drafts while it computes.
+                    if any(e.get("spec_seed") for e, _ in admissions):
+                        self._spec_stride = 1
+                        self._spec_tick = self._spec_stride
+                        self._spec_probe = _SPEC_PROBE_EVERY
+                    drafts = self._harvest_ahead_drafts()
+                    if drafts is not None \
+                            and self._spec_gates_pass(drafts[2]):
+                        self._verify_round(*drafts, live)
+                        self._set_occ_gauge(sum(
+                            r is not None for r in self._slot_req))
+                        continue
+                elif live and self.speculative_tokens:
                     # Speculation: draft host-side; when at least one
                     # slot proposed, one verify call replaces this
                     # round's decode step (undrafted slots ride along
@@ -2007,7 +2381,9 @@ class DecodeEngine:
                                     r is not None
                                     for r in self._slot_req))
                                 continue
-                if live:
+                if live and self.decode_rounds > 1:
+                    self._fused_round(live)
+                elif live:
                     k = self.steps_per_call
                     # Cover every advancing slot's next k write
                     # positions with pages from its admission
@@ -2069,23 +2445,17 @@ class DecodeEngine:
                     while len(self._pending) > self.sync_lag:
                         self._drain_one()
                     end = time.perf_counter()
-                    if self.speculative_tokens and end > t0:
-                        # Feed the speculation throughput gate its
-                        # decode-side comparison rate, in DELIVERED
-                        # tokens (same currency as the verify side's
-                        # counts sum).
-                        delivered = (self._counters["tokens"]
-                                     - tok_before)
-                        if delivered > 0:
-                            self._rate_step_ema = self._blend_rate(
-                                self._rate_step_ema,
-                                delivered / (end - t0))
                     # Per-call latency and gap normalized by fused
                     # steps: what a client streaming tokens would see
                     # between tokens, including interleaved
-                    # admission/prefill work.
+                    # admission/prefill work.  The delivered-token
+                    # delta feeds the speculation throughput gate its
+                    # decode-side comparison rate (same currency as
+                    # the verify side's counts sum).
                     self._record_step_timing(
-                        t0, end, k, steps=k, occupancy=live * k)
+                        t0, end, k, steps=k, occupancy=live * k,
+                        delivered=(self._counters["tokens"] - tok_before
+                                   if self.speculative_tokens else None))
                 else:
                     self._last_step_end = None
                     if not self._prefilling:

@@ -33,6 +33,7 @@ def batcher_factory(*, micro_batch_size: int, batch_timeout_s: float,
                     lm_engine_sync_lag: int = 2,
                     lm_engine_steps_per_call: int = 1,
                     lm_engine_admit_width: int = 4,
+                    decode_rounds: int = 1,
                     prefill_chunk_tokens: int = 64,
                     kv_block_tokens: int = 16,
                     kv_pool_blocks: int = 0,
@@ -102,6 +103,7 @@ def batcher_factory(*, micro_batch_size: int, batch_timeout_s: float,
                     slots=lm_engine_slots, prefill_len=prefill,
                     sync_lag=lm_engine_sync_lag,
                     steps_per_call=lm_engine_steps_per_call,
+                    decode_rounds=decode_rounds,
                     admit_width=lm_engine_admit_width,
                     prefill_chunk_tokens=prefill_chunk_tokens,
                     kv_block_tokens=kv_block_tokens,
@@ -196,6 +198,17 @@ def main(argv=None) -> int:
                     help="DecodeEngine decode steps fused per step-"
                          "program call: amortizes per-dispatch overhead "
                          "k-fold at k-step admission granularity")
+    ap.add_argument("--decode_rounds", type=int, default=8,
+                    help="DecodeEngine fused decode rounds: up to k "
+                         "steps run device-resident per dispatch in a "
+                         "while_loop with early exit when every slot "
+                         "finishes, host uploads double-buffered "
+                         "behind device compute (docs §5.2e).  The "
+                         "width adapts between 1 and k on early-exit "
+                         "waste and queued admissions, and is clamped "
+                         "under the tightest live deadline; 1 restores "
+                         "the classic per-step dispatch loop "
+                         "bit-for-bit")
     ap.add_argument("--lm_engine_admit_width", type=int, default=4,
                     help="DecodeEngine concurrent mid-prefill "
                          "admissions: further queued requests wait "
@@ -332,6 +345,7 @@ def main(argv=None) -> int:
                 lm_engine_sync_lag=args.lm_engine_sync_lag,
                 lm_engine_steps_per_call=args.lm_engine_steps_per_call,
                 lm_engine_admit_width=args.lm_engine_admit_width,
+                decode_rounds=args.decode_rounds,
                 prefill_chunk_tokens=args.prefill_chunk_tokens,
                 kv_block_tokens=args.kv_block_tokens,
                 kv_pool_blocks=args.kv_pool_blocks,

@@ -112,7 +112,12 @@ def engine_smoke(namespace: str = "kubeflow-test") -> None:
     request completes), and the
     ``kft_engine_kv_block_evictions_total`` /
     ``kft_engine_kv_shed_no_blocks_total`` counters must move as
-    deltas over /metrics."""
+    deltas over /metrics.  Finally a fused-decode burst
+    (``--decode_rounds 8`` rebuild): the engine must dispatch fused
+    while_loop rounds (``kft_engine_fused_rounds_total`` delta > 0),
+    report the ``decode_rounds`` program over :stats, and produce
+    token-IDENTICAL output to a ``decode_rounds=1`` control rebuild
+    that compiles no fused program."""
     import json
     import tempfile
     import threading
@@ -432,7 +437,13 @@ def engine_smoke(namespace: str = "kubeflow-test") -> None:
                     f"http://127.0.0.1:{port}/model/lm:stats",
                     timeout=30) as resp:
                 stats = json.loads(resp.read())["batcher"]
-            assert stats["kv_shed_no_blocks"] >= len(shed), stats
+            # Every 429 is a typed shed; the pool-typed counter is
+            # racy by design (a thread scheduled after the first
+            # request retires can shed queue-full while the freed
+            # pages sit unclaimed), so assert it MOVED rather than
+            # that it covers every shed.
+            assert stats["shed"] >= len(shed), stats
+            assert stats["kv_shed_no_blocks"] >= 1, stats
             assert stats["kv_blocks"] == 8
             with urllib.request.urlopen(
                     f"http://127.0.0.1:{port}/metrics",
@@ -452,13 +463,92 @@ def engine_smoke(namespace: str = "kubeflow-test") -> None:
                                 engine="lm-v1") == 8
             assert (sample_value(parsed, "kft_engine_kv_blocks_used",
                                  engine="lm-v1") or 0) > 0
-            assert shed_after - shed_before >= len(shed), (
+            assert shed_after - shed_before >= 1, (
                 shed_before, shed_after, codes)
             # Successive distinct prompts through an 8-page pool force
             # LRU eviction of published prefix pages — the eviction
             # counter must move.
             assert evict_after > evict_before, (
                 evict_before, evict_after)
+
+            # --- fused-decode burst: rebuild with decode_rounds=8 —
+            # the fused while_loop program replaces the per-step
+            # dispatch loop (docs §5.2e) — and drive mixed-length
+            # concurrent prompts.  The engine must dispatch fused
+            # rounds (kft_engine_fused_rounds_total delta > 0), report
+            # the fused program in compiled_programs, and produce
+            # token-IDENTICAL output to a decode_rounds=1 control
+            # rebuild (the k=1 path compiles no fused program).
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics",
+                    timeout=30) as resp:
+                parsed = parse_metrics(resp.read().decode())
+            fused_before = sample_value(
+                parsed, "kft_engine_fused_rounds_total",
+                engine="lm-v1") or 0
+            rebuild(0, decode_rounds=8)
+            fused_prompts = [rng.randint(1, 128, size=(n,)).tolist()
+                             for n in (3, 9, 16)]
+            outs.clear()
+            threads = [threading.Thread(target=client, args=(i, p))
+                       for i, p in enumerate(fused_prompts)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            fused_out = {}
+            for i, prompt in enumerate(fused_prompts):
+                tokens = outs[i]["predictions"][0]["tokens"]
+                assert tokens[:len(prompt)] == prompt
+                assert len(tokens) == len(prompt) + max_new
+                fused_out[i] = tokens
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/model/lm:stats",
+                    timeout=30) as resp:
+                stats = json.loads(resp.read())["batcher"]
+            assert stats["decode_rounds"] == 8, stats
+            assert stats["fused_rounds"] > 0, (
+                f"fused burst dispatched no fused rounds: {stats}")
+            assert stats["steps_per_round_p50"] >= 1, stats
+            programs = stats["compiled_programs"]
+            # The fused program joins the guarantee exactly once; the
+            # per-step program is never needed on this path (0), and
+            # verify stays 0 (spec off).
+            assert programs.get("decode_rounds") == 1, programs
+            assert programs["chunked_prefill"] == 1, programs
+            assert programs["verify"] == 0, programs
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics",
+                    timeout=30) as resp:
+                parsed = parse_metrics(resp.read().decode())
+            fused_after = sample_value(
+                parsed, "kft_engine_fused_rounds_total",
+                engine="lm-v1") or 0
+            assert fused_after - fused_before > 0, (
+                fused_before, fused_after)
+            # k=1 control rebuild: identical tokens, no fused program.
+            # Same concurrent shape as the fused burst — greedy decode
+            # is order-independent per slot, and the threads halve the
+            # control's wall time.
+            rebuild(0)
+            outs.clear()
+            threads = [threading.Thread(target=client, args=(i, p))
+                       for i, p in enumerate(fused_prompts)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            for i, prompt in enumerate(fused_prompts):
+                assert outs[i]["predictions"][0]["tokens"] \
+                    == fused_out[i], (
+                    f"fused decode changed tokens for prompt {i}")
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/model/lm:stats",
+                    timeout=30) as resp:
+                stats = json.loads(resp.read())["batcher"]
+            assert stats["fused_rounds"] == 0, stats
+            assert "decode_rounds" not in stats["compiled_programs"], \
+                stats["compiled_programs"]
         finally:
             httpd.shutdown()
             server.stop()

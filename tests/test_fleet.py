@@ -109,7 +109,11 @@ class _Replica:
                     with replica.lock:
                         replica.get_attempts += 1
                     if replica.fail_gets:
-                        self.connection.close()
+                        # Reset, don't close(): a plain close() leaves
+                        # the rfile/wfile dups holding the fd open, so
+                        # the router blocks the full try_timeout_s
+                        # instead of seeing the failure instantly.
+                        self._die()
                         return
                     self._send(200, {"route": self.path})
 

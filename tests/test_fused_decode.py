@@ -1,0 +1,414 @@
+"""Fused multi-step decode (models/generate.py ``decode_rounds`` +
+serving/engine.py ``decode_rounds > 1``, docs §5.2e): the while_loop
+round program must be INVISIBLE in the tokens — fused(k=8) ==
+unfused(k=1) == single-request generate() across slot reuse, EOS
+inside a round, deadline expiry at a round boundary, mid-round
+admission, speculation-ON mixed traffic, and SPMD meshes — while the
+fused engine compiles exactly ONE extra program (and the k=1 path
+compiles none)."""
+
+import dataclasses
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from kubeflow_tpu.serving.errors import DeadlineExceeded
+from kubeflow_tpu.testing import faults
+
+SEED = 20260730
+VOCAB, PROMPT_LEN, NEW_TOKENS = 128, 8, 12
+
+
+@pytest.fixture(scope="module")
+def engine_model():
+    """The same tiny LM config test_lm_serving's engines run, built
+    directly (no export/ModelServer round trip — the engines take
+    cfg/params/decode, and the full-suite jit cache already holds this
+    config's generate() programs): yields (spec, None) in the
+    engine_spec shape."""
+    import jax
+    from flax import linen as nn
+
+    from kubeflow_tpu.models.generate import DecodeConfig
+    from kubeflow_tpu.models.transformer import Transformer
+    from kubeflow_tpu.serving.loaders import _model_config
+
+    cfg = _model_config({
+        "vocab_size": VOCAB, "d_model": 32, "n_layers": 2, "n_heads": 4,
+        "n_kv_heads": 2, "d_ff": 64, "head_dim": 8, "max_seq_len": 64,
+        "dtype": "float32"})
+    model = Transformer(cfg)
+    params = nn.unbox(model.init(
+        jax.random.key(SEED), np.zeros((1, PROMPT_LEN), np.int32))
+        ["params"])
+    decode = DecodeConfig(max_new_tokens=NEW_TOKENS, temperature=0.0)
+    yield {"cfg": cfg, "params": params, "decode": decode}, None
+
+
+def _counting_proxy(fn, compiles, key):
+    """Each .lower() call — exactly one XLA compilation in the
+    AOT-disciplined engine — bumps ``compiles[key]``."""
+    class _Proxy:
+        def lower(self, *a, **kw):
+            compiles[key] += 1
+            return fn.lower(*a, **kw)
+
+        def __call__(self, *a, **kw):
+            return fn(*a, **kw)
+
+    return _Proxy()
+
+
+def _reference_rows(spec, prompts, news, decode=None):
+    """Single-request generate() goldens truncated to each request's
+    budget (greedy is prefix-stable)."""
+    from kubeflow_tpu.models.generate import generate
+
+    rows = []
+    for prompt, new in zip(prompts, news):
+        out, _ = generate(spec["cfg"], spec["params"],
+                          np.asarray(prompt, np.int32)[None],
+                          decode or spec["decode"])
+        rows.append(np.asarray(out)[0, :len(prompt) + new].tolist())
+    return rows
+
+
+def _run_engine(spec, prompts, news, *, decode_rounds, slots=3,
+                decode=None, name="test-fused", **kw):
+    from kubeflow_tpu.serving.engine import DecodeEngine
+
+    engine = DecodeEngine(
+        spec["cfg"], spec["params"], decode or spec["decode"],
+        slots=slots, prefill_len=16, admit_width=2,
+        prefill_chunk_tokens=8, kv_block_tokens=4,
+        decode_rounds=decode_rounds,
+        name=f"{name}-k{decode_rounds}", **kw)
+    try:
+        outs = [None] * len(prompts)
+
+        def client(i):
+            outs[i] = engine.submit({
+                "tokens": np.asarray(prompts[i], np.int32),
+                "max_new_tokens": news[i]})
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(len(prompts))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return outs, engine.stats(), engine.compiled_programs()
+    finally:
+        engine.close()
+
+
+class TestFusedDecode:
+    def test_fused_matches_generate_slot_reuse_one_extra_program(
+            self, engine_model, monkeypatch):
+        """The tentpole identity: 9 mixed-length requests through 3
+        slots (every slot reused, multi-chunk prefill, mid-round
+        admission waves) are token-identical across fused(k=8),
+        unfused(k=1), and generate() — and across BOTH engines the
+        only programs compiled are one chunked prefill each, one step
+        (the k=1 engine), and one fused round program (the k=8 engine,
+        whose adaptive widths all ride the same executable)."""
+        from kubeflow_tpu.models import generate as gen_mod
+
+        compiles = {"chunked_prefill": 0, "step": 0, "verify": 0,
+                    "decode_rounds": 0}
+        for attr, key in (("prefill_chunk_into_slot", "chunked_prefill"),
+                          ("decode_step", "step"),
+                          ("verify_step", "verify"),
+                          ("decode_rounds", "decode_rounds")):
+            monkeypatch.setattr(gen_mod, attr, _counting_proxy(
+                getattr(gen_mod, attr), compiles, key))
+
+        spec, _ = engine_model
+        rng = np.random.RandomState(SEED)
+        lens = [3, 9, 16, 2, 9, 16, 3, 16, 2]
+        news = [12, 6, 3, 8, 12, 4, 10, 5, 12]
+        prompts = [rng.randint(1, VOCAB, size=(n,)).tolist()
+                   for n in lens]
+        want = _reference_rows(spec, prompts, news)
+
+        fused_outs, fused_stats, fused_programs = _run_engine(
+            spec, prompts, news, decode_rounds=8)
+        plain_outs, _, plain_programs = _run_engine(
+            spec, prompts, news, decode_rounds=1)
+        for i in range(len(prompts)):
+            got_f = np.asarray(fused_outs[i]["tokens"])[0].tolist()
+            got_p = np.asarray(plain_outs[i]["tokens"])[0].tolist()
+            assert got_f == want[i], (
+                f"fused request {i} (len {lens[i]}, budget {news[i]}) "
+                "drifted from single-request generate()")
+            assert got_p == want[i], (
+                f"k=1 request {i} drifted from generate()")
+
+        # Fused rounds really ran, and the round-width accounting
+        # surfaced through stats.
+        assert fused_stats["decode_rounds"] == 8
+        assert fused_stats["fused_rounds"] > 0
+        assert fused_stats["steps_per_round_p50"] >= 1
+        assert fused_stats["steps_per_round_p99"] \
+            >= fused_stats["steps_per_round_p50"]
+        assert fused_stats["fused_steps_wasted"] >= 0
+        assert fused_stats["tokens"] == sum(news)
+        assert fused_stats["active_slots"] == 0
+        assert fused_stats["in_flight_requests"] == 0
+
+        # Compile counts: the fused engine never builds the per-step
+        # program; the k=1 engine never builds the fused one.
+        assert compiles == {"chunked_prefill": 2, "step": 1,
+                            "verify": 0, "decode_rounds": 1}
+        assert fused_programs == {"chunked_prefill": 1, "step": 0,
+                                  "verify": 0, "decode_rounds": 1}
+        assert plain_programs == {"chunked_prefill": 1, "step": 1,
+                                  "verify": 0}
+
+    def test_eos_inside_round_matches_generate(self, engine_model):
+        """A slot whose EOS lands mid-round freezes on device; the
+        drain must deliver exactly generate()'s tokens up to and
+        including EOS and the slot must come back."""
+        from kubeflow_tpu.models.generate import generate
+        from kubeflow_tpu.serving.engine import DecodeEngine
+
+        spec, _ = engine_model
+        rng = np.random.RandomState(SEED + 1)
+        decode = dataclasses.replace(spec["decode"], eos_token=5)
+        prompts = [rng.randint(1, VOCAB, size=(n,)).tolist()
+                   for n in (3, 9, 16)]
+        engine = DecodeEngine(spec["cfg"], spec["params"], decode,
+                              slots=2, prefill_len=16, decode_rounds=8,
+                              name="fused-eos")
+        try:
+            for prompt in prompts:
+                out = engine.submit(
+                    {"tokens": np.asarray(prompt, np.int32)})
+                got = np.asarray(out["tokens"])[0, len(prompt):].tolist()
+                ref, _ = generate(spec["cfg"], spec["params"],
+                                  np.asarray(prompt, np.int32)[None],
+                                  decode)
+                ref = np.asarray(ref)[0, len(prompt):].tolist()
+                if 5 in ref:
+                    ref = ref[:ref.index(5) + 1]
+                assert got == ref
+            assert engine.stats()["active_slots"] == 0
+        finally:
+            engine.close()
+
+    def test_deadline_expiry_at_round_boundary_frees_slot(
+            self, engine_model):
+        """Deadline enforcement under fused rounds is round-granular
+        (§5.2e): a request expiring while a round is in flight is
+        retired at the next boundary — DeadlineExceeded to the client,
+        slot reclaimed for a successor whose tokens match generate()."""
+        from kubeflow_tpu.serving.engine import DecodeEngine
+
+        spec, _ = engine_model
+        rng = np.random.RandomState(SEED)
+        prompt_c = rng.randint(1, VOCAB, size=(6,)).tolist()
+        prompt_a = rng.randint(1, VOCAB, size=(5,)).tolist()
+        prompt_b = rng.randint(1, VOCAB, size=(7,)).tolist()
+        # One fused round costs >= 200 ms (the injected step sleep
+        # fires once per DISPATCH); A's 100 ms deadline expires during
+        # the first round it could ride, so the boundary sweep must
+        # retire it — its budget (12 tokens > 8-wide round) guarantees
+        # it cannot complete inside one round.
+        with faults.injected("seed=1;engine.step:sleep=0.2"):
+            engine = DecodeEngine(spec["cfg"], spec["params"],
+                                  spec["decode"], slots=2,
+                                  prefill_len=16, decode_rounds=8,
+                                  name="fused-dl")
+            outs: dict = {}
+
+            def client(key, prompt, deadline=None):
+                try:
+                    outs[key] = engine.submit(
+                        {"tokens": np.asarray(prompt, np.int32)},
+                        deadline=deadline)
+                except Exception as exc:  # noqa: BLE001 — the point
+                    outs[key] = exc
+
+            try:
+                t_c = threading.Thread(
+                    target=client, args=("c", prompt_c))
+                t_c.start()
+                t_a = threading.Thread(
+                    target=client, args=("a", prompt_a,
+                                         faults.monotonic() + 0.1))
+                t_a.start()
+                t_a.join(timeout=60)
+                assert isinstance(outs["a"], DeadlineExceeded), outs["a"]
+                # B admitted into A's reclaimed slot while C decodes.
+                client("b", prompt_b)
+                t_c.join(timeout=60)
+                stats = engine.stats()
+                assert stats["deadline_expired"] == 1
+                assert stats["in_flight_requests"] == 0
+            finally:
+                engine.close()
+        want = _reference_rows(spec, [prompt_c, prompt_b],
+                               [NEW_TOKENS, NEW_TOKENS])
+        for key, ref in (("c", want[0]), ("b", want[1])):
+            got = np.asarray(outs[key]["tokens"])[0].tolist()
+            assert got == ref, (
+                f"request {key!r} drifted after round-boundary expiry")
+
+    def test_mid_round_admission_joins_at_boundary(self, engine_model):
+        """A request arriving while a fused round is in flight joins
+        at the next boundary and decodes identically to generate()."""
+        from kubeflow_tpu.serving.engine import DecodeEngine
+
+        spec, _ = engine_model
+        rng = np.random.RandomState(SEED + 3)
+        prompt_a = rng.randint(1, VOCAB, size=(9,)).tolist()
+        prompt_b = rng.randint(1, VOCAB, size=(4,)).tolist()
+        want = _reference_rows(spec, [prompt_a, prompt_b],
+                               [NEW_TOKENS, NEW_TOKENS])
+        engine = DecodeEngine(spec["cfg"], spec["params"],
+                              spec["decode"], slots=2, prefill_len=16,
+                              decode_rounds=8, name="fused-admit")
+        try:
+            outs: dict = {}
+
+            def client(key, prompt):
+                outs[key] = engine.submit(
+                    {"tokens": np.asarray(prompt, np.int32)})
+
+            t_a = threading.Thread(target=client, args=("a", prompt_a))
+            t_a.start()
+            time.sleep(0.02)  # A is mid-generation when B arrives
+            client("b", prompt_b)
+            t_a.join(timeout=60)
+            for key, ref in (("a", want[0]), ("b", want[1])):
+                got = np.asarray(outs[key]["tokens"])[0].tolist()
+                assert got == ref, f"request {key!r} drifted"
+        finally:
+            engine.close()
+
+    def test_spec_on_mixed_traffic_identity(self, engine_model,
+                                            monkeypatch):
+        """Speculation + fused rounds coexist: draft-ahead verify
+        rounds interleave with fused decode rounds and the mixed
+        repetitive/random workload stays token-identical to
+        generate()."""
+        import kubeflow_tpu.serving.engine as eng_mod
+
+        # Zero the measured-throughput margin so gating never vetoes
+        # verify rounds on a loaded box — identity is what is under
+        # test, and it must hold regardless of gating.
+        monkeypatch.setattr(eng_mod, "_SPEC_RATE_MARGIN", 0.0)
+
+        spec, _ = engine_model
+        rng = np.random.RandomState(SEED + 21)
+        prompts, news = [], []
+        for i in range(8):
+            if i % 2 == 0:
+                pat = rng.randint(1, VOCAB, size=(4,))
+                prompts.append(np.tile(pat, 3).tolist())
+            else:
+                prompts.append(
+                    rng.randint(1, VOCAB, size=(10,)).tolist())
+            news.append([12, 8, 10, 6][i % 4])
+        want = _reference_rows(spec, prompts, news)
+        outs, stats, programs = _run_engine(
+            spec, prompts, news, decode_rounds=8, slots=2,
+            speculative_tokens=4, name="fused-spec")
+        for i in range(len(prompts)):
+            got = np.asarray(outs[i]["tokens"])[0].tolist()
+            assert got == want[i], (
+                f"spec-ON fused request {i} drifted from generate()")
+        assert stats["fused_rounds"] > 0
+        assert programs["decode_rounds"] == 1
+
+    @pytest.mark.parametrize("tensor", [2])
+    def test_mesh_fused_identity(self, engine_model, tensor):
+        """Fused rounds compile SPMD under the serving mesh exactly
+        like decode_step: greedy identity holds at mesh 2 (the
+        conftest forces an 8-device CPU host platform; the mesh-1 /
+        single-device fused path is every other test in this file)."""
+        from kubeflow_tpu.serving import sharding
+        from kubeflow_tpu.serving.engine import DecodeEngine
+
+        spec, _ = engine_model
+        rng = np.random.RandomState(SEED + 5)
+        prompts = [rng.randint(1, VOCAB, size=(n,)).tolist()
+                   for n in (8, 5, 11)]
+        want = _reference_rows(spec, prompts, [NEW_TOKENS] * 3)
+        mesh = sharding.build_mesh({"tensor": tensor})
+        engine = DecodeEngine(spec["cfg"], spec["params"],
+                              spec["decode"], slots=2, prefill_len=16,
+                              kv_block_tokens=4, decode_rounds=8,
+                              mesh=mesh, name=f"fused-mesh{tensor}")
+        try:
+            for i, prompt in enumerate(prompts):
+                got = engine.submit(
+                    {"tokens": np.asarray(prompt, np.int32)}
+                )["tokens"][0].tolist()
+                assert got == want[i], (
+                    f"mesh={tensor} fused decode diverged on {i}")
+            stats = engine.stats()
+            assert stats["mesh_devices"] == max(1, tensor)
+            assert stats["fused_rounds"] > 0
+            assert engine.compiled_programs()["decode_rounds"] == 1
+        finally:
+            engine.close()
+
+    def test_fault_inside_fused_round_aborts_cleanly(
+            self, engine_model, monkeypatch):
+        """A device fault inside a fused round (seeded at the
+        engine.step chaos site, which _fused_round fires per dispatch)
+        must error EVERY waiter — no hung client, no wedged loop."""
+        from kubeflow_tpu.models import generate as gen_mod
+        from kubeflow_tpu.serving.engine import DecodeEngine
+
+        real = gen_mod.decode_rounds
+        calls = {"n": 0}
+
+        class _DiesOnSecondRound:
+            def lower(self, *a, **kw):
+                lowered = real.lower(*a, **kw)
+
+                class _Lowered:
+                    def compile(self_l):
+                        exe = lowered.compile()
+
+                        def run(*ra, **rkw):
+                            calls["n"] += 1
+                            if calls["n"] >= 2:
+                                raise RuntimeError("device died")
+                            return exe(*ra, **rkw)
+
+                        return run
+
+                return _Lowered()
+
+        monkeypatch.setattr(gen_mod, "decode_rounds",
+                            _DiesOnSecondRound())
+        spec, _ = engine_model
+        engine = DecodeEngine(spec["cfg"], spec["params"],
+                              spec["decode"], slots=2, prefill_len=16,
+                              decode_rounds=4, name="fused-abort")
+        outs: dict = {}
+
+        def client(i, new):
+            try:
+                outs[i] = engine.submit({
+                    "tokens": np.arange(1, 5, dtype=np.int32),
+                    "max_new_tokens": new})
+            except Exception as exc:  # noqa: BLE001 — the point
+                outs[i] = exc
+
+        threads = [threading.Thread(target=client, args=a)
+                   for a in ((0, 12), (1, 12))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert not any(t.is_alive() for t in threads), (
+            "a client hung after the fused loop died")
+        assert len(outs) == 2  # every waiter resolved (result or error)
+        assert any(isinstance(v, Exception) for v in outs.values())
+        engine.close()
