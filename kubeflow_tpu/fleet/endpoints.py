@@ -174,6 +174,11 @@ class EndpointState:
         # terms), but the per-replica number operators read off
         # `kubeflow-tpu fleet status` to see cache health fleet-wide.
         self.cached_token_ratio = 0.0
+        # Host spill-tier occupancy (kft_serving_kv_spill_ratio,
+        # §5.10): like the cache ratio, an operator signal (`fleet
+        # status` SPILL%), not a routing input — replicas without a
+        # spill tier stay at 0.
+        self.kv_spill_ratio = 0.0
         self._consecutive_failures = 0
         self._eject_threshold = max(1, int(eject_threshold))
         self.breaker = breaker
@@ -504,11 +509,14 @@ class EndpointRegistry:
         # The unlabeled aggregate sorts first in the rendered series;
         # replicas without a decode engine simply lack the metric.
         ratio = sample_value(parsed, "kft_serving_cached_token_ratio")
+        spill = sample_value(parsed, "kft_serving_kv_spill_ratio")
         with state._lock:
             state.inflight = inflight
             state.queue_depth = queue
             if ratio is not None:
                 state.cached_token_ratio = ratio
+            if spill is not None:
+                state.kv_spill_ratio = spill
 
     def _export_gauges(self) -> None:
         counts: Dict[str, int] = {}
@@ -581,6 +589,7 @@ class EndpointRegistry:
                     "queue_depth": s.queue_depth,
                     "local_inflight": s.local_inflight,
                     "cached_token_ratio": s.cached_token_ratio,
+                    "kv_spill_ratio": s.kv_spill_ratio,
                     "breaker_failures": s.breaker.failure_count(),
                     "breaker_state": s.breaker.state(),
                 })

@@ -105,6 +105,13 @@ REPLAYS_HELP = ("idempotent-POST replays by outcome: ok/failed = a "
 RESUME_DEPTH = "kft_router_resume_tokens"
 RESUME_DEPTH_HELP = ("tokens already delivered to the client when a "
                      "mid-generation failover resumed")
+FETCH_TOTAL = "kft_router_kv_fetch_total"
+FETCH_HELP = (
+    "failover KV-fetch attempts by outcome (§5.10): ok = a surviving "
+    "peer answered with session pages attached to the replay body, "
+    "miss = peers answered but none holds the session, error = every "
+    "asked peer failed transport/status, none = no routable peer to "
+    "ask — every non-ok outcome falls back to recompute-resume")
 TIER_REQUESTS_TOTAL = "kft_router_tier_requests_total"
 TIER_REQUESTS_HELP = (
     "disaggregated :generate dispatches by tier: prefill = a "
@@ -241,6 +248,7 @@ class FleetRouter:
             RESUME_DEPTH, RESUME_DEPTH_HELP, buckets=_RESUME_BUCKETS)
         self._tier_requests = REGISTRY.counter(TIER_REQUESTS_TOTAL,
                                                TIER_REQUESTS_HELP)
+        self._fetches = REGISTRY.counter(FETCH_TOTAL, FETCH_HELP)
 
     # -- balancing ---------------------------------------------------------
 
@@ -652,6 +660,17 @@ class FleetRouter:
                 dead = state.name
                 if delivered:
                     self._resume_hist.observe(float(len(delivered)))
+                if meta and meta.get("resumable"):
+                    # Resume-by-fetch (§5.10): before the recompute
+                    # resume, ask surviving peers for the session's
+                    # spilled/parked KV pages; on a hit the payload
+                    # rides the replay body and the survivor imports
+                    # instead of re-prefilling.  Any failure leaves
+                    # the body untouched — recompute-resume is always
+                    # correct, fetch only makes it cheap.
+                    body = self._fetch_resume(
+                        path, body, delivered, headers, deadline,
+                        span, tiered)
                 continue
             # kind == "connect": nothing was sent — an ordinary retry.
             last_error = verdict[1]
@@ -860,6 +879,65 @@ class FleetRouter:
             return body
         payload["resume_tokens"] = list(delivered)
         return json.dumps(payload).encode()
+
+    def _fetch_resume(self, path, body, delivered, headers, deadline,
+                      parent, tiered):
+        """The fetch leg of resume-by-fetch (§5.10): POST the full
+        context (prompt + delivered tokens) to up to two surviving
+        peers' :fetch_kv route and fold the first non-null
+        ``kv_handoff`` into the :generate body — _rewrite_resume's
+        json round-trip carries it to the survivor, whose engine
+        imports the pages and chunk-prefills only the uncovered
+        suffix.  Returns the (possibly rewritten) body; ANY failure
+        returns it untouched and the replay recomputes.  Never burns
+        the retry budget: like the prefill leg, the fallback is
+        always correct."""
+        request = _json_obj(body)
+        if request is None or request.get("kv_handoff") is not None:
+            return body
+        context = list(request.get("tokens") or []) + list(delivered)
+        if not context:
+            return body
+        fetch_path = path[:-len(":generate")] + ":fetch_kv"
+        fetch_body = json.dumps({"tokens": context}).encode()
+        asked = failed = 0
+        tried: List[str] = []
+        tiers = ("decode",) if tiered else None
+        while asked < 2:
+            state = self.pick(exclude=tuple(tried), tiers=tiers)
+            if state is None:
+                break
+            tried.append(state.name)
+            asked += 1
+            span = tracing.start_span(
+                "router.fetch_kv", parent=parent,
+                attrs={"replica": state.name})
+            verdict = self._forward_once(state, "POST", fetch_path,
+                                         fetch_body, headers, deadline)
+            if verdict[0] != "response" or verdict[1] != 200:
+                failed += 1
+                span.end(status="transport" if verdict[0] != "response"
+                         else "upstream_error",
+                         error=str(verdict[1]))
+                continue
+            reply = _json_obj(verdict[3])
+            handoff = reply.get("kv_handoff") if reply else None
+            if not isinstance(handoff, dict):
+                span.end(status="ok", code=200)
+                continue
+            span.end(status="ok", code=200,
+                     tokens_covered=int(
+                         handoff.get("tokens_covered", 0)))
+            self._fetches.inc(outcome="ok")
+            request["kv_handoff"] = handoff
+            return json.dumps(request).encode()
+        if not asked:
+            self._fetches.inc(outcome="none")
+        elif failed == asked:
+            self._fetches.inc(outcome="error")
+        else:
+            self._fetches.inc(outcome="miss")
+        return body
 
     def _forward_once(self, state: EndpointState, method, path, body,
                       headers, deadline):

@@ -24,6 +24,7 @@ from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from kubeflow_tpu.models.transformer import (
     Transformer,
@@ -580,6 +581,29 @@ def import_kv_pages(state, pages_k, pages_v, ids):
     state["cache_k"] = scatter(state["cache_k"], pages_k)
     state["cache_v"] = scatter(state["cache_v"], pages_v)
     return state
+
+
+def gather_kv_pages(state, ids):
+    """The inverse of ``import_kv_pages``, host side: pull physical
+    blocks ``ids`` out of the pool as HOST page stacks — one batched
+    fancy index per pool side ([layers, n, block_tokens, hkv, d] in a
+    single transfer, never a per-block loop).  Returns
+    ``((k_vals, k_scale), (v_vals, v_scale))`` as numpy arrays (scale
+    is None for fp pools).  Deliberately NOT jitted: ``n`` varies per
+    record and a traced gather would mint a new executable per shape,
+    breaking the engine's compiled-program guarantee.  Feeds the KV
+    export handoff (§5.9) and the host spill tier (§5.10); callers run
+    it on the engine loop thread only, between program dispatches,
+    because the pool buffers are donated to the step programs."""
+    ids = np.asarray(ids, np.int32)
+
+    def gather(pool):
+        if isinstance(pool, QTensor):
+            return (np.asarray(pool.values[:, ids]),
+                    np.asarray(pool.scale[:, ids]))
+        return np.asarray(pool[:, ids]), None
+
+    return gather(state["cache_k"]), gather(state["cache_v"])
 
 
 def _advance_slots(cfg: TransformerConfig, params, decode: DecodeConfig,

@@ -109,6 +109,13 @@ from kubeflow_tpu.serving.model_server import (
 from kubeflow_tpu.serving.prefix_cache import BlockManager
 from kubeflow_tpu.testing import faults
 
+class _SpillShed(Exception):
+    """Internal: a spill-tier fault struck mid-admission (the
+    engine.spill site raised during re-import).  The admission
+    dispatcher catches this and sheds the one affected request typed
+    429 — never engine death, never a leaked page."""
+
+
 # Step-duration histogram buckets: decode steps run ~0.1 ms (tiny CPU
 # smoke models) to ~100 ms (big models over a slow tunnel).
 _STEP_BUCKETS = (.0005, .001, .0025, .005, .01, .025, .05, .1, .25, .5,
@@ -154,6 +161,18 @@ FUSED_WASTED_TOTAL = "kft_engine_fused_steps_wasted_total"
 FUSED_WASTED_HELP = \
     "fused-round slot-steps dispatched but not delivered (early-exit " \
     "waste past a slot's EOS/budget/deadline), by engine"
+KV_SPILLED_GAUGE = "kft_engine_kv_spilled_blocks"
+KV_SPILLED_HELP = \
+    "paged-KV pages currently resident in the host spill tier, " \
+    "by engine"
+HOST_TIER_GAUGE = "kft_engine_host_tier_blocks"
+HOST_TIER_HELP = \
+    "host spill-tier capacity in pages (0 = tier disabled), by engine"
+KV_SPILL_TOTAL = "kft_engine_kv_spill_total"
+KV_SPILL_HELP = \
+    "paged-KV pages crossing the host spill tier, by engine and " \
+    "direction (out = device pages evacuated to host, in = host " \
+    "pages re-imported at admission)"
 
 # N-gram drafter bounds: suffixes of up to _SPEC_NGRAM_MAX tokens are
 # matched against the request's own history, down to _SPEC_NGRAM_MIN.
@@ -363,6 +382,7 @@ class DecodeEngine:
         kv_block_tokens: int = 16,
         kv_pool_blocks: int = 0,
         prefix_caching: bool = True,
+        host_spill_blocks: int = 0,
         max_queue_depth: int = 0,
         overload_retry_after_s: float = 1.0,
         speculative_tokens: int = 0,
@@ -430,6 +450,11 @@ class DecodeEngine:
             raise ValueError(
                 f"kv_pool_blocks must be >= 1, got {self.kv_pool_blocks}")
         self.prefix_caching = bool(prefix_caching)
+        # Host-RAM spill tier capacity in pages (§5.10): 0 disables.
+        # The tier rides the prefix index (spilled records are looked
+        # up by the same chained digests), so it requires caching.
+        self.host_spill_blocks = max(0, int(host_spill_blocks)) \
+            if self.prefix_caching else 0
         self.max_queue_depth = max(0, int(max_queue_depth))
         self.overload_retry_after_s = overload_retry_after_s
         self._eos = decode.eos_token >= 0
@@ -473,7 +498,8 @@ class DecodeEngine:
         # available() for shed attribution).
         self._mgr = BlockManager(self.kv_pool_blocks,
                                  self.kv_block_tokens,
-                                 caching=self.prefix_caching)
+                                 caching=self.prefix_caching,
+                                 host_blocks=self.host_spill_blocks)
         self._evict_rec_seen = 0
         self._evict_blk_seen = 0
         # AOT executables, built lazily by the loop thread: the step
@@ -546,6 +572,8 @@ class DecodeEngine:
             "kv_evictions": 0, "kv_shed_no_blocks": 0,
             "handoff_pages_out": 0, "handoff_pages_in": 0,
             "fused_rounds": 0, "fused_steps_wasted": 0,
+            "spill_pages_out": 0, "spill_pages_in": 0,
+            "parked_sessions": 0, "fetches": 0,
         }
         self._step_times: List[float] = []   # bounded reservoirs
         self._chunk_times: List[float] = []
@@ -595,6 +623,12 @@ class DecodeEngine:
             FUSED_ROUNDS_TOTAL, FUSED_ROUNDS_HELP)
         self._fused_wasted_ctr = REGISTRY.counter(
             FUSED_WASTED_TOTAL, FUSED_WASTED_HELP)
+        self._kv_spilled_gauge = REGISTRY.gauge(
+            KV_SPILLED_GAUGE, KV_SPILLED_HELP)
+        self._host_tier_gauge = REGISTRY.gauge(
+            HOST_TIER_GAUGE, HOST_TIER_HELP)
+        self._kv_spill_ctr = REGISTRY.counter(
+            KV_SPILL_TOTAL, KV_SPILL_HELP)
         # Fault-layer series: same names as the static batchers', so
         # shed/expired rates read uniformly across batching planes.
         self._shed_ctr = REGISTRY.counter(SHED_TOTAL, SHED_HELP)
@@ -603,6 +637,8 @@ class DecodeEngine:
         self._queue_gauge.set(0, engine=name)
         self._kv_blocks_gauge.set(self.kv_pool_blocks, engine=name)
         self._kv_used_gauge.set(0, engine=name)
+        self._kv_spilled_gauge.set(0, engine=name)
+        self._host_tier_gauge.set(self.host_spill_blocks, engine=name)
         from kubeflow_tpu.serving.sharding import mesh_devices
 
         self._mesh_gauge.set(mesh_devices(mesh), engine=name)
@@ -611,6 +647,7 @@ class DecodeEngine:
         self._occ_last = 0
         self._queue_last = 0
         self._kv_used_last = 0
+        self._kv_spilled_last = 0
         self._thread = threading.Thread(
             target=self._run, daemon=True, name=f"decode-engine-{name}")
         self._thread.start()
@@ -838,6 +875,7 @@ class DecodeEngine:
             "res_blocks": res_blocks, "res_left": 0, "blocks": [],
             "released": False,
             "export": export, "handoff": handoff,
+            "park": bool(inputs.get("park_kv")), "spill_in": None,
             # Adaptive draft width: grows on full accepts, shrinks on
             # full rejects; 0 = backed off (re-probes after cooldown).
             "spec_k": self.speculative_tokens, "spec_cool": 0,
@@ -960,6 +998,7 @@ class DecodeEngine:
                 "active_slots": sum(
                     r is not None for r in self._slot_req),
                 "kv_used": self._mgr.used_blocks(),
+                "host_used": self._mgr.host_used_blocks(),
                 "step_times": list(self._step_times),
                 "chunk_times": list(self._chunk_times),
                 "gap_times": list(self._gap_times),
@@ -1037,6 +1076,25 @@ class DecodeEngine:
             "kv_utilization": round(
                 extra["kv_used"] / self.kv_pool_blocks, 4)
             if self.kv_pool_blocks else 0.0,
+            # Hierarchical KV (§5.10): host spill-tier occupancy and
+            # flow.  tokens_addressable is the two-tier capacity story
+            # — positions servable without a cold prefill, device pool
+            # PLUS host tier; kv_spill_ratio is host-tier occupancy
+            # (used / capacity) — the same number the
+            # kft_serving_kv_spill_ratio gauge and the fleet-status
+            # SPILL% column render.
+            "host_spill_blocks": self.host_spill_blocks,
+            "host_tier_used": extra["host_used"],
+            "kv_spill_pages_out": c["spill_pages_out"],
+            "kv_spill_pages_in": c["spill_pages_in"],
+            "parked_sessions": c["parked_sessions"],
+            "kv_fetches": c["fetches"],
+            "tokens_addressable": (self.kv_pool_blocks
+                                   + self.host_spill_blocks)
+            * self.kv_block_tokens,
+            "kv_spill_ratio": round(
+                extra["host_used"] / self.host_spill_blocks, 4)
+            if self.host_spill_blocks else 0.0,
             # Multi-chip serving: how many devices this engine's mesh
             # spans (1 = single-device) and how many paged-KV pages
             # have crossed the disaggregated prefill/decode boundary
@@ -1129,6 +1187,9 @@ class DecodeEngine:
         self._set_queue_gauge(0)
         self._kv_blocks_gauge.set(0, engine=self._metric_name)
         self._set_kv_used_gauge(0)
+        self._kv_spilled_gauge.set(0, engine=self._metric_name)
+        self._kv_spilled_last = 0
+        self._host_tier_gauge.set(0, engine=self._metric_name)
         self._mesh_gauge.set(0, engine=self._metric_name)
 
     def _mesh_devices(self) -> int:
@@ -1250,10 +1311,27 @@ class DecodeEngine:
         jumped into starvation.  A request carrying a KV-handoff
         payload skips the local prefix lookup (limit 0): its pages
         arrive from the prefill tier and land in PRIVATE blocks, so
-        the whole worst case reserves."""
+        the whole worst case reserves.
+
+        Hierarchical KV (§5.10): when the HOST tier covers more of the
+        prompt than the device index, the admission plans like a
+        handoff import instead — full private reservation, spilled
+        pages re-imported through the same ``kv_import`` program — so
+        a spilled session resumes without re-prefilling what the tier
+        preserved."""
         prompt = entry["tokens"][0]
         limit = 0 if entry.get("handoff") else int(prompt.shape[0]) - 1
-        return self._mgr.admit(prompt, limit, entry["res_blocks"])
+        spill_in = None
+        if limit > 0 and self.host_spill_blocks:
+            payload, depth = self._mgr.lookup_spilled(prompt, limit)
+            if payload is not None and depth * self.kv_block_tokens \
+                    > self._mgr.peek(prompt, limit):
+                spill_in = (payload, depth)
+                limit = 0
+        plan = self._mgr.admit(prompt, limit, entry["res_blocks"])
+        if plan is not None:
+            entry["spill_in"] = spill_in
+        return plan
 
     # -- disaggregated prefill/decode handoff -----------------------------
 
@@ -1344,39 +1422,81 @@ class DecodeEngine:
         pad_s[:, :n] = scale
         return QTensor(pad, pad_s, (-1,))
 
-    def _import_handoff(self, entry: dict) -> None:
-        """Admission, handoff side (loop thread, slot claimed): take
+    def _import_pages(self, entry: dict, pages: dict) -> int:
+        """Shared page-import tail (loop thread, slot claimed): take
         the covered pages from the entry's reservation, scatter the
-        transferred page data into them (ONE kv_import program call —
-        the transfer unit is a block-page list, never a contiguous
-        slot region), and start chunked prefill at the covered offset
-        — from there the request is indistinguishable from a local
-        prefix-cache resume, which is what makes handoff import
-        token-identical to local prefill at every chunk boundary."""
+        page data into them (ONE kv_import program call — the
+        transfer unit is a block-page list, never a contiguous slot
+        region), and set the chunk-prefill offset past them — from
+        there the request is indistinguishable from a local
+        prefix-cache resume, which is what makes both handoff import
+        (§5.9) and host-tier re-import (§5.10) token-identical to
+        local prefill at every chunk boundary.  ``pages`` is the
+        normalized {"covered", "k", "v"} form."""
         from kubeflow_tpu.models.generate import import_kv_pages
 
-        handoff = entry["handoff"]
-        # Chaos hook: the decode-tier import path (sleep = slow
-        # cross-replica transfer, raise = import failure — the router
-        # surfaces it rather than hanging the tiered dispatch).
-        faults.fire("engine.kv_handoff")
-        self._ensure_cover(entry, handoff["covered"] - 1)
-        n = handoff["covered"] // self.kv_block_tokens
+        self._ensure_cover(entry, pages["covered"] - 1)
+        n = pages["covered"] // self.kv_block_tokens
         span = self._table_blocks
         ids = np.full((span,), self.kv_pool_blocks, np.int32)
         ids[:n] = entry["blocks"][:n]
-        pages_k = self._pad_pages(handoff["k"], span)
-        pages_v = self._pad_pages(handoff["v"], span)
+        pages_k = self._pad_pages(pages["k"], span)
+        pages_v = self._pad_pages(pages["v"], span)
         if self._import_exec is None:
             self._import_exec = import_kv_pages.lower(
                 self._state, pages_k, pages_v, ids).compile()
         self._state = self._import_exec(
             self._state, pages_k, pages_v, ids)
-        entry["pos"] = handoff["covered"]
+        entry["pos"] = pages["covered"]
+        return n
+
+    def _import_handoff(self, entry: dict) -> None:
+        """Admission, handoff side: scatter the prefill tier's
+        transferred pages into the reserved blocks and start chunked
+        prefill at the covered offset."""
+        # Chaos hook: the decode-tier import path (sleep = slow
+        # cross-replica transfer, raise = import failure — the router
+        # surfaces it rather than hanging the tiered dispatch).
+        faults.fire("engine.kv_handoff")
+        n = self._import_pages(entry, entry["handoff"])
         with self._lock:
             self._counters["handoff_pages_in"] += n
         self._handoff_ctr.inc(n, engine=self._metric_name,
                               direction="import")
+
+    def _import_spill(self, entry: dict) -> None:
+        """Admission, host-tier side (§5.10): re-import the spilled
+        pages the plan matched, through the same kv_import program a
+        disaggregated handoff uses — re-admitting a spilled session
+        costs one page scatter plus the uncovered suffix's chunks,
+        never a full re-prefill.  A fault here sheds THIS admission
+        typed 429 (the caller releases its pages; the host record is
+        untouched, so no page leaks in either tier) instead of killing
+        the engine: losing one admission to a sick spill tier is
+        degradation, not death."""
+        payload, depth = entry.pop("spill_in")
+        try:
+            # Chaos hook: the spill-in import path (raise = spill-tier
+            # failure mid-admission -> typed 429; sleep = slow host
+            # copy).
+            faults.fire("engine.spill")
+        except Exception as exc:
+            raise _SpillShed(str(exc)) from exc
+        (k_vals, k_scale) = payload["k"]
+        (v_vals, v_scale) = payload["v"]
+        pages = {
+            "covered": depth * self.kv_block_tokens,
+            "k": (k_vals[:, :depth], None if k_scale is None
+                  else k_scale[:, :depth]),
+            "v": (v_vals[:, :depth], None if v_scale is None
+                  else v_scale[:, :depth]),
+        }
+        n = self._import_pages(entry, pages)
+        with self._lock:
+            self._counters["spill_pages_in"] += n
+            self._mgr.spills_in += n
+        self._kv_spill_ctr.inc(n, engine=self._metric_name,
+                               direction="in")
 
     def _attach_export(self, entry: dict) -> None:
         """Delivery, prefill side (loop thread, pages still held):
@@ -1385,7 +1505,7 @@ class DecodeEngine:
         ``kv_handoff`` imports, so prefill and decode tiers stay
         wire-symmetric.  Runs before release: the pages are still
         slot-referenced, so nothing can overwrite them mid-gather."""
-        from kubeflow_tpu.ops.quantize import QTensor
+        from kubeflow_tpu.models.generate import gather_kv_pages
 
         true_len = int(entry["tokens"].shape[1])
         n = min((true_len - 1) // self.kv_block_tokens,
@@ -1396,19 +1516,19 @@ class DecodeEngine:
         # failure at delivery; the router's tiered dispatch falls back
         # to the untiered path).
         faults.fire("engine.kv_handoff")
-        ids = np.asarray(entry["blocks"][:n], np.int32)
+        pages_k, pages_v = gather_kv_pages(
+            self._state, entry["blocks"][:n])
 
-        def gather(pool):
-            if isinstance(pool, QTensor):
-                return {"values": np.asarray(pool.values[:, ids]),
-                        "scale": np.asarray(pool.scale[:, ids])}
-            return np.asarray(pool[:, ids])
+        def wire(pages):
+            vals, scale = pages
+            return vals if scale is None \
+                else {"values": vals, "scale": scale}
 
         entry["out"]["kv_handoff"] = {
             "block_tokens": self.kv_block_tokens,
             "tokens_covered": n * self.kv_block_tokens,
-            "k": gather(self._state["cache_k"]),
-            "v": gather(self._state["cache_v"]),
+            "k": wire(pages_k),
+            "v": wire(pages_v),
         }
         with self._lock:
             self._counters["handoff_pages_out"] += n
@@ -1490,6 +1610,173 @@ class DecodeEngine:
             self._kv_used_last = used
             self._kv_used_gauge.set(used, engine=self._metric_name)
 
+    def _set_kv_spilled_gauge(self, spilled: int) -> None:
+        if spilled != self._kv_spilled_last:
+            self._kv_spilled_last = spilled
+            self._kv_spilled_gauge.set(spilled, engine=self._metric_name)
+
+    # -- host spill tier (§5.10) ------------------------------------------
+
+    def _spill_tick(self, max_records: int = 4) -> int:
+        """Evacuate LRU-cold idle records to the host tier while
+        take() pressure would otherwise destroy-evict them (loop
+        thread, between program calls — the pool buffers are donated
+        to the step programs, so nobody else may gather them).  Each
+        spill is select-under-lock, gather-OUTSIDE-the-lock (a device
+        read must never run under the engine lock), complete-under-
+        lock; spill() revalidates the candidate, so the off-lock
+        window is race-free.  A gather fault leaves the record
+        resident — destructive LRU eviction remains the fallback and
+        correctness is unharmed.  Returns records spilled."""
+        from kubeflow_tpu.models.generate import gather_kv_pages
+
+        spilled = 0
+        while spilled < max_records and self._mgr.spill_pressure() > 0:
+            with self._lock:
+                cands = self._mgr.spill_candidates(1)
+            if not cands:
+                break
+            rec = cands[0]
+            n = len(rec.blocks)
+            with self._lock:
+                # Gather-free fast path: a parked session's chain is
+                # already host-resident (host_put at delivery), so its
+                # device pages can drop without re-copying them.
+                freed = self._mgr.spill(rec, None)
+                if freed is not None:
+                    self._counters["spill_pages_out"] += n
+            if freed is not None:
+                self._kv_spill_ctr.inc(n, engine=self._metric_name,
+                                       direction="out")
+                spilled += 1
+                continue
+            try:
+                # Chaos hook: the spill-out gather (raise = gather
+                # failure — the record stays resident and eviction
+                # falls back to destroying it; sleep = slow host copy).
+                faults.fire("engine.spill")
+                pages_k, pages_v = gather_kv_pages(
+                    self._state, rec.blocks)
+            except Exception:
+                break
+            with self._lock:
+                freed = self._mgr.spill(
+                    rec, {"k": pages_k, "v": pages_v})
+                if freed is None:
+                    continue  # went stale off-lock; reselect
+                self._counters["spill_pages_out"] += n
+            self._kv_spill_ctr.inc(n, engine=self._metric_name,
+                                   direction="out")
+            spilled += 1
+        self._set_kv_spilled_gauge(
+            self._mgr.host_used_blocks())
+        return spilled
+
+    def _shed_admitted(self, entry: dict, slot: int, why: str) -> None:
+        """Shed one ALREADY-CLAIMED admission typed 429 (spill-tier
+        fault mid-admission): release its pages and reservation, free
+        the slot (no chunk was dispatched, so the previous occupant's
+        claim-time freeze still holds), and resolve the waiter.  The
+        host tier is untouched — its record still serves the next
+        attempt."""
+        with self._lock:
+            if self._slot_req[slot] is entry:
+                self._slot_req[slot] = None
+            self._tables[slot][:] = self.kv_pool_blocks
+            self._tables_dirty = True
+            self._release_entry_locked(entry)
+            self._counters["in_flight"] -= 1
+            self._counters["shed"] += 1
+            self._counters["kv_shed_no_blocks"] += 1
+        self._shed_ctr.inc(batcher=self._metric_name)
+        self._kv_shed_ctr.inc(engine=self._metric_name)
+        entry["err"] = Overloaded(
+            f"engine {self._metric_name!r} spill-tier re-import "
+            f"failed mid-admission: {why}",
+            retry_after_s=self.overload_retry_after_s)
+        entry["event"].set()
+
+    def _park_kv(self, entry: dict) -> None:
+        """Delivery-side session park (§5.10, loop thread, pages still
+        slot-held): publish the FULL context — prompt + emitted; the
+        last sampled token has no cache entry — as an ordinary device
+        record AND eagerly copy its full-block pages into the host
+        tier.  A parked conversation is cold by definition: the next
+        turn resumes through the device index while the record is
+        warm, through host-tier re-import once pressure spills it,
+        and over :fetch_kv from a surviving peer after failover.  A
+        gather fault degrades to device-resident-only parking."""
+        from kubeflow_tpu.models.generate import gather_kv_pages
+
+        context = np.concatenate(
+            [entry["tokens"][0],
+             np.asarray(entry["emitted"], np.int32)])
+        true_len = int(context.shape[0]) - 1
+        n = min(true_len // self.kv_block_tokens, len(entry["blocks"]))
+        with self._lock:
+            self._counters["parked_sessions"] += 1
+            if n > 0 and self.prefix_caching:
+                self._mgr.publish(context, true_len, entry["blocks"])
+        if n <= 0 or not self.host_spill_blocks:
+            return
+        try:
+            # Chaos hook: the park-side gather — same site and same
+            # degradation as the pressure spill above.
+            faults.fire("engine.spill")
+            pages_k, pages_v = gather_kv_pages(
+                self._state, entry["blocks"][:n])
+        except Exception:
+            return
+        with self._lock:
+            stored = self._mgr.host_put(
+                context, true_len, {"k": pages_k, "v": pages_v})
+            if stored:
+                self._counters["spill_pages_out"] += stored
+        if stored:
+            self._kv_spill_ctr.inc(stored, engine=self._metric_name,
+                                   direction="out")
+        self._set_kv_spilled_gauge(
+            self._mgr.host_used_blocks())
+
+    def fetch_kv(self, inputs: Dict[str, Any]) -> Dict[str, Any]:
+        """Fleet-wide session fetch (§5.10, any thread): serve the
+        longest HOST-TIER match of ``tokens`` in the export wire form
+        (``{"kv_handoff", "tokens_covered"}`` — encode_kv_handoff
+        makes it portable), or a miss with no payload.  Host tier
+        ONLY, by design: the device pool's buffers are donated to
+        in-flight step programs, so a transport thread must never
+        gather them — and parked/spilled sessions, the only state a
+        failover survivor needs, are host-resident by construction."""
+        tokens = np.asarray(inputs["tokens"], np.int32).reshape(-1)
+        # Chaos hook: the cross-replica fetch path (raise = fetch
+        # failure — the router falls back to recompute-resume; sleep =
+        # slow fetch).
+        faults.fire("engine.fetch")
+        with self._lock:
+            self._counters["fetches"] += 1
+            payload, depth = self._mgr.lookup_spilled(
+                tokens, int(tokens.shape[0]))
+        if payload is None:
+            return {"kv_handoff": None, "tokens_covered": 0}
+
+        def side(pages):
+            vals, scale = pages
+            if scale is None:
+                return vals[:, :depth]
+            return {"values": vals[:, :depth],
+                    "scale": scale[:, :depth]}
+
+        covered = depth * self.kv_block_tokens
+        return {
+            "kv_handoff": {
+                "block_tokens": self.kv_block_tokens,
+                "tokens_covered": covered,
+                "k": side(payload["k"]),
+                "v": side(payload["v"]),
+            },
+            "tokens_covered": covered,
+        }
+
     def _begin_prefill(self, entry: dict, slot: int) -> None:
         """Admission, host side.  The admission plan already aliased
         the longest cached prefix into the slot's block table (a
@@ -1539,6 +1826,10 @@ class DecodeEngine:
             # final chunk arms the slot exactly as a local prefill
             # would).
             self._import_handoff(entry)
+        elif entry.get("spill_in"):
+            # Host-tier re-import (§5.10): same mechanics, pages from
+            # this replica's own spill tier instead of the wire.
+            self._import_spill(entry)
         entry["prefilling"] = True
         self._prefill_chunk(entry)  # claim-time freeze + first chunk
         if entry["prefilling"]:
@@ -1621,6 +1912,10 @@ class DecodeEngine:
             # response (gathered before release, while the slot still
             # holds them).
             self._attach_export(entry)
+        if entry.get("park"):
+            # Multi-turn session park (§5.10): publish + host-copy the
+            # full context before release frees its pages.
+            self._park_kv(entry)
         if entry["want_timing"]:
             now = faults.monotonic()
             entry["out"]["ttft_s"] = (
@@ -2005,6 +2300,13 @@ class DecodeEngine:
         # Overlapped drafting for the next boundary's verify round.
         if self.speculative_tokens:
             self._draft_ahead(snapshot, width)
+        # Overlapped spill (§5.10): evacuate one cold record while the
+        # round computes — the gather is enqueued behind the in-flight
+        # round, so the host blocks at most where it would block on
+        # the round's tokens anyway, and pool pressure drains in the
+        # window PR 16 opened instead of on the admission path.
+        if self.host_spill_blocks:
+            self._spill_tick(1)
         # ---- round boundary: materialize ONCE, deliver, account.
         toks_np = np.asarray(toks)
         counts_np = np.asarray(counts)
@@ -2301,8 +2603,19 @@ class DecodeEngine:
                     # to drain in-flight slots.
                     self._fail_queue(BatcherClosed(
                         f"engine {self._metric_name!r} is closed"))
+                if self.host_spill_blocks:
+                    # Spill-then-admit (§5.10): evacuate LRU-cold idle
+                    # records to the host tier BEFORE this round's
+                    # take() calls (admission prefills below, chunk
+                    # budget, decode covers) can destroy-evict them —
+                    # pool pressure degrades to a host copy, not to
+                    # recompute.
+                    self._spill_tick()
                 for entry, slot in admissions:
-                    self._begin_prefill(entry, slot)
+                    try:
+                        self._begin_prefill(entry, slot)
+                    except _SpillShed as exc:
+                        self._shed_admitted(entry, slot, str(exc))
                 # Chunked prefill BETWEEN decode steps, under the
                 # per-step token budget: the head admission (FIFO —
                 # oldest finishes first, best TTFT) gets chunks until
@@ -2467,6 +2780,9 @@ class DecodeEngine:
                 # mutator; the guarded setter only touches the locked
                 # registry on change).
                 self._set_kv_used_gauge(self._mgr.used_blocks())
+                if self.host_spill_blocks:
+                    self._set_kv_spilled_gauge(
+                        self._mgr.host_used_blocks())
         except BaseException as exc:  # noqa: BLE001 — fail loudly to waiters
             self._abort(exc)
 

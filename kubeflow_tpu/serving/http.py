@@ -52,6 +52,13 @@ _ROUTES = [
     # forwards into a decode-tier :generate body.
     ("POST", re.compile(r"^/model/(?P<name>[^/:]+):prefill$"),
      "prefill"),
+    # Hierarchical KV, fetch tier (§5.10): answer with this replica's
+    # spilled/parked pages for a session prefix as a wire-encoded
+    # ``kv_handoff``, or {"kv_handoff": null} on a miss.  The fleet
+    # router's failover replay asks surviving peers here BEFORE
+    # falling back to resume-by-recompute.
+    ("POST", re.compile(r"^/model/(?P<name>[^/:]+):fetch_kv$"),
+     "fetch_kv"),
     ("POST", re.compile(
         r"^/model/(?P<name>[^/:]+)/version/(?P<version>\d+):predict$"),
      "predict"),
@@ -263,7 +270,7 @@ class ServingAPI:
         deadline = parse_deadline_ms(body)
         inputs: Dict[str, Any] = {"tokens": np.asarray(tokens, np.int32)}
         for key in ("max_new_tokens", "seed", "prompt_len",
-                    "resume_tokens"):
+                    "resume_tokens", "park_kv"):
             if body.get(key) is not None:
                 inputs[key] = body[key]
         if body.get("kv_handoff") is not None:
@@ -304,6 +311,28 @@ class ServingAPI:
             else encode_kv_handoff(payload),
             "tokens_covered": 0 if payload is None
             else int(payload["tokens_covered"]),
+        }
+
+    def fetch_kv(
+        self, name: str, body: Dict[str, Any],
+        version: Optional[int] = None,
+        idem_key: Optional[str] = None,
+    ) -> Dict[str, Any]:
+        """Hierarchical KV fetch (§5.10): look the prompt up in this
+        replica's host spill tier and answer with the covered prefix's
+        pages as a wire ``kv_handoff`` — or null on a miss (no spill
+        tier, no parked record, fault).  Pure read: replays are
+        harmless without dedup, like :prefill."""
+        tokens = body.get("tokens")
+        if tokens is None:
+            raise ValueError("Request json object must use the key: tokens")
+        out = self.server.fetch_kv(
+            name, {"tokens": np.asarray(tokens, np.int32)})
+        payload = out.get("kv_handoff")
+        return {
+            "kv_handoff": None if payload is None
+            else encode_kv_handoff(payload),
+            "tokens_covered": int(out.get("tokens_covered", 0)),
         }
 
     def classify(

@@ -37,6 +37,7 @@ def batcher_factory(*, micro_batch_size: int, batch_timeout_s: float,
                     prefill_chunk_tokens: int = 64,
                     kv_block_tokens: int = 16,
                     kv_pool_blocks: int = 0,
+                    host_spill_blocks: int = 0,
                     prefix_caching: bool = True,
                     max_queue_depth: int = 0,
                     overload_retry_after_s: float = 1.0,
@@ -108,6 +109,7 @@ def batcher_factory(*, micro_batch_size: int, batch_timeout_s: float,
                     prefill_chunk_tokens=prefill_chunk_tokens,
                     kv_block_tokens=kv_block_tokens,
                     kv_pool_blocks=kv_pool_blocks,
+                    host_spill_blocks=host_spill_blocks,
                     prefix_caching=prefix_caching,
                     max_queue_depth=max_queue_depth,
                     overload_retry_after_s=overload_retry_after_s,
@@ -235,6 +237,16 @@ def main(argv=None) -> int:
                          "slot count: mixed-length traffic fits far "
                          "more requests than the worst case, and "
                          "exhaustion sheds typed Overloaded (429)")
+    ap.add_argument("--host_spill_blocks", type=int, default=0,
+                    help="DecodeEngine host-RAM KV spill tier capacity "
+                         "in pages (0 = disabled, §5.10).  LRU-cold "
+                         "prefix records and parked multi-turn "
+                         "sessions evacuate to host memory under pool "
+                         "pressure and re-import through kv_import on "
+                         "the next hit — tokens-addressable capacity "
+                         "becomes (kv_pool_blocks + host_spill_blocks)"
+                         " x kv_block_tokens, and the :fetch_kv route "
+                         "serves these pages to failover peers")
     ap.add_argument("--no_prefix_cache", action="store_true",
                     help="disable shared-prefix block aliasing "
                          "(admissions never resume from cached "
@@ -349,6 +361,7 @@ def main(argv=None) -> int:
                 prefill_chunk_tokens=args.prefill_chunk_tokens,
                 kv_block_tokens=args.kv_block_tokens,
                 kv_pool_blocks=args.kv_pool_blocks,
+                host_spill_blocks=args.host_spill_blocks,
                 prefix_caching=not args.no_prefix_cache,
                 max_queue_depth=args.max_queue_depth,
                 overload_retry_after_s=args.overload_retry_after_s,

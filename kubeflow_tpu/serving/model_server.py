@@ -81,6 +81,13 @@ CACHED_RATIO_GAUGE = "kft_serving_cached_token_ratio"
 CACHED_RATIO_HELP = ("fraction of prompt tokens served from the engine "
                      "prefix cache; unlabeled = process aggregate, "
                      "model= per-model")
+# Hierarchical KV (§5.10): host-tier occupancy as a fraction of the
+# spill capacity — the fleet scrape and `fleet status` SPILL% column
+# read this per replica.
+SPILL_RATIO_GAUGE = "kft_serving_kv_spill_ratio"
+SPILL_RATIO_HELP = ("host spill-tier occupancy / host_spill_blocks "
+                    "(0 when the tier is disabled), by model; "
+                    "unlabeled = process aggregate")
 # Idempotency dedup: requests answered from the per-key result cache
 # (completed duplicates) or attached to an in-flight execution — the
 # survivable-inference counter a chaos run asserts on.
@@ -536,8 +543,10 @@ class ModelServer:
             inflight.set(count, model=name)
         queue = REGISTRY.gauge(QUEUE_GAUGE, QUEUE_HELP)
         ratio = REGISTRY.gauge(CACHED_RATIO_GAUGE, CACHED_RATIO_HELP)
+        spill = REGISTRY.gauge(SPILL_RATIO_GAUGE, SPILL_RATIO_HELP)
         cached_total = prompt_total = 0
-        any_engine = False
+        spill_used = spill_cap = 0
+        any_engine = any_spill = False
         for name in per_model:
             stats = self.batcher_stats(name) or {}
             queue.set(stats.get("queue_depth", 0) or 0, model=name)
@@ -549,6 +558,17 @@ class ModelServer:
                 ratio.set(stats["cached_token_ratio"], model=name)
                 cached_total += stats.get("cached_prompt_tokens", 0)
                 prompt_total += stats.get("prompt_tokens", 0)
+            cap = stats.get("host_spill_blocks", 0) or 0
+            if cap:
+                # Host spill-tier occupancy (§5.10): same reset-with-
+                # the-engine discipline as the cached ratio above.
+                any_spill = True
+                used = stats.get("host_tier_used", 0) or 0
+                spill.set(round(used / cap, 4), model=name)
+                spill_used += used
+                spill_cap += cap
+        if any_spill:
+            spill.set(round(spill_used / spill_cap, 4))
         if any_engine:
             # The unlabeled aggregate must RESET with its engines: a
             # hot-reload rebuilds the engine with an empty cache, and
@@ -760,6 +780,26 @@ class ModelServer:
             with self._lock:
                 self._inflight -= 1
                 self._inflight_by_model[name] -= 1
+
+    def fetch_kv(self, name: str,
+                 inputs: Dict[str, Any]) -> Dict[str, Any]:
+        """Hierarchical KV fetch (§5.10): look ``tokens`` up in the
+        model's engine host spill tier and return the covered prefix's
+        pages in engine export form, or a miss.  Raises KeyError on
+        unknown models and ValueError when the model has no engine.
+        A pure host-memory read — no in-flight bracket: a drain must
+        not wait on a peer's failover fetch, and the fetch must keep
+        answering WHILE this replica drains (the surviving session
+        state is exactly what a peer needs then)."""
+        self.get(name)  # KeyError -> 404 on unknown names
+        with self._lock:
+            batcher = self._batchers.get(name)
+        fetch_fn = getattr(batcher, "fetch_kv", None)
+        if fetch_fn is None:
+            raise ValueError(
+                f"model {name!r} has no decode engine "
+                f"(:fetch_kv requires the continuous-batching engine)")
+        return fetch_fn(inputs)
 
     def generate_stream(
         self, name: str, inputs: Dict[str, Any],

@@ -46,8 +46,26 @@ ALL of the host bookkeeping for that pool:
     "pinned" structurally — its blocks are slot-referenced until the
     capturing request retires.
 
+  - **the host-RAM spill tier** — an optional second tier
+    (``host_blocks`` pages of capacity) holding COPIES of cold KV
+    pages in host memory, keyed by the same chained digests.  The
+    engine gathers a cold record's device pages (one batched fancy
+    index over the pool), hands the resulting host arrays to
+    ``spill()``, and the device record is dropped — pages free without
+    destroying their contents.  A later admission that misses the
+    device index but hits ``lookup_spilled`` re-imports through the
+    existing ``kv_import`` program instead of re-prefilling.  The tier
+    is a pure overlay: host records never reference device block ids,
+    so no page is ever simultaneously device-writable and
+    host-spilled, and the device-side accounting (free/idle/reserved
+    arithmetic and its deadlock-freedom invariant) is untouched.
+    Host capacity is LRU-bounded like the device index; parked
+    session KV (``park_kv``) enters via ``host_put`` so idle
+    conversations stop squatting on HBM between turns.
+
 The index holds tokens hashes and block numbers only — no device
-memory — and dies with its engine, which is what makes model-reload
+memory (the host tier holds host copies, still no device handles) —
+and dies with its engine, which is what makes model-reload
 invalidation automatic (the serving layer rebuilds the engine, and
 with it this manager, around every hot-swapped version).
 
@@ -94,6 +112,20 @@ class _PrefixRecord:
         self.blocks = blocks
 
 
+class _HostRecord:
+    """One spilled/parked prefix in the host tier: the digest chain and
+    an opaque payload (the engine stores gathered numpy pages; block i
+    of the payload holds tokens [i*block, (i+1)*block)).  Never holds
+    device block ids."""
+
+    __slots__ = ("digests", "payload", "n_blocks")
+
+    def __init__(self, digests: List[bytes], payload, n_blocks: int):
+        self.digests = digests
+        self.payload = payload
+        self.n_blocks = n_blocks
+
+
 class BlockManager:
     """Paged-KV pool bookkeeping: refcounted physical blocks,
     reservation accounting, and the prefix index (module docstring).
@@ -104,19 +136,24 @@ class BlockManager:
         hash/share granularity (``--kv_block_tokens``).
       caching: publish/lookup prefixes (False = pure allocator; the
         engine's identity tests compare ON vs OFF).
+      host_blocks: host-tier capacity in pages (0 = no spill tier).
     """
 
     def __init__(self, num_blocks: int, block_tokens: int,
-                 caching: bool = True):
+                 caching: bool = True, host_blocks: int = 0):
         if num_blocks < 1:
             raise ValueError(
                 f"num_blocks must be >= 1, got {num_blocks}")
         if block_tokens < 1:
             raise ValueError(
                 f"block_tokens must be >= 1, got {block_tokens}")
+        if host_blocks < 0:
+            raise ValueError(
+                f"host_blocks must be >= 0, got {host_blocks}")
         self.num_blocks = int(num_blocks)
         self.block = int(block_tokens)
         self.caching = bool(caching)
+        self.host_blocks = int(host_blocks)
         # Free LIFO (pop from the end -> low block ids first, which
         # keeps tests deterministic and device pages warm).
         self._free: List[int] = list(range(self.num_blocks - 1, -1, -1))
@@ -134,6 +171,14 @@ class BlockManager:
         self._lru: "OrderedDict[int, _PrefixRecord]" = OrderedDict()
         self.evictions = 0        # prefix records evicted (LRU)
         self.block_evictions = 0  # physical blocks freed by eviction
+        # Host spill tier (module docstring): digest -> (record, depth);
+        # id(record) -> record, insertion order == LRU order.
+        self._host_chains: Dict[bytes, Tuple[_HostRecord, int]] = {}
+        self._host_lru: "OrderedDict[int, _HostRecord]" = OrderedDict()
+        self._host_used = 0       # host pages resident
+        self.spills_out = 0       # device pages copied into the host tier
+        self.spills_in = 0        # host pages re-imported to device
+        self.host_evictions = 0   # host pages destroyed by host-LRU
 
     # -- capacity ----------------------------------------------------------
 
@@ -145,6 +190,10 @@ class BlockManager:
     def used_blocks(self) -> int:
         """Pages resident (slot- or cache-held)."""
         return self.num_blocks - len(self._free)
+
+    def host_used_blocks(self) -> int:
+        """Pages resident in the host spill tier."""
+        return self._host_used
 
     # -- admission ---------------------------------------------------------
 
@@ -233,6 +282,20 @@ class BlockManager:
                 return list(rec.blocks[:i]), i * self.block
         return [], 0
 
+    def peek(self, tokens: np.ndarray, limit: int) -> int:
+        """Device-tier coverage of ``tokens`` in cached positions,
+        without aliasing anything or touching LRU order (the engine
+        compares this against ``lookup_spilled`` coverage to decide
+        whether a spilled record beats the resident index)."""
+        n_blocks = int(limit) // self.block
+        if not self.caching or n_blocks <= 0 or not self._chains:
+            return 0
+        digests = _block_digests(tokens, self.block, n_blocks)
+        for i in range(n_blocks, 0, -1):
+            if digests[i - 1] in self._chains:
+                return i * self.block
+        return 0
+
     def publish(self, tokens: np.ndarray, true_len: int,
                 blocks: Sequence[int]) -> int:
         """Register a completed prefill's full-block prefix: digest i
@@ -265,6 +328,140 @@ class BlockManager:
         self._lru[id(rec)] = rec
         return new_tokens
 
+    # -- host spill tier ---------------------------------------------------
+
+    def spillable_blocks(self) -> int:
+        """Device pages that spilling could preserve instead of
+        destroy-evicting: idle cached pages, when the tier is on."""
+        return self._cached_idle if self.host_blocks else 0
+
+    def spill_pressure(self) -> int:
+        """Reservation pages the free list alone cannot cover — the
+        number of upcoming take() calls that would have to DESTROY
+        cached pages via LRU eviction.  The engine spills while this
+        is positive (and candidates exist), which is what turns
+        `free + spillable >= reserved` from an eviction bound into a
+        preservation guarantee."""
+        if not self.host_blocks:
+            return 0
+        return max(0, self._reserved - len(self._free))
+
+    def spill_candidates(self, max_records: int = 1) -> List[_PrefixRecord]:
+        """Up to ``max_records`` LRU-coldest device records whose pages
+        are ALL idle (no live slot aliases them) — safe to gather and
+        drop.  Selection only; the engine gathers the pages off-lock
+        and completes with ``spill()``."""
+        if not self.host_blocks:
+            return []
+        out: List[_PrefixRecord] = []
+        for rec in self._lru.values():
+            if len(rec.digests) > self.host_blocks:
+                continue  # never storable; destroy-evict is its fate
+            if all(self._slot_ref[b] == 0 for b in rec.blocks):
+                out.append(rec)
+                if len(out) >= max_records:
+                    break
+        return out
+
+    def spill(self, rec: _PrefixRecord, payload) -> Optional[int]:
+        """Complete a spill: store ``payload`` (the gathered host copy
+        of ``rec``'s pages) in the host tier and drop the device
+        record, freeing its idle pages WITHOUT destroying their
+        contents.  Validates the record is still live and still fully
+        idle (the gather ran outside the manager's lock); a stale or
+        unstorable candidate declines with None.  Returns device pages
+        freed (0 is a SUCCESS whose pages other records still pin).
+
+        ``payload=None`` is the gather-free fast path: succeed ONLY if
+        the record's chain is already host-resident (a parked session
+        the engine host_put at delivery) — the device pages can drop
+        without any copy because the host tier already serves them.
+        Declining (None) tells the caller to gather and retry."""
+        if not self.host_blocks or id(rec) not in self._lru:
+            return None
+        if any(self._slot_ref[b] != 0 for b in rec.blocks):
+            return None  # re-aliased since selection; still hot
+        if payload is None and rec.digests[-1] not in self._host_chains:
+            return None  # no host copy to lean on; caller must gather
+        freed = sum(1 for b in rec.blocks
+                    if self._rec_ref[b] == 1 and self._slot_ref[b] == 0)
+        if payload is not None:
+            self._host_store(rec.digests, payload)
+        else:
+            hrec, _ = self._host_chains[rec.digests[-1]]
+            self._host_lru.move_to_end(id(hrec))
+        if rec.digests[-1] not in self._host_chains:
+            # Not storable (larger than the whole host tier) and not
+            # already resident: dropping would destroy the only copy.
+            return None
+        del self._lru[id(rec)]
+        self._drop_record(rec, count=False)
+        self.spills_out += len(rec.blocks)
+        return freed
+
+    def host_put(self, tokens: np.ndarray, true_len: int,
+                 payload) -> int:
+        """Store a host copy of ``tokens``' full-block prefix directly
+        (parked session KV: the engine gathers the pages at delivery
+        and parks them here so the session's device pages can retire).
+        Returns host pages stored (0 = disabled, dup, or too short)."""
+        if not self.host_blocks:
+            return 0
+        n_blocks = int(true_len) // self.block
+        if n_blocks <= 0:
+            return 0
+        digests = _block_digests(tokens, self.block, n_blocks)
+        return self._host_store(digests, payload)
+
+    def _host_store(self, digests: List[bytes], payload) -> int:
+        if len(digests) > self.host_blocks:
+            return 0  # larger than the whole tier — never storable
+        if digests[-1] in self._host_chains:
+            # First-writer-wins, same as publish(): the established
+            # host record already serves the full chain.
+            hrec, _ = self._host_chains[digests[-1]]
+            self._host_lru.move_to_end(id(hrec))
+            return 0
+        hrec = _HostRecord(list(digests), payload, len(digests))
+        for i, d in enumerate(digests):
+            if d not in self._host_chains:
+                self._host_chains[d] = (hrec, i + 1)
+        self._host_lru[id(hrec)] = hrec
+        self._host_used += hrec.n_blocks
+        # The new record is MRU and fits by the guard above, so this
+        # terminates with it resident.
+        while self._host_used > self.host_blocks:
+            self._evict_host_lru()
+        return hrec.n_blocks
+
+    def lookup_spilled(self, tokens: np.ndarray,
+                       limit: int) -> Tuple[Optional[object], int]:
+        """Longest host-tier match of ``tokens`` covering at most
+        ``limit`` positions: (payload, depth_blocks) — the payload
+        covers AT LEAST ``depth_blocks`` pages and the caller trims to
+        that depth — or (None, 0) on a miss.  Touches host LRU."""
+        n_blocks = int(limit) // self.block
+        if not self.host_blocks or n_blocks <= 0 or not self._host_chains:
+            return None, 0
+        digests = _block_digests(tokens, self.block, n_blocks)
+        for i in range(n_blocks, 0, -1):
+            ent = self._host_chains.get(digests[i - 1])
+            if ent is not None:
+                hrec, depth = ent
+                assert depth == i, (depth, i)
+                self._host_lru.move_to_end(id(hrec))
+                return hrec.payload, i
+        return None, 0
+
+    def _evict_host_lru(self) -> None:
+        _, hrec = self._host_lru.popitem(last=False)
+        for d in hrec.digests:
+            ent = self._host_chains.get(d)
+            if ent is not None and ent[0] is hrec:
+                del self._host_chains[d]
+        self._host_used -= hrec.n_blocks
+        self.host_evictions += hrec.n_blocks
+
     # -- maintenance -------------------------------------------------------
 
     def _drop_record(self, rec: _PrefixRecord, count: bool) -> None:
@@ -293,10 +490,14 @@ class BlockManager:
         """Forget every cached prefix (engine close / model reload: a
         new version's KV is numerically unrelated, so serving a stale
         prefix would be silent corruption).  Pages still aliased by
-        live slots stay resident until those slots release them."""
+        live slots stay resident until those slots release them.  The
+        host tier drops too — its copies are the same stale KV."""
         while self._lru:
             _, rec = self._lru.popitem(last=False)
             self._drop_record(rec, count=False)
+        self._host_chains.clear()
+        self._host_lru.clear()
+        self._host_used = 0
 
     def stats(self) -> Dict[str, int]:
         return {
@@ -310,6 +511,12 @@ class BlockManager:
             "published_digests": len(self._chains),
             "evictions": self.evictions,
             "block_evictions": self.block_evictions,
+            "host_blocks": self.host_blocks,
+            "host_used_blocks": self._host_used,
+            "host_records": len(self._host_lru),
+            "spills_out": self.spills_out,
+            "spills_in": self.spills_in,
+            "host_evictions": self.host_evictions,
         }
 
     def check_invariants(self) -> None:
@@ -332,3 +539,22 @@ class BlockManager:
             assert rec_id == id(rec)
             for b in rec.blocks:
                 assert self._rec_ref[b] >= 1
+        # Host tier: the overlay never references device pages, its
+        # page accounting matches its records, and every chain entry
+        # points into a live record at the right depth.
+        assert self._host_used == sum(
+            h.n_blocks for h in self._host_lru.values()), (
+            self._host_used, "host page accounting broken")
+        assert self._host_used <= self.host_blocks, "host tier over capacity"
+        live_host = {id(h) for h in self._host_lru.values()}
+        for d, (hrec, depth) in self._host_chains.items():
+            assert id(hrec) in live_host, "host chain to evicted record"
+            assert 1 <= depth <= hrec.n_blocks
+            assert hrec.digests[depth - 1] == d
+        for hrec_id, hrec in self._host_lru.items():
+            assert hrec_id == id(hrec)
+            assert hrec.n_blocks == len(hrec.digests)
+            # The full chain must resolve through _host_chains (its
+            # tail digest always maps to this record or a first-writer
+            # predecessor covering the same prefix).
+            assert hrec.digests[-1] in self._host_chains

@@ -1472,6 +1472,299 @@ def survivable_smoke(namespace: str = "kubeflow-test") -> None:
                 srv.stop()
 
 
+def kv_spill_smoke(namespace: str = "kubeflow-test") -> None:
+    """Hermetic hierarchical-KV scenario (§5.10): three engine
+    replicas with a TIGHT device pool (12 pages) and a host spill
+    tier behind the fleet router.
+
+      1. control — uninterrupted turn-1 and turn-2 greedy streams
+         recorded on one replica;
+      2. spill under pressure — multi-turn sessions park their KV
+         (``park_kv``) on a replica until the parked mass exceeds the
+         device pool; the overflow spills to host RAM with ZERO
+         sheds and ZERO destructive evictions
+         (kft_engine_kv_spill_total{direction="out"} and the host-
+         tier gauge move, kv_shed stays flat);
+      3. re-import — the first parked session's turn 2 re-imports its
+         spilled pages through kv_import (spill_total{direction="in"}
+         delta) and streams BIT-IDENTICAL to the uninterrupted
+         control;
+      4. resume-by-FETCH failover — a session parked on BOTH
+         surviving replicas is killed mid-generation on whichever
+         replica serves its turn 2; the router's replay fetches the
+         session's pages from a surviving peer (:fetch_kv,
+         kft_router_kv_fetch_total{outcome="ok"} delta, engine.fetch
+         hook-site encounter) and the spliced stream equals the
+         control.
+    """
+    import json
+    import os
+    import socket
+    import tempfile
+    import threading
+    import urllib.request
+    from http.server import ThreadingHTTPServer
+
+    import jax
+    import numpy as np
+
+    from kubeflow_tpu.fleet.endpoints import (
+        Endpoint,
+        EndpointRegistry,
+        StaticEndpoints,
+    )
+    from kubeflow_tpu.fleet.router import FleetRouter, make_router_server
+    from kubeflow_tpu.models.transformer import Transformer
+    from kubeflow_tpu.runtime.prom import parse_metrics, sample_value
+    from kubeflow_tpu.serving.export import export
+    from kubeflow_tpu.serving.http import make_http_server
+    from kubeflow_tpu.serving.loaders import _model_config
+    from kubeflow_tpu.serving.main import batcher_factory
+    from kubeflow_tpu.serving.model_server import ModelServer
+    from kubeflow_tpu.testing import faults
+
+    class KillableServer(ThreadingHTTPServer):
+        """See survivable_smoke: severs live sockets on kill()."""
+
+        def __init__(self, *a, **kw):
+            super().__init__(*a, **kw)
+            self._live = set()
+            self._live_lock = threading.Lock()
+
+        def process_request(self, request, client_address):
+            with self._live_lock:
+                self._live.add(request)
+            super().process_request(request, client_address)
+
+        def shutdown_request(self, request):
+            with self._live_lock:
+                self._live.discard(request)
+            super().shutdown_request(request)
+
+        def handle_error(self, request, client_address):
+            pass
+
+        def kill(self):
+            with self._live_lock:
+                live = list(self._live)
+                self._live.clear()
+            for sock in live:
+                try:
+                    sock.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                sock.close()
+            self.shutdown()
+            self.server_close()
+
+    overrides = {
+        "vocab_size": 128, "d_model": 32, "n_layers": 2, "n_heads": 4,
+        "n_kv_heads": 2, "d_ff": 64, "head_dim": 8, "max_seq_len": 64,
+        "dtype": "float32",
+    }
+    max_new = 12
+    rng = np.random.RandomState(20260807)
+    prompts = [rng.randint(1, 120, size=(9 + i,)).tolist()
+               for i in range(5)]
+    scenario = os.environ.get(faults.ENV) or \
+        "seed=20260807;engine.step:sleep=0.02"
+
+    def make_replica(base, port=0):
+        server = ModelServer()
+        server.add_model("lm", base)
+        server.enable_batching("lm", batcher_factory(
+            micro_batch_size=0, batch_timeout_s=0.005,
+            lm_engine=True, lm_engine_slots=2,
+            lm_engine_prefill_len=32, max_queue_depth=16,
+            kv_block_tokens=4, kv_pool_blocks=12,
+            host_spill_blocks=60))
+        httpd, _ = make_http_server(server, port=port, host="127.0.0.1",
+                                    server_cls=KillableServer)
+        return server, httpd
+
+    def stream_via(port, body, on_tokens=None, timeout=180):
+        import http.client
+
+        conn = http.client.HTTPConnection("127.0.0.1", port,
+                                          timeout=timeout)
+        conn.request("POST", "/model/lm:generate",
+                     json.dumps(body).encode(),
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        assert resp.status == 200, (resp.status, resp.read())
+        meta = terminal = None
+        tokens = []
+        while True:
+            line = resp.readline()
+            if not line:
+                break
+            line = line.strip()
+            if not line:
+                continue
+            msg = json.loads(line)
+            if "meta" in msg:
+                meta = msg["meta"]
+            elif "tokens" in msg:
+                tokens.extend(msg["tokens"])
+                if on_tokens is not None:
+                    on_tokens(tokens)
+            if "done" in msg or "error" in msg:
+                terminal = msg
+                break
+        conn.close()
+        return meta, tokens, terminal
+
+    def scrape(port):
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=30) as resp:
+            return parse_metrics(resp.read().decode())
+
+    model = Transformer(_model_config(overrides))
+    variables = model.init(jax.random.key(0), np.zeros((1, 4), np.int32))
+    replicas = []
+    router_httpd = None
+    with faults.injected(scenario) as inj, \
+            tempfile.TemporaryDirectory() as tmp:
+        export(f"{tmp}/lm", 1, variables,
+               loader="kubeflow_tpu.serving.loaders:lm_generate",
+               config={"model": overrides, "max_new_tokens": max_new,
+                       "temperature": 0.0})
+        try:
+            replicas = [list(make_replica(f"{tmp}/lm"))
+                        for _ in range(3)]
+            ports = [h.server_address[1] for _, h in replicas]
+            eps = [Endpoint(name=f"srv-{i}",
+                            url=f"http://127.0.0.1:{p}")
+                   for i, p in enumerate(ports)]
+            registry = EndpointRegistry(
+                StaticEndpoints(eps), probe_interval_s=0.2,
+                eject_threshold=3, eject_backoff_s=2.0)
+            registry.refresh()
+            assert len(registry.routable()) == 3, registry.describe()
+            router = FleetRouter(registry, max_tries=3, max_replays=2,
+                                 try_timeout_s=180.0)
+            router_httpd, _ = make_router_server(router, port=0,
+                                                 host="127.0.0.1")
+            rport = router_httpd.server_address[1]
+
+            # -- 1. uninterrupted controls on replica 0 ---------------
+            def turn1_body(i, park=False):
+                b = {"tokens": prompts[i], "max_new_tokens": max_new}
+                if park:
+                    b["park_kv"] = True
+                return b
+
+            controls = {}
+            for i in range(len(prompts)):
+                _, toks, term = stream_via(ports[0], turn1_body(i))
+                assert term.get("done") and len(toks) == max_new
+                controls[i] = toks
+            # Turn 2 extends turn 1's full context with 3 user tokens.
+            extra = rng.randint(1, 120, size=(3,)).tolist()
+
+            def turn2_body(i):
+                return {"tokens": prompts[i] + controls[i] + extra,
+                        "max_new_tokens": max_new}
+
+            control2 = {}
+            for i in (0, 1):
+                _, toks, term = stream_via(ports[0], turn2_body(i))
+                assert term.get("done"), term
+                control2[i] = toks
+
+            before = scrape(rport)
+            spills_before = inj.fired("engine.spill")
+
+            def delta(name, **labels):
+                # Deltas, not absolutes: the registry is process-wide
+                # and an earlier in-process scenario may have moved
+                # the same counters.  Engine-labeled reads must pin
+                # engine="lm-v1" (batcher_factory names engines
+                # {model}-v{version}): sample_value returns the FIRST
+                # matching series, and an earlier test file's engines
+                # (default name "engine") register theirs first.
+                return (sample_value(scrape(rport), name, **labels)
+                        or 0) - (sample_value(before, name, **labels)
+                                 or 0)
+
+            # -- 2. parked sessions overflow the pool into host RAM --
+            # Replica 1 parks every session (5 contexts x ~5 pages in
+            # a 12-page pool => the cold ones MUST spill); replica 2
+            # parks session 1 too — the fetch-failover scenario needs
+            # the session host-resident on BOTH survivors.
+            for i in range(len(prompts)):
+                _, toks, term = stream_via(
+                    ports[1], turn1_body(i, park=True))
+                assert term.get("done") and toks == controls[i], (
+                    f"parked session {i} diverged", toks)
+            _, toks, _ = stream_via(ports[2], turn1_body(1, park=True))
+            assert toks == controls[1]
+            assert inj.fired("engine.spill") > spills_before
+            assert delta("kft_engine_kv_spill_total",
+                         engine="lm-v1", direction="out") > 0
+            assert (sample_value(scrape(rport),
+                                 "kft_engine_host_tier_blocks",
+                                 engine="lm-v1")
+                    or 0) > 0
+            assert delta("kft_engine_kv_shed_no_blocks_total",
+                         engine="lm-v1") == 0, (
+                "pool-exhaustion shed while spillable mass existed")
+            st1 = replicas[1][0].batcher_stats("lm") or {}
+            assert st1.get("shed", 0) == 0, st1
+            assert st1.get("parked_sessions") == len(prompts)
+            assert st1.get("tokens_addressable") == (12 + 60) * 4
+            assert st1.get("kv_spill_ratio", 0) > 0
+
+            # -- 3. turn-2 re-import: bit-identical to the control ----
+            _, toks, term = stream_via(ports[1], turn2_body(0))
+            assert term.get("done") and toks == control2[0], (
+                "re-imported resume diverged from control",
+                toks, control2[0])
+            assert delta("kft_engine_kv_spill_total",
+                         engine="lm-v1", direction="in") > 0, \
+                "turn 2 did not re-import spilled pages"
+
+            # -- 4. kill mid-generation; resume by FETCH from a peer --
+            killed: dict = {}
+            kill_lock = threading.Lock()
+
+            def maybe_kill(tokens):
+                if len(tokens) < 3:
+                    return
+                with kill_lock:
+                    if killed:
+                        return
+                    for i, (srv, httpd) in enumerate(replicas):
+                        stats = srv.batcher_stats("lm") or {}
+                        if stats.get("in_flight_requests", 0) > 0:
+                            killed["index"] = i
+                            httpd.kill()
+                            return
+
+            meta, toks, term = stream_via(rport, turn2_body(1),
+                                          on_tokens=maybe_kill)
+            assert killed, "the kill never fired"
+            assert term is not None and term.get("done"), term
+            assert toks == control2[1], (
+                "fetch-resumed stream diverged from control",
+                toks, control2[1])
+            assert delta("kft_router_kv_fetch_total",
+                         outcome="ok") >= 1
+            assert delta("kft_router_replays_total",
+                         outcome="ok") >= 1
+            assert inj.fired("engine.fetch") >= 1
+        finally:
+            if router_httpd is not None:
+                router_httpd.shutdown()
+            for srv, httpd in replicas:
+                try:
+                    httpd.shutdown()
+                    httpd.server_close()
+                except Exception:
+                    pass
+                srv.stop()
+
+
 def multichip_serving_smoke(namespace: str = "kubeflow-test") -> None:
     """Hermetic multi-chip serving scenario (§5.9) over a forced
     multi-device host platform:
@@ -2277,6 +2570,7 @@ COMMANDS = {
     "faults": fault_injection_smoke,
     "fleet": fleet_smoke,
     "survivable": survivable_smoke,
+    "kv_spill": kv_spill_smoke,
     "multichip_serving": multichip_serving_smoke,
     "scheduler": scheduler_smoke,
     "train": train_smoke,

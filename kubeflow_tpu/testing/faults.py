@@ -48,6 +48,18 @@ Hook sites planted in production code (grep for ``faults.fire``):
                       dispatch decision for a :generate (raise =
                       tier routing failure — the request must fall
                       back to the untiered path, never hang or 500)
+    engine.spill      hierarchical-KV host-tier traffic: the spill-out
+                      gather (raise = spill abandoned, the record
+                      stays device-resident and destructive eviction
+                      remains the fallback), the park gather (raise =
+                      the session parks device-resident only), and
+                      the spill-in re-import at admission (raise =
+                      typed Overloaded shed, no page leaked in
+                      either tier; sleep = slow host copy)
+    engine.fetch      the :fetch_kv host-tier read a failover peer
+                      asks for a session's pages (raise = fetch
+                      failure — the router falls back to
+                      recompute-resume, sleep = slow fetch)
     fleet.probe       endpoint registry readiness probe attempt
     scheduler.admit   cluster scheduler admission-plan pass (skew =
                       age the queue / expire preemption windows,

@@ -134,8 +134,8 @@ def default_e2e(name: str = "e2e", namespace: str = "kubeflow-test",
 # resolve to kubeflow_tpu.testing.e2e subcommands).
 PLATFORM_STEPS = {
     "hermetic": ["tpujob", "scheduler", "serving", "engine", "faults",
-                 "fleet", "survivable", "multichip_serving", "train",
-                 "train_resilience"],
+                 "fleet", "survivable", "kv_spill", "multichip_serving",
+                 "train", "train_resilience"],
     "kind": ["deploy-crds", "tpujob-real"],
     "gke": ["deploy", "tpujob-real"],
 }

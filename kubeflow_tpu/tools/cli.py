@@ -174,15 +174,19 @@ def cmd_fleet_status(args: argparse.Namespace) -> int:
     if not rows:
         print("no endpoints discovered")
         return 0
-    fmt = "{:<20} {:<10} {:<8} {:<10} {:>9} {:>12} {:>7} {:>9}"
+    fmt = "{:<20} {:<10} {:<8} {:<10} {:>9} {:>12} {:>7} {:>7} {:>9}"
     print(fmt.format("ENDPOINT", "STATE", "TIER", "BREAKER",
-                     "INFLIGHT", "QUEUE_DEPTH", "CACHE%", "FAILURES"))
+                     "INFLIGHT", "QUEUE_DEPTH", "CACHE%", "SPILL%",
+                     "FAILURES"))
     for row in rows:
         # Prefix-cache effectiveness per replica (engine models only;
         # replicas that predate the metric report "-").  TIER is the
         # disaggregation role the replica advertises on /readyz
         # (prefill/decode/unified — §5.9); pre-tier routers report "-".
+        # SPILL% is host spill-tier occupancy (§5.10) — "-" on
+        # replicas without a spill tier or pre-spill routers.
         ratio = row.get("cached_token_ratio")
+        spill = row.get("kv_spill_ratio")
         print(fmt.format(row["name"], row["state"],
                          row.get("tier", "-"),
                          row.get("breaker_state", "-"),
@@ -190,6 +194,7 @@ def cmd_fleet_status(args: argparse.Namespace) -> int:
                          int(row["queue_depth"]),
                          f"{ratio * 100:.0f}%" if ratio is not None
                          else "-",
+                         f"{spill * 100:.0f}%" if spill else "-",
                          row["breaker_failures"]))
     if isinstance(payload, dict):
         budget = payload.get("retry_budget") or {}

@@ -105,6 +105,7 @@ class TestSharding:
 
 
 class TestTrainCnnFromShards:
+    @pytest.mark.slow  # ~22s CNN train; the readers have direct tests above
     def test_train_cnn_reads_kftr(self, tmp_path):
         """train_cnn --data-dir: the full CNN entrypoint trains from KFTR
         shards through the loader (heir of tf_cnn_benchmarks' real-data

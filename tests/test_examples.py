@@ -6,6 +6,7 @@ import os
 import pathlib
 import subprocess
 import sys
+import pytest
 
 REPO = pathlib.Path(__file__).parents[1]
 
@@ -30,6 +31,7 @@ def test_quickstart_end_to_end():
         assert stage in proc.stdout, proc.stdout
 
 
+@pytest.mark.slow  # ~30s subprocess sweep of every parallelism family
 def test_parallelism_tour_runs_every_family():
     """examples/parallelism.py: the SAME flagship model trains through
     dp/fsdp/tp/sp/ep/pp — the one-file proof of the mesh story the

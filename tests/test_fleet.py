@@ -51,6 +51,11 @@ class _Replica:
         self.gen_tokens = list(range(100, 115))
         self.gen_die_after = None
         self.gen_meta = {"resumable": True, "seeded": False}
+        # Scripted :fetch_kv answer (§5.10 resume-by-fetch): the
+        # kv_handoff payload this replica's host tier "holds" for any
+        # context (None = miss), and the route's status code.
+        self.fetch_status = 200
+        self.fetch_payload = None
         # Disaggregation tier advertised on /readyz (None = omit the
         # key, the pre-tier wire shape) and the scripted :prefill
         # answer — the payload is OPAQUE to the router, which only
@@ -153,6 +158,13 @@ class _Replica:
                         replica.prefill_payload else
                         replica.prefill_payload.get(
                             "tokens_covered", 0)})
+                    return
+                if self.path.endswith(":fetch_kv"):
+                    payload = replica.fetch_payload
+                    self._send(replica.fetch_status, {
+                        "kv_handoff": payload,
+                        "tokens_covered": 0 if payload is None
+                        else payload.get("tokens_covered", 0)})
                     return
                 if self.path.endswith(":generate"):
                     payload = json.loads(body or b"{}")
@@ -723,8 +735,11 @@ class TestStreamingFailover:
             assert sink.lines[-1] == {
                 "done": True, "tokens_emitted": len(dying.gen_tokens)}
             # The survivor was asked to RESUME: prompt + the 5 tokens
-            # the client already held, same idempotency key.
-            path, body = survivor.received()[0]
+            # the client already held, same idempotency key.  (A
+            # resumable replay also tries the :fetch_kv leg first —
+            # TestFetchResume pins that — so filter for :generate.)
+            path, body = [r for r in survivor.received()
+                          if r[0].endswith(":generate")][0]
             payload = json.loads(body)
             assert payload["resume_tokens"] == dying.gen_tokens[:5]
             keys = {h.get("x-kft-idempotency-key")
@@ -893,6 +908,122 @@ def _tier_ctr(tier):
     return sample_value(parse_metrics(REGISTRY.render()),
                         "kft_router_tier_requests_total",
                         tier=tier) or 0
+
+
+def _fetch_count(outcome):
+    from kubeflow_tpu.runtime.prom import (
+        REGISTRY,
+        parse_metrics,
+        sample_value,
+    )
+
+    parsed = parse_metrics(REGISTRY.render())
+    return sample_value(parsed, "kft_router_kv_fetch_total",
+                        outcome=outcome) or 0
+
+
+class TestFetchResume:
+    """Resume-by-fetch (§5.10): before the recompute resume, the
+    router asks surviving peers' :fetch_kv for the broken session's
+    parked/spilled KV pages and folds a hit into the replay body;
+    every failure mode must fall back to the plain recompute resume
+    (fetch only makes resume cheap, never makes it possible)."""
+
+    _HANDOFF = {"block_tokens": 4, "tokens_covered": 8,
+                "k": {"b64": "AA==", "shape": [1], "dtype": "uint8"},
+                "v": {"b64": "AA==", "shape": [1], "dtype": "uint8"}}
+
+    def _pair(self, die_after=5):
+        dying, survivor = _Replica(), _Replica()
+        dying.gen_die_after = die_after
+        survivor.inflight = 50  # P2C offers the dying replica first
+        reg = _registry([dying, survivor])
+        return dying, survivor, reg
+
+    def _split(self, replica):
+        reqs = replica.received()
+        return ([json.loads(b) for p, b in reqs
+                 if p.endswith(":fetch_kv")],
+                [json.loads(b) for p, b in reqs
+                 if p.endswith(":generate")])
+
+    def test_fetch_hit_attaches_handoff_to_replay(self):
+        dying, survivor, reg = self._pair()
+        survivor.fetch_payload = dict(self._HANDOFF)
+        before = _fetch_count("ok")
+        try:
+            router = _router(reg)
+            plain, sink = _stream(router)
+            assert plain is None
+            assert sink.tokens() == dying.gen_tokens, sink.lines
+            fetches, gens = self._split(survivor)
+            # The fetch asked for the FULL broken context: prompt +
+            # the tokens the client already holds.
+            assert len(fetches) == 1
+            assert fetches[0]["tokens"] == \
+                [1, 2, 3] + dying.gen_tokens[:5]
+            # The replay body carries both resume halves: the
+            # delivered prefix AND the fetched pages.
+            assert gens[0]["resume_tokens"] == dying.gen_tokens[:5]
+            assert gens[0]["kv_handoff"] == self._HANDOFF
+            assert _fetch_count("ok") == before + 1
+        finally:
+            dying.kill()
+            survivor.kill()
+
+    def test_fetch_miss_falls_back_to_recompute_resume(self):
+        dying, survivor, reg = self._pair()
+        before = _fetch_count("miss")
+        try:
+            router = _router(reg)
+            plain, sink = _stream(router)
+            assert sink.tokens() == dying.gen_tokens, sink.lines
+            fetches, gens = self._split(survivor)
+            assert len(fetches) == 1  # asked, answered "don't hold it"
+            assert "kv_handoff" not in gens[0]
+            assert gens[0]["resume_tokens"] == dying.gen_tokens[:5]
+            assert _fetch_count("miss") == before + 1
+        finally:
+            dying.kill()
+            survivor.kill()
+
+    def test_fetch_error_falls_back_to_recompute_resume(self):
+        dying, survivor, reg = self._pair()
+        survivor.fetch_status = 500
+        before = _fetch_count("error")
+        try:
+            router = _router(reg)
+            plain, sink = _stream(router)
+            # The fetch leg failing must not cost the stream anything.
+            assert sink.tokens() == dying.gen_tokens, sink.lines
+            _, gens = self._split(survivor)
+            assert "kv_handoff" not in gens[0]
+            assert gens[0]["resume_tokens"] == dying.gen_tokens[:5]
+            assert _fetch_count("error") == before + 1
+        finally:
+            dying.kill()
+            survivor.kill()
+
+    def test_seeded_replay_never_fetches(self):
+        """A seeded non-resumable stream replays from scratch — its
+        replay body has no resume_tokens, so a fetched handoff would
+        exceed the prompt and the engine would 400 it.  The fetch leg
+        is resumable-only."""
+        dying, survivor, reg = self._pair(die_after=4)
+        dying.gen_meta = {"resumable": False, "seeded": True}
+        survivor.gen_meta = {"resumable": False, "seeded": True}
+        survivor.fetch_payload = dict(self._HANDOFF)
+        try:
+            router = _router(reg)
+            plain, sink = _stream(router)
+            assert sink.tokens() == dying.gen_tokens, sink.lines
+            fetches, gens = self._split(survivor)
+            assert fetches == []
+            assert "kv_handoff" not in gens[0]
+            assert "resume_tokens" not in gens[0]
+        finally:
+            dying.kill()
+            survivor.kill()
 
 
 class TestTieredRouting:

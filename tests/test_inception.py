@@ -3,10 +3,12 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from kubeflow_tpu.models.inception import InceptionV3
 
 
+@pytest.mark.slow  # ~26s inception compile on CPU
 def test_forward_shapes_and_dtype():
     model = InceptionV3(num_classes=10)
     x = jnp.zeros((1, 96, 96, 3))
@@ -16,6 +18,7 @@ def test_forward_shapes_and_dtype():
     assert out.dtype == jnp.float32
 
 
+@pytest.mark.slow  # ~14s inception compile on CPU
 def test_train_mode_updates_batch_stats():
     model = InceptionV3(num_classes=4)
     x = jnp.asarray(np.random.RandomState(0).randn(2, 96, 96, 3), jnp.float32)

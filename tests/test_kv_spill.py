@@ -1,0 +1,328 @@
+"""Hierarchical KV survivability (§5.10): host-RAM spill tier under
+the paged pool, shed-free degradation, and resume-by-fetch failover.
+
+The acceptance battery the robustness item demands:
+
+  - pool pressure SPILLS idle records instead of destroy-evicting
+    them, so no pool-exhaustion shed (and no content loss) happens
+    while spillable mass exists — regression-tested;
+  - a parked multi-turn session whose device pages were spilled
+    resumes through the kv_import re-import path BIT-IDENTICAL to an
+    uninterrupted control, with TTFT ≪ the cold prefill of the same
+    context (re-import replaces chunked prefill compute);
+  - the b64 wire codec makes host-tier pages portable: a failover
+    survivor imports a corpse's peer-fetched pages (:fetch_kv) and
+    continues the stream bit-identically;
+  - the `engine.spill` fault at spill-in re-import sheds a typed 429
+    with no page leaked in either tier; `engine.fetch` faults surface
+    to the router's recompute fallback.
+"""
+
+import numpy as np
+import pytest
+
+SEED = 20260807
+VOCAB, NEW_TOKENS = 96, 10
+
+
+@pytest.fixture(scope="module")
+def lm():
+    """Tiny LM, (cfg, params, decode, reference) with
+    reference(prompt) -> full greedy token list (prompt + emitted)."""
+    import jax
+    from flax import linen as nn
+
+    from kubeflow_tpu.models.generate import DecodeConfig, generate
+    from kubeflow_tpu.models.transformer import Transformer
+    from kubeflow_tpu.serving.loaders import _model_config
+
+    cfg = _model_config({
+        "vocab_size": VOCAB, "d_model": 32, "n_layers": 2,
+        "n_heads": 4, "n_kv_heads": 2, "d_ff": 64, "head_dim": 8,
+        "max_seq_len": 64, "dtype": "float32"})
+    model = Transformer(cfg)
+    params = nn.unbox(model.init(
+        jax.random.key(SEED), np.zeros((1, 8), np.int32))["params"])
+    decode = DecodeConfig(max_new_tokens=NEW_TOKENS, temperature=0.0)
+    cache = {}
+
+    def reference(prompt):
+        key = np.asarray(prompt, np.int32).tobytes()
+        if key not in cache:
+            out, _ = generate(cfg, params,
+                              np.asarray(prompt, np.int32)[None],
+                              decode)
+            cache[key] = np.asarray(out)[0].tolist()
+        return cache[key]
+
+    return cfg, params, decode, reference
+
+
+def _engine(lm, **kw):
+    from kubeflow_tpu.serving.engine import DecodeEngine
+
+    cfg, params, decode, _ = lm
+    kw.setdefault("slots", 2)
+    kw.setdefault("prefill_len", 32)
+    kw.setdefault("prefill_chunk_tokens", 8)
+    kw.setdefault("kv_block_tokens", 4)
+    return DecodeEngine(cfg, params, decode, **kw)
+
+
+def _prompt(n, lo=1):
+    rng = np.random.RandomState(SEED + n)
+    return rng.randint(lo, VOCAB, size=(n,)).astype(np.int32)
+
+
+class TestSpillTier:
+    def test_pressure_spills_never_sheds_and_resume_is_identical(
+            self, lm):
+        """The tentpole end-to-end, engine level: a tight device pool
+        (12 pages) accumulates parked sessions well past its own
+        capacity; pool pressure evacuates the LRU records to the host
+        tier (spills, NOT destructive evictions, NOT sheds), and each
+        parked session's second turn re-imports its spilled pages and
+        emits greedy tokens bit-identical to the uninterrupted
+        reference."""
+        _, _, _, reference = lm
+        eng = _engine(lm, kv_pool_blocks=12, host_spill_blocks=48,
+                      name="spill-core")
+        try:
+            sessions = []
+            for i in range(5):
+                p = _prompt(9 + i)
+                out = eng.submit({"tokens": p, "park_kv": True})
+                turn1 = out["tokens"][0].tolist()
+                assert turn1 == reference(p)
+                sessions.append((p, turn1))
+            st = eng.stats()
+            mgr = eng._mgr.stats()
+            # 5 parked contexts x 4+ full pages each cannot all be
+            # device-resident in a 12-page pool: the overflow MUST
+            # have spilled, and nothing may have shed or been
+            # destroyed while the host tier had room.
+            assert st["shed"] == 0
+            assert st["kv_spill_pages_out"] > 0
+            assert st["parked_sessions"] == 5
+            assert mgr["evictions"] == 0, (
+                "destructive eviction while spillable mass existed")
+            assert mgr["block_evictions"] == 0
+            assert st["host_tier_used"] > 0
+            assert st["kv_spill_ratio"] > 0
+            assert st["tokens_addressable"] == (12 + 48) * 4
+            eng._mgr.check_invariants()
+            # Turn 2 on every session, oldest first — the oldest are
+            # the certainly-spilled ones.
+            for p, turn1 in sessions:
+                turn2 = np.concatenate(
+                    [np.asarray(turn1, np.int32), _prompt(3, lo=90)])
+                got = eng.submit({"tokens": turn2})
+                assert got["tokens"][0].tolist() == \
+                    reference(turn2.tolist()), "resumed turn diverged"
+            st = eng.stats()
+            assert st["kv_spill_pages_in"] > 0, (
+                "no session resumed through the re-import path")
+            assert st["shed"] == 0
+            assert eng._mgr.stats()["evictions"] == 0
+            assert eng.compiled_programs()["kv_import"] == 1
+            eng._mgr.check_invariants()
+        finally:
+            eng.close()
+
+    def test_reimport_skips_prefill_compute(self, lm):
+        """TTFT mechanism check (CPU-sim stands in for wall clock,
+        PR-13 precedent): resuming a spilled session must run FEWER
+        prefill chunks than the cold prefill of the same context —
+        the imported pages replace that compute entirely."""
+        eng = _engine(lm, kv_pool_blocks=10, host_spill_blocks=32,
+                      name="spill-ttft")
+        cold = _engine(lm, kv_pool_blocks=32, name="spill-cold")
+        try:
+            p = _prompt(16)
+            out = eng.submit({"tokens": p, "park_kv": True})
+            ctx = out["tokens"][0].tolist()  # 26 tokens
+            chunks_before = eng.stats()["prefill_chunks"]
+            # Force the resume through the HOST tier: drop the device
+            # records (the test's stand-in for churn having spilled
+            # them — the core test above covers natural pressure).
+            with eng._lock:
+                while eng._mgr._lru:
+                    _, rec = eng._mgr._lru.popitem(last=False)
+                    eng._mgr._drop_record(rec, count=False)
+            got = eng.submit({"tokens": np.asarray(ctx, np.int32)})
+            warm_chunks = eng.stats()["prefill_chunks"] - chunks_before
+            cold.submit({"tokens": np.asarray(ctx, np.int32)})
+            cold_chunks = cold.stats()["prefill_chunks"]
+            assert eng.stats()["kv_spill_pages_in"] > 0
+            assert warm_chunks < cold_chunks, (
+                f"re-import ran {warm_chunks} prefill chunks vs "
+                f"{cold_chunks} cold — no TTFT win")
+            assert got["tokens"][0].tolist() == \
+                cold.submit({"tokens": np.asarray(ctx, np.int32)}
+                            )["tokens"][0].tolist()
+        finally:
+            eng.close()
+            cold.close()
+
+    def test_spill_in_fault_sheds_typed_429_with_no_leak(self, lm):
+        """A spill-gather fault mid-admission (the re-import leg) must
+        shed the request as a typed Overloaded — never crash the loop,
+        never leak a page in either tier — and the SAME request must
+        succeed once the fault clears (proof the host record survived
+        the shed)."""
+        from kubeflow_tpu.serving.errors import Overloaded
+        from kubeflow_tpu.testing import faults
+
+        _, _, _, reference = lm
+        eng = _engine(lm, kv_pool_blocks=10, host_spill_blocks=32,
+                      name="spill-fault")
+        try:
+            p = _prompt(16)
+            ctx = eng.submit({"tokens": p, "park_kv": True}
+                             )["tokens"][0].tolist()
+            with eng._lock:
+                while eng._mgr._lru:
+                    _, rec = eng._mgr._lru.popitem(last=False)
+                    eng._mgr._drop_record(rec, count=False)
+            host_before = eng._mgr.host_used_blocks()
+            used_before = eng._mgr.used_blocks()
+            inj = faults.parse("engine.spill:raise")
+            faults.install(inj)
+            try:
+                with pytest.raises(Overloaded):
+                    eng.submit({"tokens": np.asarray(ctx, np.int32)})
+            finally:
+                faults.install(None)
+            assert inj.fired("engine.spill") >= 1
+            st = eng.stats()
+            assert st["shed"] == 1
+            assert eng._mgr.used_blocks() == used_before, (
+                "device pages leaked by the shed path")
+            assert eng._mgr.host_used_blocks() == host_before, (
+                "host pages destroyed by the shed path")
+            eng._mgr.check_invariants()
+            # Fault cleared: the identical request now re-imports and
+            # matches the reference — nothing was corrupted.
+            got = eng.submit({"tokens": np.asarray(ctx, np.int32)})
+            assert got["tokens"][0].tolist() == reference(ctx)
+            assert eng.stats()["kv_spill_pages_in"] > 0
+        finally:
+            eng.close()
+
+    def test_spill_out_fault_is_graceful(self, lm):
+        """A fault at the spill-OUT gather abandons that spill (the
+        record stays device-resident, destroy-eviction remains the
+        fallback) — traffic keeps flowing, nothing sheds."""
+        from kubeflow_tpu.testing import faults
+
+        _, _, _, reference = lm
+        eng = _engine(lm, kv_pool_blocks=12, host_spill_blocks=48,
+                      name="spill-out-fault")
+        try:
+            inj = faults.parse("engine.spill:raise")
+            faults.install(inj)
+            try:
+                for i in range(4):
+                    p = _prompt(10 + i)
+                    got = eng.submit({"tokens": p, "park_kv": True})
+                    assert got["tokens"][0].tolist() == reference(p)
+            finally:
+                faults.install(None)
+            st = eng.stats()
+            assert st["shed"] == 0
+            assert st["kv_spill_pages_out"] == 0  # every spill faulted
+            eng._mgr.check_invariants()
+        finally:
+            eng.close()
+
+
+class TestFetchResume:
+    def test_fetch_payload_resumes_on_a_peer_bit_identical(self, lm):
+        """Resume-by-fetch, engine level: replica A parks a session;
+        a survivor B (cold cache) imports A's :fetch_kv payload —
+        round-tripped through the b64 wire codec, as the router ships
+        it — plus resume_tokens, and emits exactly the suffix an
+        uninterrupted run would have."""
+        from kubeflow_tpu.serving.http import (
+            decode_kv_handoff,
+            encode_kv_handoff,
+        )
+
+        _, _, _, reference = lm
+        a = _engine(lm, kv_pool_blocks=16, host_spill_blocks=32,
+                    name="fetch-a")
+        b = _engine(lm, kv_pool_blocks=16, host_spill_blocks=32,
+                    name="fetch-b")
+        try:
+            p = _prompt(12)
+            a.submit({"tokens": p, "park_kv": True})
+            want = reference(p)
+            # Mid-generation death after 4 delivered tokens: the
+            # router replays on B with prompt + delivered and the
+            # payload it fetched from A.
+            delivered = want[len(p):len(p) + 4]
+            context = np.asarray(list(p) + delivered, np.int32)
+            fetched = a.fetch_kv({"tokens": context})
+            assert fetched["tokens_covered"] > 0
+            assert a.stats()["kv_fetches"] == 1
+            wire = encode_kv_handoff(fetched["kv_handoff"])
+            got = b.submit({
+                "tokens": p, "resume_tokens": delivered,
+                "kv_handoff": decode_kv_handoff(wire)})
+            assert got["tokens"][0].tolist() == want, (
+                "fetch-resume diverged from control")
+            assert b.stats()["handoff_pages_in"] > 0
+        finally:
+            a.close()
+            b.close()
+
+    def test_fetch_misses_cleanly(self, lm):
+        eng = _engine(lm, kv_pool_blocks=16, host_spill_blocks=16,
+                      name="fetch-miss")
+        try:
+            out = eng.fetch_kv({"tokens": _prompt(12)})
+            assert out == {"kv_handoff": None, "tokens_covered": 0}
+        finally:
+            eng.close()
+
+    def test_fetch_fault_site_fires(self, lm):
+        """`engine.fetch:raise` surfaces out of fetch_kv — the serving
+        layer answers 500 and the router's fetch leg falls back to
+        recompute-resume (router fallback covered in test_fleet)."""
+        from kubeflow_tpu.testing import faults
+
+        eng = _engine(lm, kv_pool_blocks=16, host_spill_blocks=16,
+                      name="fetch-fault")
+        try:
+            eng.submit({"tokens": _prompt(12), "park_kv": True})
+            inj = faults.parse("engine.fetch:raise")
+            faults.install(inj)
+            try:
+                with pytest.raises(faults.FaultInjected):
+                    eng.fetch_kv({"tokens": _prompt(12)})
+            finally:
+                faults.install(None)
+            assert inj.fired("engine.fetch") == 1
+        finally:
+            eng.close()
+
+    def test_spill_gauges_zeroed_on_close(self, lm):
+        from kubeflow_tpu.runtime.prom import REGISTRY, parse_metrics
+        from kubeflow_tpu.serving.engine import (
+            HOST_TIER_GAUGE,
+            KV_SPILLED_GAUGE,
+        )
+
+        eng = _engine(lm, kv_pool_blocks=10, host_spill_blocks=32,
+                      name="spill-gauge")
+        eng.submit({"tokens": _prompt(16), "park_kv": True})
+
+        def series(name):
+            parsed = parse_metrics(REGISTRY.render())
+            return [v for _, v in parsed.get(name, ())]
+
+        assert any(v > 0 for v in series(KV_SPILLED_GAUGE))
+        assert any(v > 0 for v in series(HOST_TIER_GAUGE))
+        eng.close()
+        assert all(v == 0 for v in series(KV_SPILLED_GAUGE))
+        assert all(v == 0 for v in series(HOST_TIER_GAUGE))

@@ -79,6 +79,7 @@ class TestMoETransformer:
         d_ff=64, head_dim=8, max_seq_len=32, moe_experts=4,
     )
 
+    @pytest.mark.slow  # ~13s; layer-level MoE tests above keep the coverage
     def test_train_on_expert_parallel_mesh(self, devices):
         mesh = MeshSpec(data=2, expert=2, tensor=2).build(devices)
         init_fn, loss_fn = lm_task(self.CFG)

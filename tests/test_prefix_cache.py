@@ -288,3 +288,146 @@ class TestAllocatorInvariantBattery:
         mgr.invalidate()
         assert mgr.used_blocks() == 0, "pages leaked after full drain"
         assert mgr.available() == mgr.num_blocks
+
+    @pytest.mark.parametrize("seed", [0, 1, 7])
+    def test_spill_workload_never_corrupts(self, seed):
+        """The two-tier battery (§5.10): the device workload above
+        interleaved with spill / park (host_put) / fetch
+        (lookup_spilled) ops against a small host tier, invariants
+        checked across BOTH tiers after every op.  Host records hold
+        COPIES keyed by the same chained digests — never device block
+        ids — so a page can never be device-writable and host-spilled
+        at once; the payload marker asserts lookups return the exact
+        record stored for that chain depth."""
+        rng = np.random.RandomState(seed)
+        mgr = BlockManager(num_blocks=12, block_tokens=4,
+                           host_blocks=8)
+        live = []
+        spilled_chains = []  # (tokens, depth_blocks) once host-stored
+
+        def writable(req):
+            return set(req["blocks"][req["shared_n"]:])
+
+        def payload_for(digests):
+            return {"marker": digests[-1], "n": len(digests)}
+
+        for _ in range(400):
+            op = rng.randint(7)
+            if op == 0 and len(live) < 6:  # admit
+                base = ([1, 2, 3, 4, 5, 6, 7, 8] if rng.randint(2)
+                        else [9, 9, 9, 9])
+                tokens = (base * 2)[:rng.randint(4, 13)] + \
+                    rng.randint(10, 90, size=(rng.randint(0, 5),)
+                                ).tolist()
+                budget = int(rng.randint(1, 9))
+                need = -(-(len(tokens) + budget) // mgr.block)
+                plan = mgr.admit(np.asarray(tokens, np.int32),
+                                 len(tokens) - 1, need)
+                if plan is not None:
+                    shared, cached = plan
+                    live.append({
+                        "tokens": tokens, "blocks": list(shared),
+                        "shared_n": len(shared),
+                        "res_left": need - len(shared), "need": need,
+                        "published": False})
+            elif op == 1 and live:  # grow the frontier
+                req = live[rng.randint(len(live))]
+                if req["res_left"] > 0:
+                    blk = mgr.take()
+                    req["res_left"] -= 1
+                    for other in live:
+                        if other is not req:
+                            assert blk not in other["blocks"], (
+                                "page aliased to a diverged writer")
+                    req["blocks"].append(blk)
+                    if not req["published"] and (
+                            len(req["blocks"]) * mgr.block
+                            >= len(req["tokens"])):
+                        mgr.publish(
+                            np.asarray(req["tokens"], np.int32),
+                            len(req["tokens"]), req["blocks"])
+                        req["published"] = True
+            elif op == 2 and live:  # speculative tail rollback
+                req = live[rng.randint(len(live))]
+                if len(req["blocks"]) - req["shared_n"] > 1:
+                    tail = req["blocks"][-1:]
+                    del req["blocks"][-1:]
+                    req["res_left"] += 1
+                    mgr.rollback(tail)
+            elif op == 3 and live:  # retire
+                req = live.pop(rng.randint(len(live)))
+                mgr.release(req["blocks"], unreserve=req["res_left"])
+            elif op == 4:  # spill an idle LRU record to the host tier
+                for rec in mgr.spill_candidates(max_records=2):
+                    digests = list(rec.digests)
+                    freed = mgr.spill(rec, payload_for(digests))
+                    if freed is None:
+                        continue  # declined: stale or unstorable
+                    # The freed pages are back in the free list — a
+                    # double-free of any of them would trip
+                    # check_invariants' free-list uniqueness below.
+                    assert 0 <= freed <= len(digests)
+                    assert digests[-1] in mgr._host_chains
+            elif op == 5:  # park a session's KV straight to host
+                tokens = rng.randint(1, 90,
+                                     size=(rng.randint(4, 17),)).tolist()
+                depth = len(tokens) // mgr.block
+                if depth:
+                    dig = payload_for(
+                        [b"x"] * depth)  # marker only needs depth
+                    stored = mgr.host_put(
+                        np.asarray(tokens, np.int32), len(tokens),
+                        {"marker": None, "n": depth})
+                    if stored:
+                        spilled_chains.append((tokens, stored))
+            elif op == 6 and spilled_chains:  # fetch / re-import path
+                tokens, depth = spilled_chains[
+                    rng.randint(len(spilled_chains))]
+                payload, got = mgr.lookup_spilled(
+                    np.asarray(tokens, np.int32), len(tokens))
+                if payload is not None:  # may have been host-evicted
+                    assert 0 < got <= depth
+                    assert payload["n"] >= got
+                    mgr.spills_in += got  # the engine's re-import
+            for i, a in enumerate(live):
+                for b in live[i + 1:]:
+                    assert not (writable(a) & writable(b))
+            mgr.check_invariants()
+
+        for req in live:
+            mgr.release(req["blocks"], unreserve=req["res_left"])
+        mgr.check_invariants()
+        mgr.invalidate()
+        assert mgr.used_blocks() == 0, "pages leaked after full drain"
+        assert mgr.host_used_blocks() == 0, "host pages survived drain"
+        assert mgr.available() == mgr.num_blocks
+
+    def test_spill_preserves_available_and_declines_unstorable(self):
+        """Spilling an idle record moves its pages cached->free, so
+        available() is UNCHANGED (the deadlock-freedom invariant
+        free + evictable + spillable >= reserved holds across tiers)
+        — and a record larger than the whole host tier is declined
+        outright rather than destroying the only copy."""
+        mgr = BlockManager(num_blocks=8, block_tokens=4, host_blocks=2)
+        tokens = toks(*range(1, 13))  # 3 full blocks
+        got = run_request(mgr, tokens, budget=0)
+        assert got is not None
+        blocks, _, res = got
+        mgr.release(blocks, unreserve=res)
+        before = mgr.available()
+        [rec] = [r for r in (mgr.spill_candidates(2) or [])] or [None]
+        # 3 blocks > host_blocks=2: candidates must skip it entirely
+        # (the pages still count as spillable mass — they are idle —
+        # but no candidate offers them, so the engine destroy-evicts).
+        assert rec is None
+        assert mgr.spillable_blocks() == 3
+        # Enlarge the tier: now it spills, available() is unchanged.
+        mgr.host_blocks = 4
+        [rec] = mgr.spill_candidates(1)
+        freed = mgr.spill(rec, {"p": 1})
+        assert freed == 3
+        assert mgr.available() == before
+        assert mgr.host_used_blocks() == 3
+        payload, depth = mgr.lookup_spilled(tokens, len(tokens))
+        assert payload == {"p": 1} and depth == 3
+        mgr.check_invariants()

@@ -8,6 +8,7 @@ from kubeflow_tpu.testing.e2e import (
     engine_smoke,
     fault_injection_smoke,
     fleet_smoke,
+    kv_spill_smoke,
     multichip_serving_smoke,
     scheduler_smoke,
     serving_smoke,
@@ -116,6 +117,19 @@ class TestE2EDrivers:
         # kft_serving_dedup_hits_total move as /metrics deltas (see
         # kubeflow_tpu/testing/e2e.py survivable_smoke).
         survivable_smoke()
+
+    def test_kv_spill_smoke(self):
+        # The ci/e2e_config.yaml hermetic `kv_spill` step: router + 3
+        # engine replicas with a TIGHT 12-page device pool and a host
+        # spill tier (user_guide §5.10) — parked multi-turn sessions
+        # overflow to host RAM with zero sheds and zero destructive
+        # evictions, a resumed session re-imports its spilled pages
+        # bit-identical to an uninterrupted control, and a
+        # kill-mid-generation failover resumes by FETCHING the
+        # session's pages from a surviving peer
+        # (kft_router_kv_fetch_total{outcome="ok"} delta; see
+        # kubeflow_tpu/testing/e2e.py kv_spill_smoke).
+        kv_spill_smoke()
 
     def test_multichip_serving_smoke(self):
         # The ci/e2e_config.yaml hermetic `multichip_serving` step:

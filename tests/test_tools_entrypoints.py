@@ -110,6 +110,7 @@ class TestProfiling:
 
 
 class TestXplaneSummary:
+    @pytest.mark.slow  # ~19s real-trace capture; trace-writing stays tier-1
     def test_summarizes_a_real_trace(self, tmp_path):
         import jax
         import jax.numpy as jnp

@@ -39,6 +39,7 @@ class TestExecutedWorker:
         assert main(["--steps", "3", "--batch-size", "4",
                      "--features", "2"]) == 0
 
+    @pytest.mark.slow  # ~21s two-process gloo spin-up; single-process stays tier-1
     def test_two_process_gloo_gang(self, tmp_path):
         """Two real processes rendezvous over the KFT contract and take
         DDP-averaged steps — the executed equivalent of the reference's

@@ -47,6 +47,7 @@ def trainer(devices):
 
 
 class TestResNetTraining:
+    @pytest.mark.slow  # ~20s ResNet compile; eval/batch-stats tests keep coverage
     def test_loss_decreases(self, trainer):
         tr, model = trainer
         state = tr.fit(fake_data(), num_steps=20, examples_per_step=BATCH,
@@ -106,6 +107,7 @@ class TestMultiStepFusion:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        atol=5e-3)
 
+    @pytest.mark.slow  # ~22s; the fused-trajectory test keeps the identity signal
     def test_remainder_steps_run_per_step(self, trainer):
         """num_steps not divisible by k: the tail runs through the
         single-step program; total step count is exact."""
@@ -116,6 +118,7 @@ class TestMultiStepFusion:
         )
         assert int(state.step) == 7
 
+    @pytest.mark.slow  # ~22s; the fused-trajectory test keeps the identity signal
     def test_repeated_staged_batch_skips_stacking(self, trainer,
                                                   monkeypatch):
         """The repeat fast path must actually fire for a staged batch
@@ -151,6 +154,7 @@ class TestMultiStepFusion:
 
 
 class TestCheckpointResume:
+    @pytest.mark.slow  # ~32s; train_resilience_smoke keeps the restore signal
     def test_restore_or_init_roundtrip(self, trainer, tmp_path):
         tr, _ = trainer
         with CheckpointManager(tmp_path / "ckpt", save_interval_steps=1) as mgr:
@@ -188,6 +192,7 @@ class TestResNetConfig:
         with pytest.raises(ValueError, match="unknown resnet"):
             ResNetConfig(name="resnet1b").build()
 
+    @pytest.mark.slow  # ~25s resnet50 compile just for shapes
     def test_resnet50_shapes(self, devices):
         model = ResNetConfig(num_classes=10).build()
         vars_ = model.init(jax.random.key(0), jnp.zeros((1, 64, 64, 3)),

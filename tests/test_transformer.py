@@ -352,6 +352,7 @@ class TestFusedCE:
         np.testing.assert_allclose(losses["f32"], losses["compute"],
                                    rtol=1e-6)
 
+    @pytest.mark.slow  # ~15s; the f32 compute-dtype identity test stays tier-1
     def test_compute_dtype_ce_close_on_bf16_model(self):
         """bf16 logits with f32-accumulated reductions track the f32
         materialization closely; gradients stay finite."""
@@ -386,6 +387,7 @@ class TestFusedCE:
         with pytest.raises(ValueError, match="ce_dtype"):
             TransformerConfig(ce_dtype="fp32")
 
+    @pytest.mark.slow  # ~21s; the f32 compute-dtype identity test stays tier-1
     def test_chunked_ce_matches_unchunked(self):
         """ce_chunk > 0 (no [b, s, vocab] logits in HBM, the seq-128k
         memory lever) must match the unchunked loss AND grads in both
