@@ -160,6 +160,11 @@ class EndpointState:
         # ("prefill" / "decode" / "unified"): the router's two-tier
         # :generate pipeline keys off this — see FleetRouter.
         self.tier = "unified"
+        # Loaded adapter digests advertised on /readyz (§5.11):
+        # {model: {adapter_name: digest}}.  The router's affinity pick
+        # prefers replicas already holding a request's adapter resident
+        # (a miss elsewhere costs a cold load + possible eviction).
+        self.adapters: Dict[str, Dict[str, str]] = {}
         # Scraped load gauges (refresh) + router-local outstanding
         # count: the P2C score adds both — the scrape is stale by up to
         # one refresh interval, and the local count covers exactly the
@@ -199,6 +204,13 @@ class EndpointState:
             if not self.ready or self.draining:
                 return False
         return not self.breaker.open
+
+    def has_adapter(self, model: str, adapter: str) -> bool:
+        """True when the last /readyz probe advertised ``adapter``
+        resident for ``model`` — at most one refresh interval stale,
+        which only costs a redundant (idempotent) hot-load on a miss."""
+        with self._lock:
+            return adapter in self.adapters.get(model, {})
 
     def state_label(self) -> str:
         if self.breaker.open:
@@ -428,16 +440,17 @@ class EndpointRegistry:
                 # replica reads as not_ready here.  Routing behavior
                 # is identical either way — no NEW work — only the
                 # state label/metric is coarser than the REST probe's.
-                ready, draining, tier = check_health(
+                ready, draining, tier, adapters = check_health(
                     ep.grpc_target,
-                    timeout=self._probe_timeout_s), False, "unified"
+                    timeout=self._probe_timeout_s), False, "unified", {}
             else:
-                ready, draining, tier = self._probe_http(ep.url)
+                ready, draining, tier, adapters = self._probe_http(ep.url)
             with state._lock:
                 state.reachable = True
                 state.ready = ready
                 state.draining = draining
                 state.tier = tier
+                state.adapters = adapters
             if ready or draining:
                 state.note_success()
             else:
@@ -456,26 +469,36 @@ class EndpointRegistry:
                 self.on_eject(state)
 
     def _probe_http(self, url: str):
-        """GET /readyz -> (ready, draining, tier).  503 is a VALID
-        answer — the replica is alive and telling us not to route to
-        it; only transport failures count against the breaker.  The
+        """GET /readyz -> (ready, draining, tier, adapters).  503 is a
+        VALID answer — the replica is alive and telling us not to route
+        to it; only transport failures count against the breaker.  The
         body's ``role`` key (replicas started with --role) is the
         disaggregation tier; replicas that predate it — or whose body
         is unparsable — read as "unified", so a mixed-version fleet
-        degrades to the single-tier path instead of misrouting."""
+        degrades to the single-tier path instead of misrouting.  The
+        ``adapters`` key ({model: [{name, digest}, ...]}, §5.11)
+        flattens to {model: {name: digest}}; replicas that predate it
+        simply advertise none, so affinity falls back to plain P2C."""
         tier = "unified"
         try:
             with urllib.request.urlopen(
                     url + "/readyz",
                     timeout=self._probe_timeout_s) as resp:
                 body = resp.read()
+                adapters: Dict[str, Dict[str, str]] = {}
                 try:
-                    role = json.loads(body).get("role")
+                    payload = json.loads(body)
+                    role = payload.get("role")
                     if role in ("prefill", "decode", "unified"):
                         tier = role
-                except (ValueError, AttributeError):
+                    for model, infos in (payload.get("adapters")
+                                         or {}).items():
+                        adapters[str(model)] = {
+                            str(i["name"]): str(i.get("digest", ""))
+                            for i in infos if "name" in i}
+                except (ValueError, AttributeError, TypeError, KeyError):
                     pass
-                return resp.status == 200, False, tier
+                return resp.status == 200, False, tier, adapters
         except urllib.error.HTTPError as e:
             body = e.read()
             draining = False
@@ -488,7 +511,7 @@ class EndpointRegistry:
                         tier = role
                 except (ValueError, AttributeError):
                     pass
-            return False, draining, tier
+            return False, draining, tier, {}
 
     def _scrape(self, state: EndpointState) -> None:
         """Parse the replica's /metrics for the load gauges the P2C
@@ -590,6 +613,8 @@ class EndpointRegistry:
                     "local_inflight": s.local_inflight,
                     "cached_token_ratio": s.cached_token_ratio,
                     "kv_spill_ratio": s.kv_spill_ratio,
+                    "adapters": {m: sorted(d) for m, d
+                                 in s.adapters.items()},
                     "breaker_failures": s.breaker.failure_count(),
                     "breaker_state": s.breaker.state(),
                 })

@@ -66,6 +66,7 @@ Metrics: kft_router_requests_total{outcome,code},
 kft_router_retries_total{reason}, kft_router_retry_budget_exhausted_
 total, kft_router_replays_total{outcome}, kft_router_resume_tokens,
 kft_router_tier_requests_total{tier}, kft_router_request_seconds,
+kft_router_adapter_affinity_total{outcome},
 plus the registry's endpoint-state gauges and ejection counters.
 """
 
@@ -112,6 +113,12 @@ FETCH_HELP = (
     "miss = peers answered but none holds the session, error = every "
     "asked peer failed transport/status, none = no routable peer to "
     "ask — every non-ok outcome falls back to recompute-resume")
+ADAPTER_AFFINITY_TOTAL = "kft_router_adapter_affinity_total"
+ADAPTER_AFFINITY_HELP = (
+    "adapter-affinity picks for model@adapter requests (§5.11): hit = "
+    "a replica already advertising the adapter resident was preferred, "
+    "miss = no routable replica advertises it (plain P2C; the chosen "
+    "replica hot-loads on admission)")
 TIER_REQUESTS_TOTAL = "kft_router_tier_requests_total"
 TIER_REQUESTS_HELP = (
     "disaggregated :generate dispatches by tier: prefill = a "
@@ -249,27 +256,56 @@ class FleetRouter:
         self._tier_requests = REGISTRY.counter(TIER_REQUESTS_TOTAL,
                                                TIER_REQUESTS_HELP)
         self._fetches = REGISTRY.counter(FETCH_TOTAL, FETCH_HELP)
+        self._affinity = REGISTRY.counter(ADAPTER_AFFINITY_TOTAL,
+                                          ADAPTER_AFFINITY_HELP)
 
     # -- balancing ---------------------------------------------------------
 
     def pick(self, exclude: Tuple[str, ...] = (),
-             tiers: Optional[Tuple[str, ...]] = None) -> \
+             tiers: Optional[Tuple[str, ...]] = None,
+             adapter: Optional[Tuple[str, str]] = None) -> \
             Optional[EndpointState]:
         """Power-of-two-choices among routable endpoints not already
         tried this request: two uniform draws, lower load score wins
         (one candidate short-circuits; zero returns None).  ``tiers``
         restricts candidates to those disaggregation tiers (None =
-        any — the single-tier path)."""
+        any — the single-tier path).  ``adapter`` = (model, name) for
+        ``model@adapter`` requests (§5.11): replicas whose last /readyz
+        advertised the adapter resident are preferred — P2C runs INSIDE
+        that subset, so affinity never overrides load balancing among
+        warm replicas — and when none advertises it, the pick falls
+        back to the full pool (the chosen replica hot-loads on
+        admission)."""
         candidates = [s for s in self.registry.routable()
                       if s.name not in exclude
                       and (tiers is None
                            or getattr(s, "tier", "unified") in tiers)]
         if not candidates:
             return None
+        if adapter is not None:
+            model, name = adapter
+            warm = [s for s in candidates
+                    if s.has_adapter(model, name)]
+            self._affinity.inc(outcome="hit" if warm else "miss")
+            if warm:
+                candidates = warm
         if len(candidates) == 1:
             return candidates[0]
         a, b = self._rng.sample(candidates, 2)
         return a if a.score() <= b.score() else b
+
+    @staticmethod
+    def _path_adapter(path: str) -> Optional[Tuple[str, str]]:
+        """(model, adapter) from a ``/model/<base>@<adapter>:verb``
+        path, or None for plain model names — the affinity key the
+        pick() preference consumes."""
+        if not path.startswith("/model/"):
+            return None
+        name = path[len("/model/"):].split(":", 1)[0]
+        if "/" in name or "@" not in name:
+            return None
+        base, _, adapter = name.partition("@")
+        return (base, adapter) if base and adapter else None
 
     def _tier_topology(self) -> bool:
         """True when the fleet has BOTH a routable prefill pool and a
@@ -382,6 +418,7 @@ class FleetRouter:
         idempotent = method == "GET"
         replays = 0
         dead: Optional[str] = None
+        affinity = self._path_adapter(path)
         for _ in range(self.max_tries + self.max_replays):
             if deadline is not None \
                     and faults.monotonic() >= deadline:
@@ -389,7 +426,7 @@ class FleetRouter:
                     self._replays.inc(outcome="failed")
                 return 504, {}, _jerr("deadline expired in router"), \
                     "deadline_exceeded"
-            state = self.pick(exclude=tuple(tried))
+            state = self.pick(exclude=tuple(tried), adapter=affinity)
             if state is None:
                 break
             tried.append(state.name)
@@ -523,6 +560,7 @@ class FleetRouter:
         replays = 0
         dead: Optional[str] = None
         last_error = "no endpoints"
+        affinity = self._path_adapter(path)
 
         def fail(status, message, outcome, extra_headers=None):
             """Terminal failure: a plain routed response while nothing
@@ -543,7 +581,8 @@ class FleetRouter:
                 return fail(504, "deadline expired in router",
                             "deadline_exceeded")
             state = self.pick(exclude=tuple(tried),
-                              tiers=("decode",) if tiered else None)
+                              tiers=("decode",) if tiered else None,
+                              adapter=affinity)
             if state is None:
                 if tiered:
                     # The decode pool is exhausted (every decode
@@ -703,7 +742,8 @@ class FleetRouter:
         # dispatch failure — the :generate must fall back to the
         # untiered path, never hang or 500).
         faults.fire("router.tier_dispatch")
-        state = self.pick(tiers=("prefill",))
+        state = self.pick(tiers=("prefill",),
+                          adapter=self._path_adapter(path))
         if state is None:
             return body, False
         self._tier_requests.inc(tier="prefill")

@@ -68,9 +68,21 @@ class DecodeConfig:
     kv_cache_dtype: str = "model"
 
 
+def _lora(x, a, b, spec_a, spec_b):
+    """Per-row low-rank delta: contract ``x`` against PER-ROW factor
+    slices ``a``/``b`` (leading batch axis — row i's slice is its own
+    adapter's, gathered by ``_forward_with_cache`` from the stacked
+    [n_adapters, ...] arrays) in two rank-r hops, so the full-rank
+    delta matrix never materializes and the cost stays O(r) of the
+    base projection.  Row independence is what makes a mixed-adapter
+    batch bit-identical to per-adapter sequential runs."""
+    mid = jnp.einsum(spec_a, x, a)
+    return jnp.einsum(spec_b, mid, b).astype(x.dtype)
+
+
 def _layer_step(cfg: TransformerConfig, layer_params, x, cache_kv,
                 cache_len, positions, pad_amount=None, write_cols=None,
-                tables=None):
+                tables=None, adapters=None):
     """One decoder block against the KV cache.
 
     x: [b, t, e] new activations (t = prompt len at prefill, 1 at decode);
@@ -118,6 +130,19 @@ def _layer_step(cfg: TransformerConfig, layer_params, x, cache_kv,
     q = qeinsum("bse,ehd->bshd", y, attn["wq"], dt)
     k = qeinsum("bse,ehd->bshd", y, attn["wkv"][0], dt)
     v = qeinsum("bse,ehd->bshd", y, attn["wkv"][1], dt)
+    if adapters is not None:
+        # Adapter-array serving (§5.11): each row adds ITS adapter's
+        # low-rank delta to every projection, pre-rope so the delta is
+        # part of the projection itself.  Row 0 of the stack is the
+        # all-zero base delta, so base traffic co-batches with tenant
+        # traffic at identical math.
+        ad = adapters["attn"]
+        q = q + _lora(y, ad["wq_a"], ad["wq_b"],
+                      "bse,ber->bsr", "bsr,brhd->bshd")
+        k = k + _lora(y, ad["wkv_a"][:, 0], ad["wkv_b"][:, 0],
+                      "bse,ber->bsr", "bsr,brhd->bshd")
+        v = v + _lora(y, ad["wkv_a"][:, 1], ad["wkv_b"][:, 1],
+                      "bse,ber->bsr", "bsr,brhd->bshd")
     q = rope(q, positions, cfg.rope_theta)
     k = rope(k, positions, cfg.rope_theta)
 
@@ -250,19 +275,33 @@ def _layer_step(cfg: TransformerConfig, layer_params, x, cache_kv,
             kv_valid_start=pad_amount,
         )
     y = qeinsum("bshd,hde->bse", out, attn["wo"], dt)
+    if adapters is not None:
+        ad = adapters["attn"]
+        y = y + _lora(out, ad["wo_a"], ad["wo_b"],
+                      "bshd,bhdr->bsr", "bsr,bre->bse")
     x = x + y
     y = norm(x, layer_params["mlp_norm"]["scale"])
     mlp = layer_params["mlp"]
     gate = qeinsum("bse,ef->bsf", y, mlp["wi"][0], dt)
     up = qeinsum("bse,ef->bsf", y, mlp["wi"][1], dt)
+    if adapters is not None:
+        ad = adapters["mlp"]
+        gate = gate + _lora(y, ad["wi_a"][:, 0], ad["wi_b"][:, 0],
+                            "bse,ber->bsr", "bsr,brf->bsf")
+        up = up + _lora(y, ad["wi_a"][:, 1], ad["wi_b"][:, 1],
+                        "bse,ber->bsr", "bsr,brf->bsf")
     h = jax.nn.silu(gate) * up
     y = qeinsum("bsf,fe->bse", h, mlp["wo"], dt)
+    if adapters is not None:
+        ad = adapters["mlp"]
+        y = y + _lora(h, ad["wo_a"], ad["wo_b"],
+                      "bsf,bfr->bsr", "bsr,bre->bse")
     return x + y, (ck, cv)
 
 
 def _forward_with_cache(cfg: TransformerConfig, params, tokens, cache,
                         cache_len, pad_amount=None, write_cols=None,
-                        tables=None):
+                        tables=None, adapter_ids=None):
     """tokens [b, t] -> (logits [b, t, v], new cache).
 
     cache_len scalar: the whole batch sits at one length (generate()).
@@ -274,6 +313,10 @@ def _forward_with_cache(cfg: TransformerConfig, params, tokens, cache,
     tables: per-row block tables for the paged block-pool cache (the
     serving engine's unified KV store — see _layer_step); None keeps
     the contiguous per-row layout generate() uses.
+    adapter_ids ([b] int32, optional): per-row index into the stacked
+    ``params["adapters"]`` low-rank delta arrays (multi-model adapter
+    serving, §5.11) — ignored when the params tree carries no adapter
+    stack, so the base model's programs are untouched.
     """
     from flax import linen as nn
 
@@ -295,6 +338,18 @@ def _forward_with_cache(cfg: TransformerConfig, params, tokens, cache,
         positions = jnp.maximum(positions - pad_amount[:, None], 0)
 
     layer_stack = params["layers"]
+    adapter_stack = None
+    if adapter_ids is not None and "adapters" in params:
+        # Per-row adapter gather (§5.11): each row pulls ITS adapter's
+        # low-rank factors out of the stacked [n_adapters, layers, ...]
+        # arrays (row 0 is the all-zero base delta), then the layer
+        # axis moves out front so the factors ride the scan xs beside
+        # the base layer stack — one gather per forward, ONE SPMD
+        # program for every mix of co-batched variants.
+        adapter_stack = jax.tree_util.tree_map(
+            lambda arr: jnp.moveaxis(
+                jnp.asarray(arr, dt)[adapter_ids], 1, 0),
+            dict(params["adapters"]))
 
     # The caches ride the scan as xs/ys (sliced per layer on the leading
     # axis, re-stacked from the per-layer outputs) — NOT as carry with
@@ -304,17 +359,23 @@ def _forward_with_cache(cfg: TransformerConfig, params, tokens, cache,
     # HBM traffic per 128-token request); scan ys write each layer's
     # slice in place.
     def body(x, inputs):
-        layer_params, ck, cv = inputs
+        if adapter_stack is None:
+            layer_params, ck, cv = inputs
+            ad = None
+        else:
+            layer_params, ck, cv, ad = inputs
         x, (ck, cv) = _layer_step(
             cfg, layer_params, x, (ck, cv), cache_len, positions,
             pad_amount=pad_amount, write_cols=write_cols,
-            tables=tables,
+            tables=tables, adapters=ad,
         )
         return x, (ck, cv)
 
     cache_k, cache_v = cache
-    x, (cache_k, cache_v) = jax.lax.scan(
-        body, x, (layer_stack, cache_k, cache_v))
+    xs = (layer_stack, cache_k, cache_v)
+    if adapter_stack is not None:
+        xs = xs + (adapter_stack,)
+    x, (cache_k, cache_v) = jax.lax.scan(body, x, xs)
 
     scale = params["final_norm"]["scale"]
     x32 = x.astype(jnp.float32)
@@ -525,11 +586,14 @@ def init_paged_state(cfg: TransformerConfig, slots: int,
     donate): the [layers, num_blocks, block_tokens, hkv, d] KV block
     pool plus per-slot scalars — lengths (valid cache positions),
     stop_len (length at which the slot stops sampling), last_token
-    (sampled but not yet in cache), done, and a per-slot PRNG key
+    (sampled but not yet in cache), done, a per-slot PRNG key
     (uint32[2]) so temperature sampling is per-REQUEST deterministic
-    regardless of co-batched slots.  Block tables are NOT device state:
-    the host owns them and passes the current snapshot into every
-    program call.
+    regardless of co-batched slots, and adapter_ids — each slot's
+    index into the stacked adapter-delta array (0 = base; armed by
+    prefill_chunk_into_slot, read by every step program, inert when
+    the params tree carries no adapter stack).  Block tables are NOT
+    device state: the host owns them and passes the current snapshot
+    into every program call.
     """
     cache_k, cache_v = init_cache(cfg, num_blocks, block_tokens,
                                   kv_cache_dtype)
@@ -541,6 +605,7 @@ def init_paged_state(cfg: TransformerConfig, slots: int,
         "last_token": jnp.zeros((slots,), jnp.int32),
         "done": jnp.ones((slots,), bool),
         "keys": jnp.zeros((slots, 2), jnp.uint32),
+        "adapter_ids": jnp.zeros((slots,), jnp.int32),
     }
 
 
@@ -621,7 +686,8 @@ def _advance_slots(cfg: TransformerConfig, params, decode: DecodeConfig,
     logits, (ck, cv) = _forward_with_cache(
         cfg, params, state["last_token"][:, None],
         (state["cache_k"], state["cache_v"]), lengths,
-        write_cols=write_cols, tables=tables)
+        write_cols=write_cols, tables=tables,
+        adapter_ids=state.get("adapter_ids"))
     last = logits[:, -1]
     if decode.temperature <= 0.0:
         nxt = jnp.argmax(last, axis=-1)
@@ -782,7 +848,8 @@ def verify_step(cfg: TransformerConfig, params, state,
         [state["last_token"][:, None], draft.astype(jnp.int32)], axis=1)
     logits, (ck, cv) = _forward_with_cache(
         cfg, params, tokens, (state["cache_k"], state["cache_v"]),
-        lengths, write_cols=write_cols, tables=tables)
+        lengths, write_cols=write_cols, tables=tables,
+        adapter_ids=state.get("adapter_ids"))
     targets = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [S, k+1]
     # Longest accepted draft prefix (positions beyond draft_len never
     # match), then +1 free token, clipped to the per-slot budget: a
@@ -833,10 +900,21 @@ def prefill_chunk_into_slot(
     slot: jax.Array,
     seed: jax.Array,
     table_row: jax.Array,
+    adapter_id: Optional[jax.Array] = None,
 ):
     """Extend slot ``slot``'s KV by one static-width chunk of prompt
     starting at traced cache offset ``start``; returns
     (state, first sampled token [1]).
+
+    adapter_id (traced int32 scalar, optional): the request's index
+    into the stacked adapter-delta array (§5.11) — applied to THIS
+    chunk's forward (prefill k/v must carry the tenant's delta too)
+    and written to ``state["adapter_ids"][slot]`` so the step programs
+    gather the same delta.  The write is unconditional at ``slot``
+    (not gated on the final chunk): the freeze below already parks the
+    slot, so an interleaved step reads a harmless id from a frozen
+    row.  Omitted/None means base (0) and traces a separate program —
+    engines without an adapter stack never pay the operand.
 
     tokens [1, chunk_w]: the prompt's tokens [start, start + chunk_w),
     right-padded past ``prompt_len`` on the final chunk.  table_row
@@ -872,9 +950,11 @@ def prefill_chunk_into_slot(
     """
     slots_n = state["done"].shape[0]
     w = tokens.shape[1]
+    aid = (jnp.zeros((), jnp.int32) if adapter_id is None
+           else jnp.reshape(jnp.asarray(adapter_id, jnp.int32), ()))
     logits, (ck, cv) = _forward_with_cache(
         cfg, params, tokens, (state["cache_k"], state["cache_v"]),
-        start, tables=table_row)
+        start, tables=table_row, adapter_ids=aid[None])
     # First-token sampling from the last REAL prompt position of this
     # chunk (only meaningful on the final chunk; clamped otherwise).
     idx = jnp.clip(prompt_len - 1 - start, 0, w - 1)
@@ -900,6 +980,8 @@ def prefill_chunk_into_slot(
 
     state = dict(state)
     state["cache_k"], state["cache_v"] = ck, cv
+    if "adapter_ids" in state:
+        state["adapter_ids"] = state["adapter_ids"].at[slot].set(aid)
     state["done"] = state["done"].at[slot].set(True)
     state["done"] = state["done"].at[final_slot].set(
         done_final, mode="drop")

@@ -272,13 +272,24 @@ def parse_metrics(text: str) -> Dict[str, List[Tuple[Dict[str, str],
 
 def sample_value(parsed: Dict[str, List[Tuple[Dict[str, str], float]]],
                  name: str, **labels: str) -> Optional[float]:
-    """First sample of ``name`` whose labels are a superset of
-    ``labels`` (None when the series is absent)."""
+    """Sample of ``name`` matching ``labels``: an EXACT label-set match
+    wins when one exists, else the first sample whose labels are a
+    superset of ``labels`` (None when the series is absent).
+
+    The superset fallback is what lets callers read a known series
+    without naming every label — but on its own it returned whichever
+    superset rendered FIRST: asking for ``metric(model="lm")`` when
+    both ``{model="lm"}`` and ``{model="lm", adapter="a"}`` exist must
+    answer the aggregate series, not an arbitrary refinement of it."""
+    fallback = None
     for sample_labels, value in parsed.get(name, ()):
         if all(sample_labels.get(k) == str(v)
                for k, v in labels.items()):
-            return value
-    return None
+            if len(sample_labels) == len(labels):
+                return value
+            if fallback is None:
+                fallback = value
+    return fallback
 
 
 def serve_metrics(port: int, registry: Optional[Registry] = None,

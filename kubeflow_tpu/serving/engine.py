@@ -106,6 +106,7 @@ from kubeflow_tpu.serving.model_server import (
     SHED_TOTAL,
     locked_snapshot,
 )
+from kubeflow_tpu.serving.adapters import AdapterNotFound
 from kubeflow_tpu.serving.prefix_cache import BlockManager
 from kubeflow_tpu.testing import faults
 
@@ -173,6 +174,10 @@ KV_SPILL_HELP = \
     "paged-KV pages crossing the host spill tier, by engine and " \
     "direction (out = device pages evacuated to host, in = host " \
     "pages re-imported at admission)"
+ADAPTER_REQUESTS_TOTAL = "kft_engine_adapter_requests_total"
+ADAPTER_REQUESTS_HELP = \
+    "requests admitted naming an adapter variant, by engine and " \
+    "adapter"
 
 # N-gram drafter bounds: suffixes of up to _SPEC_NGRAM_MAX tokens are
 # matched against the request's own history, down to _SPEC_NGRAM_MIN.
@@ -363,6 +368,19 @@ class DecodeEngine:
       partition_rules: regex partition rules over the param tree
         (default serving/sharding.py LM_PARTITION_RULES); only
         consulted when ``mesh`` is set.
+      adapters: a serving/adapters.py ``AdapterRegistry`` to serve
+        per-tenant LoRA-style variants from (§5.11).  The stacked
+        delta arrays ride INSIDE ``params["adapters"]`` and the
+        per-slot row index inside ``state["adapter_ids"]``, so the
+        SAME AOT programs serve every variant — mixed-adapter traffic
+        co-batches in one continuous batch, ``compiled_programs()``
+        never grows a per-adapter entry, and under a mesh the stacked
+        axis shards along the ``adapters/...`` partition rules.
+        Admission resolves ``inputs["adapter"]`` to a row index (or
+        sheds typed 404/429), pins it until release, and seeds the
+        request's prefix-digest chain with the adapter's content
+        digest so variants never alias each other's KV pages.  None
+        (the default) serves the base model exactly as before.
     """
 
     def __init__(
@@ -388,6 +406,7 @@ class DecodeEngine:
         speculative_tokens: int = 0,
         mesh=None,
         partition_rules=None,
+        adapters=None,
         name: str = "engine",
     ):
         from kubeflow_tpu.models.generate import init_paged_state
@@ -397,6 +416,17 @@ class DecodeEngine:
             raise ValueError(f"slots must be >= 1, got {slots}")
         self.cfg = cfg
         self.mesh = mesh
+        self._registry = adapters
+        self._adapter_version = None
+        if adapters is not None:
+            # Adapter-array serving (§5.11): the stacked per-tenant
+            # delta arrays ride INSIDE the param tree, so every AOT
+            # program takes them as ordinary operands (no program-count
+            # change) and shard_params below places the stacked axis
+            # under the adapters/... partition rules.
+            stack, self._adapter_version = adapters.stack_snapshot()
+            params = dict(params)
+            params["adapters"] = stack
         if mesh is not None:
             # Tensor-parallel placement (serving/sharding.py): a
             # one-time device_put of params + pool; the AOT programs
@@ -541,6 +571,11 @@ class DecodeEngine:
         self._rate_step_ema = None
         self._rate_verify_ema = None
         self._spec_probe = 0
+        # Per-tenant fair admission (§5.11): last-admitted sequence
+        # per adapter key ("" = base traffic).  Mutated only under
+        # self._lock by the admission pop.
+        self._fair_last: Dict[str, int] = {}
+        self._fair_seq = 0
 
         self._lock = threading.Lock()
         self._work = threading.Condition(self._lock)
@@ -629,6 +664,8 @@ class DecodeEngine:
             HOST_TIER_GAUGE, HOST_TIER_HELP)
         self._kv_spill_ctr = REGISTRY.counter(
             KV_SPILL_TOTAL, KV_SPILL_HELP)
+        self._adapter_req_ctr = REGISTRY.counter(
+            ADAPTER_REQUESTS_TOTAL, ADAPTER_REQUESTS_HELP)
         # Fault-layer series: same names as the static batchers', so
         # shed/expired rates read uniformly across batching planes.
         self._shed_ctr = REGISTRY.counter(SHED_TOTAL, SHED_HELP)
@@ -854,6 +891,33 @@ class DecodeEngine:
             raise DeadlineExceeded(
                 f"deadline expired before engine "
                 f"{self._metric_name!r} admission")
+        # Adapter-array resolution (§5.11): name -> stacked row index,
+        # PINNED from here to release so LRU eviction can never
+        # recycle a row under an in-flight request.  Unknown names
+        # shed typed 404, slot exhaustion / an open load breaker 429 —
+        # all raised HERE, before any queue state exists.  Every
+        # terminal path below must unpin (_unpin_adapter is
+        # idempotent), which is what makes "evictable" == "no live
+        # request" exact.
+        adapter_name = inputs.get("adapter")
+        adapter_idx, adapter_salt, adapter_pin = 0, b"", None
+        if adapter_name:
+            adapter_name = str(adapter_name)
+            if self._registry is None:
+                raise AdapterNotFound(
+                    f"engine {self._metric_name!r} serves no adapters "
+                    f"(requested {adapter_name!r})")
+            adapter_idx, digest = self._registry.acquire(adapter_name)
+            adapter_pin = adapter_idx
+            # KV is adapter-SCOPED by the CONTENT digest (stable
+            # across replicas, unlike the row index): variants never
+            # alias each other's cached pages, while the same adapter
+            # on two replicas hashes identically for :fetch_kv.
+            adapter_salt = bytes.fromhex(digest)
+            self._adapter_req_ctr.inc(
+                engine=self._metric_name, adapter=adapter_name)
+        else:
+            adapter_name = None
         # Trace context captured on the transport thread; the loop
         # thread stamps spans from perf readings at drain time (never
         # per token), so the hot step loop stays untouched and a
@@ -876,6 +940,8 @@ class DecodeEngine:
             "released": False,
             "export": export, "handoff": handoff,
             "park": bool(inputs.get("park_kv")), "spill_in": None,
+            "adapter": adapter_idx, "adapter_salt": adapter_salt,
+            "adapter_name": adapter_name,
             # Adaptive draft width: grows on full accepts, shrinks on
             # full rejects; 0 = backed off (re-probes after cooldown).
             "spec_k": self.speculative_tokens, "spec_cool": 0,
@@ -888,6 +954,8 @@ class DecodeEngine:
             "event": threading.Event(), "out": None, "err": None,
             "t": faults.monotonic(), "t_first": None,
         }
+        if adapter_pin is not None:
+            entry["adapter_pin"] = adapter_pin
         if self.speculative_tokens:
             hist = np.empty((length + new,), np.int32)
             hist[:length] = tokens[0]
@@ -905,6 +973,7 @@ class DecodeEngine:
                 entry["spec_seed"] = False
         with self._lock:
             if self._stopped:
+                self._unpin_adapter(entry)
                 raise BatcherClosed(
                     f"engine {self._metric_name!r} is closed")
             if res_blocks > self.kv_pool_blocks:
@@ -916,6 +985,7 @@ class DecodeEngine:
                 self._counters["kv_shed_no_blocks"] += 1
                 self._shed_ctr.inc(batcher=self._metric_name)
                 self._kv_shed_ctr.inc(engine=self._metric_name)
+                self._unpin_adapter(entry)
                 raise Overloaded(
                     f"request needs {res_blocks} KV blocks but engine "
                     f"{self._metric_name!r}'s pool holds "
@@ -934,6 +1004,7 @@ class DecodeEngine:
                     self._counters["kv_shed_no_blocks"] += 1
                     self._kv_shed_ctr.inc(engine=self._metric_name)
                 self._shed_ctr.inc(batcher=self._metric_name)
+                self._unpin_adapter(entry)
                 raise Overloaded(
                     f"engine {self._metric_name!r} admission queue "
                     f"full ({len(self._queue)} waiting, "
@@ -987,6 +1058,13 @@ class DecodeEngine:
             out["decode_rounds"] = 1
         return out
 
+    def adapter_info(self) -> List[Dict[str, Any]]:
+        """Resident adapters (name/digest/index/pins) for /readyz
+        advertisement and the router's digest-affinity pick; empty
+        when this engine serves no adapters (§5.11)."""
+        return self._registry.loaded() if self._registry is not None \
+            else []
+
     def stats(self) -> Dict[str, Any]:
         """Locked snapshot of the engine counters: occupancy, queue
         depth, throughput, per-token (= per-step) latency, prefix-cache
@@ -1032,7 +1110,7 @@ class DecodeEngine:
                                      int(len(sorted_values) * q))]
 
         prompt_toks = c["prompt_tokens"]
-        return {
+        out = {
             "requests": c["requests"],
             "tokens": c["tokens"],
             "steps": steps,
@@ -1156,6 +1234,12 @@ class DecodeEngine:
             "ttft_p50_ms": pct(ttfts, 0.50),
             "ttft_p99_ms": pct(ttfts, 0.99),
         }
+        if self._registry is not None:
+            # Adapter-array serving (§5.11): registry occupancy plus
+            # the resident name/digest list the fleet layer advertises.
+            out["adapters"] = self._registry.stats()
+            out["adapters"]["loaded"] = self._registry.loaded()
+        return out
 
     def close(self, drain_s: float = 10.0) -> None:
         """Deterministic shutdown: refuse new work, give in-flight
@@ -1201,6 +1285,58 @@ class DecodeEngine:
 
     def _free_slots_locked(self) -> List[int]:
         return [i for i, r in enumerate(self._slot_req) if r is None]
+
+    def _fair_pick_locked(self) -> int:
+        """Per-tenant fair admission (§5.11): among the queued
+        requests, pick the one whose adapter key ("" = base traffic)
+        was admitted least recently, oldest-first within a tenant —
+        a hot adapter's burst cannot starve co-batched neighbors,
+        because every other tenant's queue head outranks the hot
+        tenant's next request.  Pure FIFO when nothing queued names an
+        adapter, so single-tenant engines keep the exact pre-adapter
+        admission order.  The caller still stops on the first
+        unplannable pick, which preserves the no-starvation property
+        under pool pressure: a waiting request is never jumped
+        indefinitely."""
+        if self._registry is None or len(self._queue) < 2:
+            return 0
+        if all(e.get("adapter_name") is None for e in self._queue):
+            return 0
+        best, best_key = 0, None
+        for i, e in enumerate(self._queue):
+            key = (self._fair_last.get(
+                e.get("adapter_name") or "", -1), i)
+            if best_key is None or key < best_key:
+                best, best_key = i, key
+        return best
+
+    def _apply_adapter_updates(self) -> None:
+        """Hot adapter load/evict, device side (loop thread, between
+        program calls): swap the registry's current stacked delta
+        arrays into the param tree when its version moved.  The stack
+        has identical shapes/dtypes on every version (rows mutate,
+        geometry never does), so the swap NEVER recompiles a program;
+        device_put preserves each leaf's existing placement, so under
+        a mesh the stacked axis lands exactly where the compiled SPMD
+        programs expect it.  Copy-on-write on the registry side means
+        in-flight dispatches keep reading the old leaves — a program
+        never observes a torn row."""
+        import jax
+
+        stack, version = self._registry.stack_snapshot()
+        if version == self._adapter_version:
+            return
+
+        def place(new, old):
+            sharding = getattr(old, "sharding", None)
+            return jax.device_put(np.asarray(new), sharding) \
+                if sharding is not None else np.asarray(new)
+
+        params = dict(self.params)
+        params["adapters"] = jax.tree_util.tree_map(
+            place, stack, dict(self.params["adapters"]))
+        self.params = params
+        self._adapter_version = version
 
     def _sweep_expired_locked(self) -> List[dict]:
         """Pull every deadline-expired request out of the queue AND the
@@ -1274,6 +1410,10 @@ class DecodeEngine:
             return
         self._expired_ctr.inc(len(expired), batcher=self._metric_name)
         for entry in expired:
+            # Queue-expired entries never reach _release_entry_locked
+            # (they hold no pages) — unpin their adapters here.
+            self._unpin_adapter(entry)
+        for entry in expired:
             if not entry["event"].is_set():
                 if entry["trace"] is not None:
                     tracing.record_span(
@@ -1289,6 +1429,16 @@ class DecodeEngine:
                     f"(engine {self._metric_name!r})")
                 entry["event"].set()
 
+    def _unpin_adapter(self, entry: dict) -> None:
+        """Drop an entry's adapter pin (idempotent — the pin travels
+        as a pop-once key).  Every terminal path calls this: release,
+        expiry, queue failure, abort, and the typed admission sheds —
+        so an adapter row is LRU-evictable exactly when no live
+        request references it."""
+        pin = entry.pop("adapter_pin", None)
+        if pin is not None and self._registry is not None:
+            self._registry.release(pin)
+
     def _release_entry_locked(self, entry: dict) -> None:
         """Return an entry's physical pages (slot refs) and never-taken
         reservation to the pool.  Pages a published prefix record
@@ -1296,6 +1446,7 @@ class DecodeEngine:
         retirement, expiry, and drain can each reach a request once.
         Never touches the slot's table row: by release time the row
         may already belong to a successor request."""
+        self._unpin_adapter(entry)
         if entry["released"]:
             return
         entry["released"] = True
@@ -1320,15 +1471,18 @@ class DecodeEngine:
         a spilled session resumes without re-prefilling what the tier
         preserved."""
         prompt = entry["tokens"][0]
+        salt = entry.get("adapter_salt", b"")
         limit = 0 if entry.get("handoff") else int(prompt.shape[0]) - 1
         spill_in = None
         if limit > 0 and self.host_spill_blocks:
-            payload, depth = self._mgr.lookup_spilled(prompt, limit)
+            payload, depth = self._mgr.lookup_spilled(
+                prompt, limit, salt=salt)
             if payload is not None and depth * self.kv_block_tokens \
-                    > self._mgr.peek(prompt, limit):
+                    > self._mgr.peek(prompt, limit, salt=salt):
                 spill_in = (payload, depth)
                 limit = 0
-        plan = self._mgr.admit(prompt, limit, entry["res_blocks"])
+        plan = self._mgr.admit(prompt, limit, entry["res_blocks"],
+                               salt=salt)
         if plan is not None:
             entry["spill_in"] = spill_in
         return plan
@@ -1713,10 +1867,12 @@ class DecodeEngine:
              np.asarray(entry["emitted"], np.int32)])
         true_len = int(context.shape[0]) - 1
         n = min(true_len // self.kv_block_tokens, len(entry["blocks"]))
+        salt = entry.get("adapter_salt", b"")
         with self._lock:
             self._counters["parked_sessions"] += 1
             if n > 0 and self.prefix_caching:
-                self._mgr.publish(context, true_len, entry["blocks"])
+                self._mgr.publish(context, true_len, entry["blocks"],
+                                  salt=salt)
         if n <= 0 or not self.host_spill_blocks:
             return
         try:
@@ -1729,7 +1885,8 @@ class DecodeEngine:
             return
         with self._lock:
             stored = self._mgr.host_put(
-                context, true_len, {"k": pages_k, "v": pages_v})
+                context, true_len, {"k": pages_k, "v": pages_v},
+                salt=salt)
             if stored:
                 self._counters["spill_pages_out"] += stored
         if stored:
@@ -1748,6 +1905,13 @@ class DecodeEngine:
         gather them — and parked/spilled sessions, the only state a
         failover survivor needs, are host-resident by construction."""
         tokens = np.asarray(inputs["tokens"], np.int32).reshape(-1)
+        # Adapter-scoped lookup: a variant's digest chain is salted
+        # with its CONTENT digest, so a fetching peer passes the same
+        # digest to address the same pages (base traffic: no salt).
+        salt = b""
+        digest = inputs.get("adapter_digest")
+        if digest:
+            salt = bytes.fromhex(str(digest))
         # Chaos hook: the cross-replica fetch path (raise = fetch
         # failure — the router falls back to recompute-resume; sleep =
         # slow fetch).
@@ -1755,7 +1919,7 @@ class DecodeEngine:
         with self._lock:
             self._counters["fetches"] += 1
             payload, depth = self._mgr.lookup_spilled(
-                tokens, int(tokens.shape[0]))
+                tokens, int(tokens.shape[0]), salt=salt)
         if payload is None:
             return {"kv_handoff": None, "tokens_covered": 0}
 
@@ -1856,17 +2020,27 @@ class DecodeEngine:
         chunk[0, :seg.shape[0]] = seg
         self._ensure_cover(entry, start + w - 1)
         if self._chunk_exec is None:
-            self._chunk_exec = prefill_chunk_into_slot.lower(
+            lower_args = [
                 self.cfg, self.params, self._state, self.decode,
                 chunk, np.int32(0), np.int32(1), np.int32(1),
-                np.int32(0), np.int32(0),
-                self._tables[:1]).compile()
-        t0 = time.perf_counter()
-        self._state, tok = self._chunk_exec(
+                np.int32(0), np.int32(0), self._tables[:1]]
+            if self._registry is not None:
+                # Adapter-array serving: the row index is a TRACED
+                # operand of the ONE chunked-prefill executable (row 0
+                # = base), so compiled_programs() never grows a
+                # per-adapter entry.
+                lower_args.append(np.int32(0))
+            self._chunk_exec = prefill_chunk_into_slot.lower(
+                *lower_args).compile()
+        call_args = [
             self.params, self._state, chunk,
             np.int32(start), np.int32(true_len), np.int32(entry["new"]),
             np.int32(entry["slot"]), np.int32(entry["seed"]),
-            self._tables[entry["slot"]:entry["slot"] + 1])
+            self._tables[entry["slot"]:entry["slot"] + 1]]
+        if self._registry is not None:
+            call_args.append(np.int32(entry.get("adapter", 0)))
+        t0 = time.perf_counter()
+        self._state, tok = self._chunk_exec(*call_args)
         dt = time.perf_counter() - t0
         entry["pos"] = start + w
         finished = entry["pos"] >= true_len
@@ -1879,8 +2053,9 @@ class DecodeEngine:
                 # this prefill just wrote ARE the cache entry — a
                 # refcount bump in the index, no donor copy.
                 with self._lock:
-                    self._mgr.publish(prompt, true_len,
-                                      entry["blocks"])
+                    self._mgr.publish(
+                        prompt, true_len, entry["blocks"],
+                        salt=entry.get("adapter_salt", b""))
         with self._lock:
             self._counters["prefill_chunks"] += 1
             # Prefill compute belongs in busy_s — tokens_per_sec must
@@ -2545,17 +2720,23 @@ class DecodeEngine:
                         while (free and self._queue
                                and len(self._prefilling)
                                + len(admissions) < self.admit_width):
-                            entry = self._queue[0]
+                            pick = self._fair_pick_locked()
+                            entry = self._queue[pick]
                             plan = self._plan_blocks_locked(entry)
                             if plan is None:
                                 # Tokens-resident admission bound: the
                                 # pool cannot reserve this request's
-                                # worst case yet.  It HOLDS the queue
-                                # head (FIFO — no starvation) until
+                                # worst case yet.  It HOLDS its queue
+                                # position (no starvation — the pick
+                                # is stable until pages free) until
                                 # retirements free pages; submit sheds
                                 # new arrivals past the queue cap.
                                 break
-                            self._queue.pop(0)
+                            self._queue.pop(pick)
+                            self._fair_seq += 1
+                            self._fair_last[
+                                entry.get("adapter_name") or ""] = \
+                                self._fair_seq
                             slot = free.pop(0)
                             shared, cached = plan
                             # Claim the slot and bump in_flight in the
@@ -2603,6 +2784,12 @@ class DecodeEngine:
                     # to drain in-flight slots.
                     self._fail_queue(BatcherClosed(
                         f"engine {self._metric_name!r} is closed"))
+                if self._registry is not None:
+                    # Hot adapter load/evict (§5.11): fold any pending
+                    # stack version into params between dispatches —
+                    # live traffic never waits, in-flight rows are
+                    # never torn, and no program recompiles.
+                    self._apply_adapter_updates()
                 if self.host_spill_blocks:
                     # Spill-then-admit (§5.10): evacuate LRU-cold idle
                     # records to the host tier BEFORE this round's
@@ -2791,6 +2978,7 @@ class DecodeEngine:
             queued, self._queue = self._queue, []
             self._set_queue_gauge(0)
         for entry in queued:
+            self._unpin_adapter(entry)
             entry["err"] = exc
             entry["event"].set()
 
@@ -2809,6 +2997,7 @@ class DecodeEngine:
         # leave their clients parked in submit() forever.
         for i, entry in enumerate(self._slot_req):
             if entry is not None and not entry["event"].is_set():
+                self._unpin_adapter(entry)
                 entry["err"] = err
                 entry["event"].set()
             # Loop thread is dead or dying here; no concurrent writer
@@ -2818,6 +3007,7 @@ class DecodeEngine:
         for _, snapshot, _ in self._pending:
             for _, entry in snapshot:
                 if not entry["event"].is_set():
+                    self._unpin_adapter(entry)
                     entry["err"] = err
                     entry["event"].set()
         self._pending.clear()

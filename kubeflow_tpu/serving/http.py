@@ -26,6 +26,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 from kubeflow_tpu.runtime import tracing
+from kubeflow_tpu.serving.adapters import split_model_adapter
 from kubeflow_tpu.serving.errors import DeadlineExceeded, Overloaded
 from kubeflow_tpu.serving.model_server import ModelServer
 from kubeflow_tpu.testing import faults
@@ -248,7 +249,10 @@ class ServingAPI:
         # Expiry surfaces as DeadlineExceeded -> HTTP 504.
         deadline = parse_deadline_ms(body)
         instances = decode_b64_if_needed(instances)
-        model = self.server.get(name, version)
+        # ``model@adapter`` names (§5.11): the signature lookup needs
+        # the BASE model; ModelServer.predict re-splits the full name
+        # to thread the adapter into the engine admission.
+        model = self.server.get(split_model_adapter(name)[0], version)
         sig_inputs = list(
             model.meta.get("signature", {}).get("inputs", []) or []
         )
@@ -439,9 +443,17 @@ class _Handler(BaseHTTPRequestHandler):
             # reads it off this route, which is how the router learns
             # the two-tier topology without any extra discovery hop.
             if server.is_ready():
-                self._send(200, {"status": "ready",
-                                 "role": server.role,
-                                 "models": server.models()})
+                body = {"status": "ready",
+                        "role": server.role,
+                        "models": server.models()}
+                # Loaded adapter digests per engine model (§5.11): the
+                # fleet registry's readiness probe reads these so the
+                # router can prefer replicas that already hold a
+                # request's adapter resident (digest-affinity).
+                adapters = server.adapter_info()
+                if adapters:
+                    body["adapters"] = adapters
+                self._send(200, body)
             else:
                 self._send(503, {
                     "status": "draining" if server.draining()

@@ -42,6 +42,9 @@ def batcher_factory(*, micro_batch_size: int, batch_timeout_s: float,
                     max_queue_depth: int = 0,
                     overload_retry_after_s: float = 1.0,
                     speculative_tokens: int = 0,
+                    adapters_dir: str = "",
+                    adapter_slots: int = 8,
+                    adapter_rank: int = 4,
                     mesh: str = ""):
     """ModelServer.enable_batching factory: picks the batcher per model.
 
@@ -94,6 +97,20 @@ def batcher_factory(*, micro_batch_size: int, batch_timeout_s: float,
                 max(buckets) if buckets else min(cap, 512))
             prefill = min(prefill, cap)
             if prefill >= 1:
+                registry = None
+                if adapters_dir:
+                    # Multi-model adapter serving (§5.11): one registry
+                    # per engine; hot-loaded per-tenant deltas ride the
+                    # stacked adapter array inside the SAME programs.
+                    from kubeflow_tpu.serving.adapters import (
+                        AdapterRegistry,
+                    )
+
+                    registry = AdapterRegistry(
+                        spec["cfg"], slots=adapter_slots,
+                        rank=adapter_rank, directory=adapters_dir,
+                        name=f"{model.name}-v{model.version}",
+                        overload_retry_after_s=overload_retry_after_s)
                 logging.info(
                     "decode engine for %r v%d: %d slots, prefill width "
                     "%d, cache %d cols/slot", model.name, model.version,
@@ -114,6 +131,7 @@ def batcher_factory(*, micro_batch_size: int, batch_timeout_s: float,
                     max_queue_depth=max_queue_depth,
                     overload_retry_after_s=overload_retry_after_s,
                     speculative_tokens=speculative_tokens,
+                    adapters=registry,
                     mesh=sharding.build_mesh(mesh_axes),
                     name=f"{model.name}-v{model.version}")
             logging.warning(
@@ -262,6 +280,25 @@ def main(argv=None) -> int:
                          "traffic.  Greedy exports only (sampling "
                          "exports fall back to plain decode); 0 "
                          "disables")
+    ap.add_argument("--adapters_dir", default="",
+                    help="directory of per-tenant adapter deltas "
+                         "(<name>.npz + digest sidecar, §5.11): enables "
+                         "multi-model serving on the DecodeEngine — "
+                         "requests naming 'model@adapter' hot-load the "
+                         "delta into a bounded stacked-array slot and "
+                         "co-batch with every other variant in the SAME "
+                         "compiled programs.  Empty = adapter requests "
+                         "404")
+    ap.add_argument("--adapter_slots", type=int, default=8,
+                    help="resident adapter variants per engine (the "
+                         "stacked array's device rows beyond base); "
+                         "idle adapters LRU-evict when the slots fill, "
+                         "in-flight ones are pinned — all slots pinned "
+                         "sheds 429")
+    ap.add_argument("--adapter_rank", type=int, default=4,
+                    help="low-rank adapter factor rank: every adapter "
+                         "served by one engine shares this rank (the "
+                         "stacked array is one static shape)")
     ap.add_argument("--mesh", default="",
                     help="serving mesh spec, e.g. 'tensor=4': shard "
                          "the DecodeEngine's params and paged KV pool "
@@ -366,6 +403,9 @@ def main(argv=None) -> int:
                 max_queue_depth=args.max_queue_depth,
                 overload_retry_after_s=args.overload_retry_after_s,
                 speculative_tokens=args.speculative_tokens,
+                adapters_dir=args.adapters_dir,
+                adapter_slots=args.adapter_slots,
+                adapter_rank=args.adapter_rank,
                 mesh=args.mesh,
             ),
         )

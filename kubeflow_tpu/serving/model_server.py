@@ -25,6 +25,10 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 import numpy as np
 
 from kubeflow_tpu.runtime import tracing
+from kubeflow_tpu.serving.adapters import (
+    AdapterNotFound,
+    split_model_adapter,
+)
 from kubeflow_tpu.serving.errors import (  # noqa: F401 — re-exported
     BatcherClosed,
     DeadlineExceeded,
@@ -479,8 +483,40 @@ class ModelServer:
             return {n: sorted(v) for n, v in self._models.items()}
 
     def has_model(self, name: str) -> bool:
+        base, _ = split_model_adapter(name)
         with self._lock:
-            return name in self._models
+            return base in self._models
+
+    def adapter_info(self) -> Dict[str, List[Dict[str, Any]]]:
+        """Resident adapters per engine-served model — name, digest,
+        slot index, pins — for the /readyz advertisement the router's
+        digest-affinity pick reads (§5.11).  Models without an adapter
+        registry are omitted."""
+        with self._lock:
+            batchers = dict(self._batchers)
+        out: Dict[str, List[Dict[str, Any]]] = {}
+        for name, batcher in batchers.items():
+            info_fn = getattr(batcher, "adapter_info", None)
+            if info_fn is None:
+                continue
+            info = info_fn()
+            if info:
+                out[name] = info
+        return out
+
+    def _resolve_adapter(
+        self, name: str, inputs: Dict[str, Any],
+    ) -> Tuple[str, Dict[str, Any]]:
+        """Split a ``model@adapter`` request name (§5.11): the BASE
+        name drives every lookup/metric/batcher route — one model, one
+        engine, one program — while the adapter rides
+        ``inputs["adapter"]`` for the engine to resolve against its
+        registry at admission.  Plain names pass through untouched."""
+        base, adapter = split_model_adapter(name)
+        if adapter:
+            inputs = dict(inputs)
+            inputs["adapter"] = adapter
+        return base, inputs
 
     # -- readiness / drain ------------------------------------------------
 
@@ -595,6 +631,8 @@ class ModelServer:
         only shape a batcher entry can represent (each entry gets one
         result row back; multi-row requests go straight to predict)."""
         for v in inputs.values():
+            if isinstance(v, str):
+                continue  # routing metadata (e.g. "adapter"), not a leaf
             shape = getattr(v, "shape", None)
             if shape is None:
                 v = np.asarray(v)
@@ -620,6 +658,7 @@ class ModelServer:
         in-flight duplicate attaches to that execution, and a completed
         duplicate is answered from the TTL'd result cache — so a retry
         after a dropped connection is answered, never re-run."""
+        name, inputs = self._resolve_adapter(name, inputs)
         if idem_key:
             return self._predict_deduped(name, inputs, version,
                                          deadline, idem_key)
@@ -717,7 +756,8 @@ class ModelServer:
             # _shape_sig, and the dispatch concatenate all consume the
             # same arrays instead of re-materializing the payload.
             converted = {
-                k: v if hasattr(v, "shape") else np.asarray(v)
+                k: v if isinstance(v, str) or hasattr(v, "shape")
+                else np.asarray(v)
                 for k, v in inputs.items()
             }
             # Bounded retry: a hot-swap or drain can close the batcher
@@ -742,6 +782,15 @@ class ModelServer:
                 except BatcherClosed:
                     continue
         model = self.get(name, version)
+        if inputs.get("adapter"):
+            # The direct path dispatches whole-generation programs with
+            # the BASE weights only — silently answering an adapter
+            # request with base output would be a wrong-tenant response,
+            # strictly worse than failing (§5.11).
+            raise AdapterNotFound(
+                f"adapter {inputs['adapter']!r} requires the "
+                f"continuous-batching engine; model {name!r} fell "
+                f"through to the direct path")
         # Re-checked at the fallthrough: the request may have spent its
         # whole budget queued in a batcher that closed under it (drain,
         # swap race) — launching an uninterruptible whole-generation
@@ -762,6 +811,7 @@ class ModelServer:
         (the :prefill route).  Raises KeyError on unknown models and
         ValueError when the model has no engine.  Bracketed in the
         in-flight counts like any predict."""
+        name, inputs = self._resolve_adapter(name, inputs)
         self.get(name)  # KeyError -> 404 on unknown names
         with self._lock:
             batcher = self._batchers.get(name)
@@ -791,6 +841,7 @@ class ModelServer:
         not wait on a peer's failover fetch, and the fetch must keep
         answering WHILE this replica drains (the surviving session
         state is exactly what a peer needs then)."""
+        name, _ = split_model_adapter(name)
         self.get(name)  # KeyError -> 404 on unknown names
         with self._lock:
             batcher = self._batchers.get(name)
@@ -813,6 +864,7 @@ class ModelServer:
         cannot stream.  The iterator is bracketed in the in-flight
         counts (drain waits for live streams); callers must exhaust or
         close() it."""
+        name, inputs = self._resolve_adapter(name, inputs)
         self.get(name)  # KeyError -> 404 on unknown names
         with self._lock:
             batcher = self._batchers.get(name)

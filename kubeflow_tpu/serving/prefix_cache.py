@@ -87,11 +87,20 @@ _SEED_DIGEST = b"\x00" * 16
 
 
 def _block_digests(tokens: np.ndarray, block: int,
-                   n_blocks: int) -> List[bytes]:
+                   n_blocks: int, salt: bytes = b"") -> List[bytes]:
     """Chained digests of the first ``n_blocks`` full ``block``-token
-    blocks of ``tokens`` — digest i commits to tokens[0 : (i+1)*block]."""
+    blocks of ``tokens`` — digest i commits to tokens[0 : (i+1)*block].
+
+    ``salt`` seeds the whole chain (adapter-scoped KV, §5.11: the
+    engine passes each request's adapter CONTENT digest, so two
+    variants prefilling the same tokens produce disjoint chains and
+    can never alias each other's pages — while the same adapter on any
+    replica hashes identically, which keeps :fetch_kv addressable
+    fleet-wide).  Empty salt is the base chain, bit-identical to the
+    pre-adapter index."""
     out: List[bytes] = []
-    h = _SEED_DIGEST
+    h = hashlib.blake2b(salt, digest_size=16).digest() if salt \
+        else _SEED_DIGEST
     flat = np.asarray(tokens, np.int32).reshape(-1)
     for i in range(n_blocks):
         h = hashlib.blake2b(
@@ -198,7 +207,8 @@ class BlockManager:
     # -- admission ---------------------------------------------------------
 
     def admit(self, tokens: np.ndarray, limit: int,
-              total_blocks: int) -> Optional[Tuple[List[int], int]]:
+              total_blocks: int, salt: bytes = b"",
+              ) -> Optional[Tuple[List[int], int]]:
         """Admission, atomically: find the longest cached block-prefix
         of ``tokens`` covering at most ``limit`` positions, alias its
         blocks (slot refs bumped), and reserve the remaining
@@ -208,7 +218,7 @@ class BlockManager:
         retirement frees pages).  Callers pass ``limit = prompt_len -
         1`` so at least one prompt token always recomputes — blocks
         cache k/v, not the logits the first sampled token needs."""
-        shared, cached = self._lookup(tokens, limit)
+        shared, cached = self._lookup(tokens, limit, salt)
         private = max(0, int(total_blocks) - len(shared))
         # Aliasing an idle cached page consumes an evictable page, so
         # it must be covered by headroom exactly like a reservation —
@@ -268,12 +278,12 @@ class BlockManager:
 
     # -- prefix index ------------------------------------------------------
 
-    def _lookup(self, tokens: np.ndarray,
-                limit: int) -> Tuple[List[int], int]:
+    def _lookup(self, tokens: np.ndarray, limit: int,
+                salt: bytes = b"") -> Tuple[List[int], int]:
         n_blocks = int(limit) // self.block
         if not self.caching or n_blocks <= 0 or not self._chains:
             return [], 0
-        digests = _block_digests(tokens, self.block, n_blocks)
+        digests = _block_digests(tokens, self.block, n_blocks, salt)
         for i in range(n_blocks, 0, -1):
             ent = self._chains.get(digests[i - 1])
             if ent is not None:
@@ -282,7 +292,8 @@ class BlockManager:
                 return list(rec.blocks[:i]), i * self.block
         return [], 0
 
-    def peek(self, tokens: np.ndarray, limit: int) -> int:
+    def peek(self, tokens: np.ndarray, limit: int,
+             salt: bytes = b"") -> int:
         """Device-tier coverage of ``tokens`` in cached positions,
         without aliasing anything or touching LRU order (the engine
         compares this against ``lookup_spilled`` coverage to decide
@@ -290,14 +301,14 @@ class BlockManager:
         n_blocks = int(limit) // self.block
         if not self.caching or n_blocks <= 0 or not self._chains:
             return 0
-        digests = _block_digests(tokens, self.block, n_blocks)
+        digests = _block_digests(tokens, self.block, n_blocks, salt)
         for i in range(n_blocks, 0, -1):
             if digests[i - 1] in self._chains:
                 return i * self.block
         return 0
 
     def publish(self, tokens: np.ndarray, true_len: int,
-                blocks: Sequence[int]) -> int:
+                blocks: Sequence[int], salt: bytes = b"") -> int:
         """Register a completed prefill's full-block prefix: digest i
         maps to ``blocks[i]``, which already holds the computed k/v —
         publication is a refcount bump, never a copy.  Partial trailing
@@ -310,7 +321,7 @@ class BlockManager:
         n_blocks = min(int(true_len) // self.block, len(blocks))
         if n_blocks <= 0:
             return 0
-        digests = _block_digests(tokens, self.block, n_blocks)
+        digests = _block_digests(tokens, self.block, n_blocks, salt)
         if digests[-1] in self._chains:
             return 0  # the full chain is already served
         rec = _PrefixRecord(digests,
@@ -400,7 +411,7 @@ class BlockManager:
         return freed
 
     def host_put(self, tokens: np.ndarray, true_len: int,
-                 payload) -> int:
+                 payload, salt: bytes = b"") -> int:
         """Store a host copy of ``tokens``' full-block prefix directly
         (parked session KV: the engine gathers the pages at delivery
         and parks them here so the session's device pages can retire).
@@ -410,7 +421,7 @@ class BlockManager:
         n_blocks = int(true_len) // self.block
         if n_blocks <= 0:
             return 0
-        digests = _block_digests(tokens, self.block, n_blocks)
+        digests = _block_digests(tokens, self.block, n_blocks, salt)
         return self._host_store(digests, payload)
 
     def _host_store(self, digests: List[bytes], payload) -> int:
@@ -434,8 +445,8 @@ class BlockManager:
             self._evict_host_lru()
         return hrec.n_blocks
 
-    def lookup_spilled(self, tokens: np.ndarray,
-                       limit: int) -> Tuple[Optional[object], int]:
+    def lookup_spilled(self, tokens: np.ndarray, limit: int,
+                       salt: bytes = b"") -> Tuple[Optional[object], int]:
         """Longest host-tier match of ``tokens`` covering at most
         ``limit`` positions: (payload, depth_blocks) — the payload
         covers AT LEAST ``depth_blocks`` pages and the caller trims to
@@ -443,7 +454,7 @@ class BlockManager:
         n_blocks = int(limit) // self.block
         if not self.host_blocks or n_blocks <= 0 or not self._host_chains:
             return None, 0
-        digests = _block_digests(tokens, self.block, n_blocks)
+        digests = _block_digests(tokens, self.block, n_blocks, salt)
         for i in range(n_blocks, 0, -1):
             ent = self._host_chains.get(digests[i - 1])
             if ent is not None:

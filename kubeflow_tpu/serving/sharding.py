@@ -89,6 +89,31 @@ LM_PARTITION_RULES: PartitionRules = (
     (r"embed$", PartitionSpec(TENSOR, None)),
     # [e, V] — untied LM head, vocab split.
     (r"w_out$", PartitionSpec(None, TENSOR)),
+    # Stacked adapter-delta factors (serving/adapters.py, §5.11):
+    # [rows, L, ...] low-rank pairs whose OUT-side factor mirrors its
+    # base projection's split — the b-factor of a column-parallel
+    # projection shards the same heads/kv-heads/hidden dim, the
+    # a-factor of a row-parallel projection shards the same input dim
+    # (its rank-r product is the partial sum XLA all-reduces) — so the
+    # per-row gathered delta lands with exactly the base activation's
+    # sharding.  The leading adapter-row axis always replicates: a
+    # gather by slot index must see every row on every shard.  The
+    # rank-r factors left unlisted replicate via the catch-all.
+    # [rows, L, r, h, d] — q delta out-factor, heads split.
+    (r"adapters/attn/wq_b$",
+     PartitionSpec(None, None, None, TENSOR, None)),
+    # [rows, L, 2, r, hkv, d] — k/v delta out-factor, kv-heads split.
+    (r"adapters/attn/wkv_b$",
+     PartitionSpec(None, None, None, None, TENSOR, None)),
+    # [rows, L, h, d, r] — attn-out delta in-factor, row-parallel.
+    (r"adapters/attn/wo_a$",
+     PartitionSpec(None, None, TENSOR, None, None)),
+    # [rows, L, 2, r, f] — gate/up delta out-factor, hidden split.
+    (r"adapters/mlp/wi_b$",
+     PartitionSpec(None, None, None, None, TENSOR)),
+    # [rows, L, f, r] — MLP-down delta in-factor, row-parallel.
+    (r"adapters/mlp/wo_a$",
+     PartitionSpec(None, None, TENSOR, None)),
 )
 
 

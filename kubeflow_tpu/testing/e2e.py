@@ -353,10 +353,16 @@ def engine_smoke(namespace: str = "kubeflow-test") -> None:
                     f"http://127.0.0.1:{port}/metrics",
                     timeout=30) as resp:
                 parsed = parse_metrics(resp.read().decode())
+            # Pinned to THIS server's engine label: the prom registry
+            # is process-global, and an unpinned read falls back to
+            # the first series of each family — which other suites'
+            # engines may own (and own DIFFERENTLY per family).
             accepted = sample_value(
-                parsed, "kft_engine_spec_accepted_total") or 0
+                parsed, "kft_engine_spec_accepted_total",
+                engine="lm-v1") or 0
             drafted = sample_value(
-                parsed, "kft_engine_spec_drafted_total") or 0
+                parsed, "kft_engine_spec_drafted_total",
+                engine="lm-v1") or 0
             assert accepted > 0, (
                 "kft_engine_spec_accepted_total not exported/zero")
             assert drafted >= accepted
@@ -2038,6 +2044,342 @@ def multichip_serving_smoke(namespace: str = "kubeflow-test") -> None:
                 srv.stop()
 
 
+def adapter_serving_smoke(namespace: str = "kubeflow-test") -> None:
+    """Hermetic adapter-array multi-model serving scenario (§5.11):
+    THREE per-tenant adapters over a TWO-replica engine fleet behind
+    the router, every variant riding the base model's one compiled
+    program set.
+
+      1. hot-load under live traffic — while concurrent base-model
+         clients stream through the router, the first requests naming
+         ``lm@alpha`` / ``lm@beta`` hot-load their artifacts from the
+         adapter directory mid-burst; every request (base and variant)
+         returns 200 with tokens IDENTICAL to a sequential per-adapter
+         control server's;
+      2. co-batched mixed burst — base/alpha/beta concurrently through
+         the router: all complete, all token-identical to their
+         sequential controls, and each engine still reports only the
+         base program set over :stats (no per-adapter executable);
+      3. evict-under-pressure — with 2 registry slots per replica, a
+         gamma request against a replica holding an IN-FLIGHT alpha
+         generation must evict the idle beta, never the pinned alpha:
+         the live request completes bit-identical, beta hot-reloads on
+         its next request, and kft_engine_adapter_evictions_total
+         moves as a /metrics delta;
+      4. advertisement + affinity — /readyz advertises loaded adapter
+         digests, the registry learns them at the next probe, and
+         routed ``lm@alpha`` traffic prefers warm replicas
+         (kft_router_adapter_affinity_total{outcome="hit"} delta);
+         an unknown adapter sheds typed 404 through the whole stack.
+
+    kft_engine_adapter_loads_total / _requests_total / _evictions_total
+    and the router affinity counter are all asserted as /metrics
+    deltas.  Override the chaos scenario via KFT_FAULTS (the default
+    slows engine steps so the in-flight pin in step 3 is observable).
+    """
+    import json
+    import os
+    import tempfile
+    import threading
+    import urllib.error
+    import urllib.request
+
+    import jax
+    import numpy as np
+
+    from kubeflow_tpu.fleet.endpoints import (
+        Endpoint,
+        EndpointRegistry,
+        StaticEndpoints,
+    )
+    from kubeflow_tpu.fleet.router import FleetRouter, make_router_server
+    from kubeflow_tpu.models.transformer import Transformer
+    from kubeflow_tpu.runtime.prom import parse_metrics, sample_value
+    from kubeflow_tpu.serving.adapters import (
+        random_adapter_factors,
+        save_adapter,
+    )
+    from kubeflow_tpu.serving.export import export
+    from kubeflow_tpu.serving.http import make_http_server
+    from kubeflow_tpu.serving.loaders import _model_config
+    from kubeflow_tpu.serving.main import batcher_factory
+    from kubeflow_tpu.serving.model_server import ModelServer
+    from kubeflow_tpu.testing import faults
+
+    overrides = {"vocab_size": 96, "d_model": 32, "n_layers": 2,
+                 "n_heads": 4, "n_kv_heads": 2, "d_ff": 64,
+                 "head_dim": 8, "max_seq_len": 64, "dtype": "float32"}
+    cfg = _model_config(overrides)
+    max_new, rank = 8, 4
+    scenario = os.environ.get(faults.ENV) or \
+        "seed=20260807;engine.step:sleep=0.01"
+    rng = np.random.RandomState(20260807)
+    prompts = [rng.randint(1, 96, size=(n,)).tolist()
+               for n in (8, 5, 11, 9)]
+    tenants = ("alpha", "beta", "gamma")
+
+    def make_replica(base, adir):
+        server = ModelServer()
+        server.add_model("lm", base)
+        server.enable_batching("lm", batcher_factory(
+            micro_batch_size=0, batch_timeout_s=0.005,
+            lm_engine=True, lm_engine_slots=3,
+            lm_engine_prefill_len=16, kv_block_tokens=4,
+            max_queue_depth=16, adapters_dir=adir,
+            adapter_slots=2, adapter_rank=rank))
+        httpd, _ = make_http_server(server, port=0, host="127.0.0.1")
+        return server, httpd
+
+    def predict_via(port, name, prompt, timeout=180):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/model/{name}:predict",
+            data=json.dumps(
+                {"instances": [{"tokens": prompt}]}).encode())
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                return resp.status, json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read())
+
+    def scrape(port):
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics",
+                timeout=30) as resp:
+            return parse_metrics(resp.read().decode())
+
+    def delta(before, after, name, **labels):
+        return (sample_value(after, name, **labels) or 0.0) \
+            - (sample_value(before, name, **labels) or 0.0)
+
+    model = Transformer(cfg)
+    variables = model.init(jax.random.key(0),
+                           np.zeros((1, 4), np.int32))
+    replicas = []
+    router_httpd = None
+    with faults.injected(scenario), \
+            tempfile.TemporaryDirectory() as tmp:
+        export(f"{tmp}/lm", 1, variables,
+               loader="kubeflow_tpu.serving.loaders:lm_generate",
+               config={"model": overrides, "max_new_tokens": max_new,
+                       "temperature": 0.0})
+        adir = f"{tmp}/adapters"
+        os.makedirs(adir)
+        for i, name in enumerate(tenants):
+            save_adapter(f"{adir}/{name}.npz", random_adapter_factors(
+                cfg, rank, seed=100 + i, scale=0.5))
+        control = None
+        try:
+            # -- sequential per-adapter controls (one request in
+            # flight at a time, dedicated server: the co-batched
+            # fleet must be bit-identical to THIS).
+            control = ModelServer()
+            control.add_model("lm", f"{tmp}/lm")
+            control.enable_batching("lm", batcher_factory(
+                micro_batch_size=0, batch_timeout_s=0.005,
+                lm_engine=True, lm_engine_slots=1,
+                lm_engine_prefill_len=16, adapters_dir=adir,
+                adapter_slots=3, adapter_rank=rank))
+            want = {}
+            for name in ("lm", "lm@alpha", "lm@beta", "lm@gamma"):
+                for p in prompts:
+                    out = control.predict(
+                        name, {"tokens": np.asarray(p, np.int32)[None]})
+                    want[(name, tuple(p))] = \
+                        np.asarray(out["tokens"])[0].tolist()
+            assert want[("lm@alpha", tuple(prompts[0]))] != \
+                want[("lm", tuple(prompts[0]))], (
+                "adapter delta too small to move greedy decode — the "
+                "identity assertions below would be vacuous")
+
+            # -- fleet assembly --------------------------------------
+            replicas = [make_replica(f"{tmp}/lm", adir)
+                        for _ in range(2)]
+            ports = [h.server_address[1] for _, h in replicas]
+            registry = EndpointRegistry(StaticEndpoints([
+                Endpoint(name=f"srv-{i}",
+                         url=f"http://127.0.0.1:{p}")
+                for i, p in enumerate(ports)]),
+                probe_interval_s=0.2, eject_threshold=2)
+            registry.refresh()
+            assert len(registry.routable()) == 2, registry.describe()
+            router = FleetRouter(registry, max_tries=3,
+                                 try_timeout_s=180.0)
+            router_httpd, _ = make_router_server(router, port=0,
+                                                 host="127.0.0.1")
+            rport = router_httpd.server_address[1]
+            m0 = scrape(ports[0])
+
+            # -- 1. hot-load under live base traffic -----------------
+            results: dict = {}
+
+            def client(i, name, prompt):
+                results[i] = (name, prompt,
+                              predict_via(rport, name, prompt))
+
+            base_threads = [
+                threading.Thread(target=client,
+                                 args=(i, "lm", prompts[i % 2]))
+                for i in range(4)]
+            for t in base_threads:
+                t.start()
+            # Mid-burst: the FIRST requests naming the variants land
+            # while base traffic is in flight — cold artifact loads
+            # under live load.
+            hot_threads = [
+                threading.Thread(
+                    target=client,
+                    args=(4 + j, f"lm@{name}", prompts[2 + j % 2]))
+                for j, name in enumerate(("alpha", "beta"))]
+            for t in hot_threads:
+                t.start()
+            for t in base_threads + hot_threads:
+                t.join(timeout=180)
+            assert len(results) == 6
+            for name, prompt, (code, payload) in results.values():
+                assert code == 200, (name, code, payload)
+                got = payload["predictions"][0]["tokens"]
+                assert got == want[(name, tuple(prompt))], (
+                    f"{name} diverged from its sequential control "
+                    f"under the hot-load burst")
+
+            # -- 2. co-batched mixed burst ---------------------------
+            results = {}
+            mixed = [("lm", prompts[0]), ("lm@alpha", prompts[1]),
+                     ("lm@beta", prompts[2]), ("lm@alpha", prompts[3]),
+                     ("lm", prompts[2]), ("lm@beta", prompts[0]),
+                     ("lm@alpha", prompts[2]), ("lm", prompts[1]),
+                     ("lm@beta", prompts[3])]
+            threads = [threading.Thread(target=client,
+                                        args=(i, name, prompt))
+                       for i, (name, prompt) in enumerate(mixed)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=180)
+            assert sorted(r[2][0] for r in results.values()) \
+                == [200] * len(mixed), results
+            for name, prompt, (_, payload) in results.values():
+                got = payload["predictions"][0]["tokens"]
+                assert got == want[(name, tuple(prompt))], (
+                    f"{name} diverged from its sequential control "
+                    f"in the co-batched burst")
+            # One program set per engine — no per-adapter executable.
+            for i, (srv, _) in enumerate(replicas):
+                stats = srv.batcher_stats("lm") or {}
+                programs = stats.get("compiled_programs") or {}
+                assert set(k for k, v in programs.items() if v) <= \
+                    {"chunked_prefill", "step"}, (
+                    f"replica {i} grew extra programs under mixed "
+                    f"adapter traffic: {programs}")
+
+            # -- 3. evict-under-pressure with a live pin -------------
+            # Direct to replica 0: make alpha + beta resident, hold an
+            # alpha generation IN FLIGHT, then demand gamma — its load
+            # must evict idle beta, never the pinned alpha.
+            srv0, port0 = replicas[0][0], ports[0]
+            for name in ("lm@alpha", "lm@beta"):
+                code, payload = predict_via(port0, name, prompts[0])
+                assert code == 200, (name, code, payload)
+            m_before = scrape(port0)
+            inflight0 = srv0.inflight()
+            holder: dict = {}
+            t = threading.Thread(target=lambda: holder.update(
+                {"resp": predict_via(port0, "lm@alpha", prompts[3])}))
+            t.start()
+            deadline = time.time() + 60
+            while srv0.inflight() <= inflight0:
+                assert time.time() < deadline, (
+                    "pinned alpha request never started")
+                time.sleep(0.005)
+            code, payload = predict_via(port0, "lm@gamma", prompts[1])
+            assert code == 200, (code, payload)
+            assert payload["predictions"][0]["tokens"] \
+                == want[("lm@gamma", tuple(prompts[1]))]
+            t.join(timeout=180)
+            code, payload = holder["resp"]
+            assert code == 200, (
+                "the in-flight alpha request was dropped by the "
+                "eviction", code, payload)
+            assert payload["predictions"][0]["tokens"] \
+                == want[("lm@alpha", tuple(prompts[3]))], (
+                "the pinned alpha generation was corrupted by the "
+                "gamma load")
+            resident = {a["name"]
+                        for a in srv0.adapter_info().get("lm", ())}
+            assert "alpha" in resident and "gamma" in resident, resident
+            assert "beta" not in resident, (
+                "eviction took the wrong victim", resident)
+            m_after = scrape(port0)
+            assert delta(m_before, m_after,
+                         "kft_engine_adapter_evictions_total",
+                         engine="lm-v1") >= 1
+            # Evicted beta hot-reloads on demand, identically.
+            code, payload = predict_via(port0, "lm@beta", prompts[0])
+            assert code == 200
+            assert payload["predictions"][0]["tokens"] \
+                == want[("lm@beta", tuple(prompts[0]))]
+
+            # -- 4. advertisement + affinity + typed sheds -----------
+            # Touch alpha on replica 0 first: the beta reload above may
+            # have taken alpha as its LRU victim, and the affinity
+            # assertion below needs at least one warm alpha replica.
+            code, _ = predict_via(port0, "lm@alpha", prompts[0])
+            assert code == 200
+            resident = {a["name"]
+                        for a in srv0.adapter_info().get("lm", ())}
+            assert "alpha" in resident, resident
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port0}/readyz",
+                    timeout=30) as resp:
+                ready = json.loads(resp.read())
+            advertised = {a["name"]: a["digest"]
+                          for a in ready.get("adapters", {}).get(
+                              "lm", ())}
+            assert set(advertised) == resident, ready
+            assert all(len(d) == 64 for d in advertised.values())
+            registry.refresh()   # the probe learns the advertisement
+            r_before = scrape(rport)
+            for _ in range(4):
+                code, payload = predict_via(rport, "lm@alpha",
+                                            prompts[0])
+                assert code == 200
+                assert payload["predictions"][0]["tokens"] \
+                    == want[("lm@alpha", tuple(prompts[0]))]
+            r_after = scrape(rport)
+            assert delta(r_before, r_after,
+                         "kft_router_adapter_affinity_total",
+                         outcome="hit") >= 4, (
+                "routed lm@alpha traffic never hit the warm subset")
+            code, payload = predict_via(rport, "lm@ghost", prompts[0])
+            assert code == 404, (
+                "unknown adapter must shed typed 404 through the "
+                "router", code, payload)
+
+            # -- engine adapter counters moved as /metrics deltas ----
+            m1 = scrape(ports[0])
+            assert delta(m0, m1, "kft_engine_adapter_loads_total",
+                         engine="lm-v1", adapter="alpha") >= 1
+            assert delta(m0, m1, "kft_engine_adapter_requests_total",
+                         engine="lm-v1", adapter="alpha") >= 1
+            total_loads = sum(
+                delta(m0, m1, "kft_engine_adapter_loads_total",
+                      engine="lm-v1", adapter=name)
+                for name in tenants)
+            assert total_loads >= 4, (
+                "expected initial loads + the beta reload", total_loads)
+        finally:
+            if router_httpd is not None:
+                router_httpd.shutdown()
+            if control is not None:
+                control.stop()
+            for srv, httpd in replicas:
+                try:
+                    httpd.shutdown()
+                except Exception:
+                    pass
+                srv.stop()
+
+
 def scheduler_smoke(namespace: str = "kubeflow-test") -> None:
     """Hermetic multi-tenant scheduler scenario: two tenants' TPUJobs
     through the fake apiserver (real sockets, HttpKube) against the
@@ -2572,6 +2914,7 @@ COMMANDS = {
     "survivable": survivable_smoke,
     "kv_spill": kv_spill_smoke,
     "multichip_serving": multichip_serving_smoke,
+    "adapter_serving": adapter_serving_smoke,
     "scheduler": scheduler_smoke,
     "train": train_smoke,
     "train_resilience": train_resilience_smoke,

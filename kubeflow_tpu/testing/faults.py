@@ -60,6 +60,16 @@ Hook sites planted in production code (grep for ``faults.fire``):
                       asks for a session's pages (raise = fetch
                       failure — the router falls back to
                       recompute-resume, sleep = slow fetch)
+    adapter.load      AdapterRegistry cold-load of a requested
+                      adapter from disk, before the artifact read
+                      (raise = corrupt/missing adapter: the request
+                      sheds 404, the breaker opens, and resident
+                      last-good adapters KEEP serving; sleep = slow
+                      hot-load under traffic)
+    adapter.evict     LRU eviction of an idle resident adapter to
+                      free a slot (raise = eviction failure — the
+                      incoming load sheds, nothing in-flight is
+                      touched)
     fleet.probe       endpoint registry readiness probe attempt
     scheduler.admit   cluster scheduler admission-plan pass (skew =
                       age the queue / expire preemption windows,

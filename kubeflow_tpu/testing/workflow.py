@@ -135,7 +135,7 @@ def default_e2e(name: str = "e2e", namespace: str = "kubeflow-test",
 PLATFORM_STEPS = {
     "hermetic": ["tpujob", "scheduler", "serving", "engine", "faults",
                  "fleet", "survivable", "kv_spill", "multichip_serving",
-                 "train", "train_resilience"],
+                 "adapter_serving", "train", "train_resilience"],
     "kind": ["deploy-crds", "tpujob-real"],
     "gke": ["deploy", "tpujob-real"],
 }

@@ -174,10 +174,11 @@ def cmd_fleet_status(args: argparse.Namespace) -> int:
     if not rows:
         print("no endpoints discovered")
         return 0
-    fmt = "{:<20} {:<10} {:<8} {:<10} {:>9} {:>12} {:>7} {:>7} {:>9}"
+    fmt = ("{:<20} {:<10} {:<8} {:<10} {:>9} {:>12} {:>7} {:>7} {:>9}"
+           " {:<16}")
     print(fmt.format("ENDPOINT", "STATE", "TIER", "BREAKER",
                      "INFLIGHT", "QUEUE_DEPTH", "CACHE%", "SPILL%",
-                     "FAILURES"))
+                     "FAILURES", "ADAPTERS"))
     for row in rows:
         # Prefix-cache effectiveness per replica (engine models only;
         # replicas that predate the metric report "-").  TIER is the
@@ -185,8 +186,13 @@ def cmd_fleet_status(args: argparse.Namespace) -> int:
         # (prefill/decode/unified — §5.9); pre-tier routers report "-".
         # SPILL% is host spill-tier occupancy (§5.10) — "-" on
         # replicas without a spill tier or pre-spill routers.
+        # ADAPTERS lists the adapter variants the replica advertises
+        # resident on /readyz (§5.11) — "-" when it serves none.
         ratio = row.get("cached_token_ratio")
         spill = row.get("kv_spill_ratio")
+        adapters = sorted({a for names in
+                           (row.get("adapters") or {}).values()
+                           for a in names})
         print(fmt.format(row["name"], row["state"],
                          row.get("tier", "-"),
                          row.get("breaker_state", "-"),
@@ -195,7 +201,8 @@ def cmd_fleet_status(args: argparse.Namespace) -> int:
                          f"{ratio * 100:.0f}%" if ratio is not None
                          else "-",
                          f"{spill * 100:.0f}%" if spill else "-",
-                         row["breaker_failures"]))
+                         row["breaker_failures"],
+                         ",".join(adapters) if adapters else "-"))
     if isinstance(payload, dict):
         budget = payload.get("retry_budget") or {}
         tokens, cap = budget.get("tokens"), budget.get("cap")

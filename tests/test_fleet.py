@@ -61,6 +61,9 @@ class _Replica:
         # answer — the payload is OPAQUE to the router, which only
         # forwards it into the decode-tier :generate body.
         self.role = None
+        # Adapters advertised on /readyz (§5.11): {model: [{name,
+        # digest}]} or None to omit the key (pre-adapter wire shape).
+        self.adapters = None
         self.prefill_status = 200
         self.prefill_payload = {
             "block_tokens": 4, "tokens_covered": 8,
@@ -91,6 +94,8 @@ class _Replica:
                 if self.path == "/readyz":
                     extra = {} if replica.role is None \
                         else {"role": replica.role}
+                    if replica.adapters is not None:
+                        extra["adapters"] = replica.adapters
                     if replica.ready and not replica.draining:
                         self._send(200, dict(
                             {"status": "ready"}, **extra))
@@ -682,6 +687,97 @@ class TestRouter:
         status, _, body = _predict(router)
         assert status == 503
         assert b"no routable" in body
+
+
+class TestAdapterAffinity:
+    """model@adapter routing (§5.11): /readyz advertisement -> warm-
+    subset preference in pick(), with full-pool P2C fallback on miss
+    (the cold replica hot-loads; affinity is a preference, never a
+    hard constraint)."""
+
+    def test_readyz_adapters_parsed_into_state(self, replicas):
+        replicas[0].adapters = {
+            "m": [{"name": "a", "digest": "d1"},
+                  {"name": "b", "digest": "d2"}]}
+        reg = _registry(replicas)
+        states = {s.name: s for s in reg.all()}
+        assert states["r0"].has_adapter("m", "a")
+        assert states["r0"].has_adapter("m", "b")
+        assert not states["r0"].has_adapter("m", "zz")
+        assert not states["r0"].has_adapter("other", "a")
+        assert not states["r1"].has_adapter("m", "a")
+        row = next(r for r in reg.describe() if r["name"] == "r0")
+        assert row["adapters"] == {"m": ["a", "b"]}
+        # A replica that stops advertising loses its affinity (evict).
+        replicas[0].adapters = {"m": [{"name": "b", "digest": "d2"}]}
+        reg.refresh()
+        states = {s.name: s for s in reg.all()}
+        assert not states["r0"].has_adapter("m", "a")
+        assert states["r0"].has_adapter("m", "b")
+
+    def test_path_adapter_parse(self):
+        f = FleetRouter._path_adapter
+        assert f("/model/m@a:predict") == ("m", "a")
+        assert f("/model/m@a") == ("m", "a")
+        assert f("/model/m@a:generate") == ("m", "a")
+        assert f("/model/m:predict") is None
+        assert f("/model/m@:predict") is None
+        assert f("/model/m/versions/1:predict") is None
+        assert f("/healthz") is None
+
+    def test_pick_prefers_warm_replica(self, replicas):
+        from kubeflow_tpu.runtime.prom import (
+            REGISTRY,
+            parse_metrics,
+            sample_value,
+        )
+
+        replicas[2].adapters = {
+            "m": [{"name": "a", "digest": "d1"}]}
+        reg = _registry(replicas)
+        router = _router(reg)
+
+        def affinity(outcome):
+            return sample_value(
+                parse_metrics(REGISTRY.render()),
+                "kft_router_adapter_affinity_total",
+                outcome=outcome) or 0.0
+
+        hits = affinity("hit")
+        for _ in range(8):
+            assert router.pick(adapter=("m", "a")).name == "r2"
+        assert affinity("hit") == hits + 8
+        # Unknown adapter: nobody is warm — full-pool P2C fallback
+        # (the picked replica will hot-load it on demand).
+        misses = affinity("miss")
+        picked = {router.pick(adapter=("m", "zz")).name
+                  for _ in range(24)}
+        assert len(picked) > 1
+        assert affinity("miss") == misses + 24
+        # Plain pick()s never touch the affinity counter.
+        hits, misses = affinity("hit"), affinity("miss")
+        router.pick()
+        assert (affinity("hit"), affinity("miss")) == (hits, misses)
+
+    def test_routed_predict_lands_on_warm_replica(self, replicas):
+        replicas[1].adapters = {
+            "m": [{"name": "a", "digest": "d1"}]}
+        reg = _registry(replicas)
+        router = _router(reg)
+        for _ in range(5):
+            status, _, _ = _predict(router,
+                                    path="/model/m@a:predict")
+            assert status == 200
+        assert len(replicas[1].received()) == 5
+        assert all(p == "/model/m@a:predict"
+                   for p, _ in replicas[1].received())
+        # The warm replica draining must not strand the adapter:
+        # fallback routes to the cold pool.
+        replicas[1].draining = True
+        reg.refresh()
+        status, _, _ = _predict(router, path="/model/m@a:predict")
+        assert status == 200
+        assert len(replicas[1].received()) == 5
 
 
 class _Sink:

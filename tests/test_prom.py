@@ -135,6 +135,30 @@ class TestParseMetrics:
         assert sample_value(parsed, "h_seconds_count") == 1.0
         assert sample_value(parsed, "missing") is None
 
+    def test_exact_label_match_beats_first_superset(self):
+        # Regression (§5.11 satellite): sample_value returned the FIRST
+        # sample whose labels were a superset of the request, so asking
+        # for metric(model="lm") when an adapter-refined series
+        # {model="lm", adapter="a"} rendered first answered the
+        # refinement, not the aggregate.  An exact label-set match must
+        # win whenever one exists; the superset fallback stays for
+        # callers that underspecify on purpose.
+        reg = Registry()
+        ctr = reg.counter("reqs_total", "r")
+        ctr.inc(5, model="lm", adapter="a")   # renders before the
+        ctr.inc(2, model="lm")                # label-sparser series
+        parsed = parse_metrics(reg.render())
+        assert sample_value(parsed, "reqs_total", model="lm") == 2.0
+        assert sample_value(parsed, "reqs_total",
+                            model="lm", adapter="a") == 5.0
+        # No exact match -> first superset still answers (the
+        # underspecified read callers rely on).
+        only_refined = parse_metrics(
+            'reqs_total{adapter="a",model="lm"} 5\n'
+            'reqs_total{adapter="b",model="lm"} 7\n')
+        assert sample_value(only_refined, "reqs_total",
+                            model="lm") == 5.0
+
     def test_garbage_lines_skipped_not_fatal(self):
         parsed = parse_metrics(
             "# HELP x y\nnot a metric line !!\nx 1.5\nx{a=\"b\"} nan?\n")

@@ -5,6 +5,7 @@ import xml.etree.ElementTree as ET
 import pytest
 
 from kubeflow_tpu.testing.e2e import (
+    adapter_serving_smoke,
     engine_smoke,
     fault_injection_smoke,
     fleet_smoke,
@@ -141,6 +142,20 @@ class TestE2EDrivers:
         # mesh, and decode-pool death shedding typed 429 (see
         # kubeflow_tpu/testing/e2e.py multichip_serving_smoke).
         multichip_serving_smoke()
+
+    def test_adapter_serving_smoke(self):
+        # The ci/e2e_config.yaml hermetic `adapter_serving` step:
+        # three per-tenant adapters over a 2-replica engine fleet
+        # behind the router (user_guide §5.11) — hot-load under live
+        # base traffic, a co-batched mixed burst token-identical to a
+        # sequential per-adapter control with the engines reporting
+        # only the base program set, evict-under-pressure sparing the
+        # pinned in-flight adapter with zero lost accepted requests,
+        # /readyz digest advertisement driving router affinity
+        # (kft_router_adapter_affinity_total{outcome="hit"} delta),
+        # and unknown-adapter typed 404 (see
+        # kubeflow_tpu/testing/e2e.py adapter_serving_smoke).
+        adapter_serving_smoke()
 
     def test_train_resilience_smoke(self):
         # The ci/e2e_config.yaml hermetic `train_resilience` step:
