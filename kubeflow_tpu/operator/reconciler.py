@@ -36,6 +36,7 @@ step instead of step 0.
 
 from __future__ import annotations
 
+import dataclasses
 import logging
 import time
 from typing import Dict, List, Optional
@@ -52,6 +53,7 @@ from kubeflow_tpu.operator.kube import (
     NotFound,
 )
 from kubeflow_tpu.runtime import bootstrap, tracing
+from kubeflow_tpu.scheduler import fuse
 from kubeflow_tpu.testing import faults
 
 log = logging.getLogger(__name__)
@@ -311,6 +313,14 @@ class TPUJobController:
                 self.cluster.forget(key)
             return phase
 
+        # Fused members (scheduler/fuse.py): the plan mirrored the
+        # gang's verdict onto this member key; one shared pod gang is
+        # driven under the fused claim while each member CR keeps its
+        # own phase, events, restart budget and resumable flag.
+        if decision is not None and decision.fused_gang:
+            return self._reconcile_fused_member(
+                cr_obj, job, status, phase, key, decision)
+
         # 0. Preemption: a higher-priority job needs this gang's
         # slices.  Grace window first (checkpoint-on-SIGTERM
         # contract), teardown + resumable re-queue after.  A gang
@@ -558,6 +568,269 @@ class TPUJobController:
                             message=f"{phases.count(RUNNING)}/"
                                     f"{job.num_workers} running",
                             extra={"restarts": restarts})
+        return STARTING
+
+    # -- fused gangs -------------------------------------------------------
+
+    def _fused_gang_spec(self, job: crd.TPUJobSpec,
+                         decision) -> crd.TPUJobSpec:
+        """The shared workload spec for a fused gang: the member's spec
+        renamed to the gang's pod/service-safe name, with the member
+        roster injected so the worker entrypoint can build its
+        FusedTrainer member array."""
+        member_names = ",".join(
+            k.split("/", 1)[-1] for k in decision.fused_members)
+        worker = dataclasses.replace(
+            job.worker,
+            env={**job.worker.env, "KFT_FUSED_MEMBERS": member_names})
+        return dataclasses.replace(
+            job, name=fuse.fused_gang_name(decision.fused_gang),
+            worker=worker)
+
+    def _reconcile_fused_member(self, cr_obj: dict, job: crd.TPUJobSpec,
+                                status: dict, phase: str, key: str,
+                                decision) -> str:
+        """Drive one member CR of a fused gang.
+
+        The gang claim, pod set and grace deadline are keyed on the
+        FUSED key and shared by every member; each member CR keeps its
+        own phase/events/restart budget/resumable flag.  Every step is
+        idempotent, so whichever member reconciles first performs the
+        shared action (offer, pod creation, teardown) and its peers
+        observe the result in the same sweep.
+        """
+        gkey = decision.fused_gang
+        gang = self._fused_gang_spec(job, decision)
+        admitted = self.scheduler.admitted(gkey)
+        pods = self.kube.list_pods(job.namespace,
+                                   labels={LABEL_JOB: gang.name})
+        pod_phases = [(p.get("status") or {}).get("phase", PENDING)
+                      for p in pods]
+
+        # Completion first: it outranks both preemption (a gang that
+        # finishes during the grace is a completion, not an eviction)
+        # and the post-release sweep order (a peer may have released
+        # the claim moments ago).
+        if pods and len(pods) == gang.num_workers and all(
+                ph == SUCCEEDED for ph in pod_phases):
+            self.kube.record_event(
+                job.namespace, f"TPUJob/{job.name}",
+                "FusedMemberCompleted",
+                f"fused gang {gkey} completed; member done")
+            self._set_phase(cr_obj, JOB_SUCCEEDED,
+                            reason="AllWorkersDone",
+                            message=f"fused gang {gkey} completed")
+            if admitted:
+                self.scheduler.release(gkey)
+                self._admitted_at.pop(gkey, None)
+                self._preempt_deadline.pop(gkey, None)
+            if self.cluster is not None:
+                self.cluster.forget(key)
+            return JOB_SUCCEEDED
+
+        if decision.action == "preempt":
+            if not admitted:
+                # A peer already tore the gang down this sweep.
+                if phase != QUEUED:
+                    if self.cluster is not None:
+                        self.cluster.note_preempted(key)
+                    self._set_phase(
+                        cr_obj, QUEUED, reason="PreemptedRequeued",
+                        message="fused gang preempted; member resumes "
+                                "from its own checkpoint",
+                        extra={"resumable": True, "fusedGang": "",
+                               "fusedMembers": 0})
+                return QUEUED
+            now = faults.monotonic()
+            grace = (self.cluster.config.preemption.grace_period_s
+                     if self.cluster is not None else 0.0)
+            deadline = self._preempt_deadline.setdefault(
+                gkey, now + grace)
+            if phase != JOB_PREEMPTING:
+                preemptions = int(status.get("preemptions", 0))
+                self.kube.record_event(
+                    job.namespace, f"TPUJob/{job.name}", "Preempted",
+                    f"{decision.message}; checkpoint grace {grace:g}s",
+                    type_="Warning")
+                self._set_phase(
+                    cr_obj, JOB_PREEMPTING, reason="Preempted",
+                    message=(f"{decision.message}; "
+                             f"checkpoint grace {grace:g}s"),
+                    extra={"resumable": True,
+                           "preemptions": preemptions + 1})
+            if now < deadline:
+                return JOB_PREEMPTING
+            # Grace spent: THIS member performs the shared teardown;
+            # peers requeue through the not-admitted branch above.
+            self._teardown_pods(gang)
+            self.scheduler.release(gkey)
+            self._admitted_at.pop(gkey, None)
+            self._preempt_deadline.pop(gkey, None)
+            if self.cluster is not None:
+                self.cluster.note_preempted(key)
+            self.metrics.append({"event": "gang_preempted", "job": gkey,
+                                 "member": key,
+                                 "preemptor": decision.preemptor})
+            self._set_phase(
+                cr_obj, QUEUED, reason="PreemptedRequeued",
+                message="fused gang preempted; member resumes from "
+                        "its own checkpoint",
+                extra={"resumable": True, "fusedGang": "",
+                       "fusedMembers": 0})
+            return QUEUED
+
+        if decision.action == "unsatisfiable":
+            self._set_phase(cr_obj, JOB_FAILED,
+                            reason=decision.reason or
+                            "UnsatisfiableResources",
+                            message=decision.message)
+            if self.cluster is not None:
+                self.cluster.forget(key)
+            return JOB_FAILED
+        if decision.action != "admit":
+            reason = decision.reason or "WaitingForSlices"
+            if phase != QUEUED or status.get("reason") != reason:
+                self._set_phase(cr_obj, QUEUED, reason=reason,
+                                message=decision.message)
+            return QUEUED
+
+        if admitted and phase == JOB_PREEMPTING:
+            # The plan withdrew the gang's eviction mid-grace: every
+            # member reverts its own stamps (deadline pop idempotent).
+            self._preempt_deadline.pop(gkey, None)
+            status = dict(status)
+            status["resumable"] = False
+            status["preemptions"] = max(
+                0, int(status.get("preemptions", 1)) - 1)
+            cr_obj["status"] = status
+            self.kube.record_event(
+                job.namespace, f"TPUJob/{job.name}",
+                "PreemptionCancelled", decision.message)
+        if not admitted:
+            admitted = self.scheduler.offer(
+                gkey, job.slice_type, job.num_slices, queue="fused")
+            if not admitted:
+                if phase != QUEUED:
+                    self._set_phase(
+                        cr_obj, QUEUED, reason="WaitingForSlices",
+                        message=f"fused gang {gkey} awaiting slices")
+                return QUEUED
+            self._admitted_at.setdefault(gkey, faults.monotonic())
+        stamp: dict = {}
+        if not status.get("fusedGang"):
+            # First admission of THIS member into the gang: count it,
+            # consume its resumable flag, stamp the gang reference
+            # (persisted by the guaranteed phase transition below).
+            if self.cluster is not None:
+                self.cluster.note_admitted(
+                    key, backfilled=decision.backfilled,
+                    resumed=bool(status.get("resumable")))
+            self.kube.record_event(
+                job.namespace, f"TPUJob/{job.name}",
+                "FusedMemberAdmitted",
+                f"admitted as member of fused gang {gkey} "
+                f"({len(decision.fused_members)} members)")
+            stamp = {"fusedGang": gkey,
+                     "fusedMembers": len(decision.fused_members),
+                     "resumable": False}
+
+        # Materialize the SHARED service + pod gang (idempotent; any
+        # member creates, Conflict means a peer won the race).
+        try:
+            self.kube.create_service(build_headless_service(gang))
+        except Conflict:
+            pass
+        existing = {p["metadata"]["name"] for p in pods}
+        restarts = int(status.get("restarts", 0))
+        avoid_nodes = self.quarantine.quarantined()
+        for i in range(gang.num_workers):
+            name = worker_name(gang.name, i)
+            if name not in existing:
+                if phase == JOB_RUNNING:
+                    return self._fused_member_restart(
+                        cr_obj, gang, key, gkey, restarts, stamp,
+                        reason="WorkerLost",
+                        message=f"{name} disappeared while Running")
+                try:
+                    self.kube.create_pod(
+                        build_worker_pod(gang, i, avoid_nodes))
+                except Conflict:
+                    pass
+
+        pods = self.kube.list_pods(job.namespace,
+                                   labels={LABEL_JOB: gang.name})
+        pod_phases = [(p.get("status") or {}).get("phase", PENDING)
+                      for p in pods]
+        if any(ph == FAILED for ph in pod_phases):
+            return self._fused_member_restart(
+                cr_obj, gang, key, gkey, restarts, stamp,
+                reason="WorkerFailed",
+                message=f"{pod_phases.count(FAILED)} worker(s) failed")
+        if len(pods) == gang.num_workers and all(
+                ph in (RUNNING, SUCCEEDED) for ph in pod_phases):
+            if phase != JOB_RUNNING:
+                latency = faults.monotonic() - self._admitted_at.get(
+                    gkey, faults.monotonic())
+                self.metrics.append({
+                    "event": "gang_running", "job": key,
+                    "fused_gang": gkey,
+                    "schedule_to_running_s": latency,
+                })
+                from kubeflow_tpu.runtime.prom import REGISTRY
+
+                REGISTRY.histogram(
+                    "kft_gang_schedule_to_running_seconds",
+                    "gang admission to all-workers-running latency",
+                    buckets=(1.0, 2.0, 5.0, 10.0, 30.0, 60.0, 120.0,
+                             300.0, 600.0),
+                ).observe(latency)
+                self._set_phase(cr_obj, JOB_RUNNING,
+                                reason="GangRunning",
+                                message=f"fused gang {gkey} running",
+                                extra={"restarts": restarts, **stamp})
+            return JOB_RUNNING
+        if phase != STARTING or status.get("restarts") != restarts \
+                or stamp:
+            self._set_phase(cr_obj, STARTING, reason="CreatingWorkers",
+                            message=f"{pod_phases.count(RUNNING)}/"
+                                    f"{gang.num_workers} running in "
+                                    f"fused gang {gkey}",
+                            extra={"restarts": restarts, **stamp})
+        return STARTING
+
+    def _fused_member_restart(self, cr_obj: dict, gang: crd.TPUJobSpec,
+                              key: str, gkey: str, restarts: int,
+                              stamp: dict, reason: str,
+                              message: str) -> str:
+        """Member-side view of a fused gang restart: the shared pods
+        are torn down once (idempotent), each member charges its OWN
+        restart budget, and the runtime re-enters through per-member
+        ``restore_or_init`` with only still-active members unmasked."""
+        self._note_worker_failures(
+            gang, self.kube.list_pods(gang.namespace,
+                                      labels={LABEL_JOB: gang.name}),
+            restarts)
+        if restarts + 1 > gang.restart.max_restarts:
+            self._set_phase(cr_obj, JOB_FAILED,
+                            reason="MaxRestartsExceeded",
+                            message=f"{message}; restarts={restarts}",
+                            extra={"restarts": restarts})
+            self._teardown_pods(gang)
+            self.scheduler.release(gkey)
+            self._admitted_at.pop(gkey, None)
+            return JOB_FAILED
+        self.kube.record_event(
+            gang.namespace, f"TPUJob/{key.split('/', 1)[-1]}", reason,
+            f"{message}; fused gang restart {restarts + 1}/"
+            f"{gang.restart.max_restarts} from per-member checkpoints",
+            type_="Warning")
+        self._teardown_pods(gang)
+        self.metrics.append({"event": "gang_restart", "job": gkey,
+                             "member": key, "restart": restarts + 1,
+                             "reason": reason})
+        self._set_phase(cr_obj, STARTING, reason=reason,
+                        message=f"fused gang restart {restarts + 1}",
+                        extra={"restarts": restarts + 1, **stamp})
         return STARTING
 
     # -- helpers ----------------------------------------------------------

@@ -1,0 +1,488 @@
+"""Horizontally fused training arrays: N small jobs, one SPMD program.
+
+HFTA (PAPERS.md, arXiv 2102.02344) observes that a swarm of
+hyperparameter-sweep jobs — same architecture, different lr/seed — each
+under-fills an accelerator while the queue backs up.  The fix is to
+fuse the members along a leading "model array" axis: params, optimizer
+state and per-member RNG streams become ``[members, ...]`` stacked
+arrays stepped by ONE jitted program (``jax.vmap`` over the solo step),
+so one slice amortizes dispatch, compilation and memory bandwidth over
+the whole family.  This module is the training twin of the serving
+adapter arrays (serving/adapters.py): stacked per-variant state under
+one compiled program.
+
+Semantics the scheduler tier (scheduler/fuse.py) relies on:
+
+  - **Per-member hparams.**  The learning rate rides INSIDE the
+    optimizer state via ``optax.inject_hyperparams`` — stacking member
+    opt states yields an ``[members]`` lr vector read by the single
+    traced ``tx.update``, so one trace serves every member.  Seeds
+    diverge the per-member RNG streams, stored as raw
+    ``jax.random.key_data`` (uint32) so the active mask can
+    ``jnp.where`` over them (typed key dtypes reject ``where``).
+  - **Active mask, not retirement.**  An early-stopped (``stop_step``)
+    or diverged (non-finite loss) member FREEZES: the vmapped step
+    still computes its candidate update, but a per-member boolean mask
+    discards it, so params/opt/rng/step stay exactly at the freeze
+    point.  The gang keeps its shape (no recompile) and the frozen
+    member's checkpoint equals its solo stop state.
+  - **Width invariance.**  A member's trajectory is bit-identical
+    across fused widths (vmap batches the SAME dot-generals whether
+    M=1 or M=4), so a width-1 ``FusedTrainer`` run IS the solo
+    control, and a gang restart that re-enters with fewer active
+    members cannot perturb the survivors.  (A plain ``Trainer`` solo
+    run matches only to float tolerance — batched vs single GEMM
+    accumulation order differs — which is why controls run through
+    this tier.)
+  - **Per-member checkpoints.**  Each member saves/restores its OWN
+    solo-shaped :class:`~kubeflow_tpu.runtime.train.TrainState` under
+    ``<root>/<member-name>/`` through the verified-manifest
+    :class:`~kubeflow_tpu.runtime.checkpoint.CheckpointManager` — a
+    plain Trainer (or ``kubeflow-tpu checkpoints list``) reads a
+    member directory as if the member had run solo, and
+    ``restore_or_init`` of member i from a fused run resumes it
+    individually.  On a fused resume the gang re-enters at
+    ``max(start_i)``; members that froze earlier re-enter MASKED.
+
+The fit loop mirrors ``Trainer.fit``'s dispatch discipline (bounded
+2-call inflight window, async checkpoints, ``train.step`` fault site,
+``on_step`` call-boundary hook) so ``TrainSupervisor`` wraps a
+FusedTrainer unchanged: heartbeat/stall detection stays gang-level,
+and a supervised restart re-enters through the per-member
+``restore_or_init`` path with only still-active members unfrozen.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from collections import deque
+from typing import (Any, Callable, Deque, Dict, Iterable, List, Optional,
+                    Sequence, Tuple)
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import linen as nn
+
+from kubeflow_tpu.parallel.mesh import DEFAULT_RULES, LogicalRules, \
+    batch_sharding
+from kubeflow_tpu.runtime.checkpoint import CheckpointManager
+from kubeflow_tpu.runtime.metrics import MetricsLogger, Timer
+from kubeflow_tpu.runtime.train import LossFn, TrainState
+from kubeflow_tpu.testing import faults
+
+log = logging.getLogger(__name__)
+
+
+def default_tx_factory(lr: float) -> optax.GradientTransformation:
+    """AdamW with the learning rate injected as optimizer-state data
+    (``opt_state.hyperparams['learning_rate']``) rather than a trace
+    constant — stacking member states yields the per-member lr vector
+    the single traced update reads."""
+    return optax.inject_hyperparams(optax.adamw)(learning_rate=lr)
+
+
+@dataclasses.dataclass(frozen=True)
+class MemberSpec:
+    """One fused-array member: a solo job's identity inside the gang.
+
+    stop_step: freeze (mask) the member once its step counter reaches
+      this value — the early-stop knob.  None = run to ``num_steps``.
+    """
+
+    name: str
+    seed: int = 0
+    lr: float = 1e-3
+    tenant: str = "default"
+    stop_step: Optional[int] = None
+
+
+def _gauge(name: str, help_: str):
+    from kubeflow_tpu.runtime.prom import REGISTRY
+
+    return REGISTRY.gauge(name, help_)
+
+
+def _counter(name: str, help_: str):
+    from kubeflow_tpu.runtime.prom import REGISTRY
+
+    return REGISTRY.counter(name, help_)
+
+
+# Process-level compiled-step cache.  Every FusedTrainer built on the
+# same task (loss_fn / mesh / tx_factory / rules / mask policy) traces
+# the SAME program, and one jit wrapper caches executables per input
+# shape — so solo controls, checkpoint resumes, and re-folds after a
+# preemption reuse the existing trace instead of paying a fresh jit
+# per construction.  Keys hold strong refs; the population is bounded
+# by the number of distinct tasks a process ever trains.
+_STEP_CACHE: Dict[Any, Any] = {}
+
+
+@dataclasses.dataclass
+class FusedTrainer:
+    """N same-architecture training jobs fused into one SPMD program.
+
+    init_fn / loss_fn: the SAME contracts as
+      :class:`~kubeflow_tpu.runtime.train.Trainer` — the member axis is
+      entirely this class's business; models stay fusion-oblivious.
+    tx_factory: lr -> GradientTransformation.  Must put the lr in the
+      optimizer state (``optax.inject_hyperparams``) so members can
+      differ; the default is :func:`default_tx_factory`.
+    members: the member array.  Order is the stacking order and the
+      checkpoint subdirectory layout — keep it stable across resumes.
+    checkpoint_dir: per-member managers live at
+      ``<checkpoint_dir>/<member.name>``; None disables checkpointing.
+    mask_nonfinite: freeze a member whose loss goes non-finite instead
+      of letting NaNs poison its params (the masked update discards
+      the whole bad step, so the member holds its last finite state).
+    """
+
+    init_fn: Callable[[jax.Array], Any]
+    loss_fn: LossFn
+    members: Sequence[MemberSpec]
+    mesh: Any
+    tx_factory: Callable[[float], optax.GradientTransformation] = \
+        default_tx_factory
+    rules: LogicalRules = DEFAULT_RULES
+    checkpoint_dir: Optional[str] = None
+    checkpoint_every: int = 1000
+    max_to_keep: int = 3
+    metrics: MetricsLogger = dataclasses.field(default_factory=MetricsLogger)
+    mask_nonfinite: bool = True
+
+    def __post_init__(self) -> None:
+        if not self.members:
+            raise ValueError("FusedTrainer needs at least one member")
+        names = [m.name for m in self.members]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate member names: {names}")
+        self._tx = self.tx_factory(0.0)  # lr comes from opt_state
+        self._fused_step = None
+        self._managers: Dict[str, CheckpointManager] = {}
+        self._last_metrics: Dict[str, float] = {}
+        self._last_active: List[bool] = [True] * len(self.members)
+        self._member_steps = _counter(
+            "kft_train_member_steps_total",
+            "optimizer steps applied per fused-array member")
+        self._members_active = _gauge(
+            "kft_train_members_active",
+            "fused-array members currently unmasked")
+
+    # -- observability -----------------------------------------------------
+
+    @property
+    def member_names(self) -> List[str]:
+        return [m.name for m in self.members]
+
+    @property
+    def last_metrics(self) -> Dict[str, float]:
+        return dict(self._last_metrics)
+
+    @property
+    def last_active(self) -> List[bool]:
+        """Per-member mask after the last fit() call — False means the
+        member froze (early stop or non-finite loss)."""
+        return list(self._last_active)
+
+    # -- per-member state --------------------------------------------------
+
+    def manager(self, member: MemberSpec) -> Optional[CheckpointManager]:
+        if self.checkpoint_dir is None:
+            return None
+        mgr = self._managers.get(member.name)
+        if mgr is None:
+            mgr = CheckpointManager(
+                f"{self.checkpoint_dir}/{member.name}",
+                max_to_keep=self.max_to_keep)
+            self._managers[member.name] = mgr
+        return mgr
+
+    def create_member_state(self, member: MemberSpec) -> TrainState:
+        """Solo-shaped init, derived EXACTLY like ``Trainer.create_state``
+        (same key splits) so a member checkpoint round-trips with a
+        plain Trainer built on ``tx_factory(member.lr)``."""
+        rng = jax.random.key(member.seed)
+
+        def init(rng):
+            init_rng, state_rng = jax.random.split(rng)
+            params, mutable = self.init_fn(init_rng)
+            params = nn.unbox(params)
+            opt_state = self.tx_factory(member.lr).init(params)
+            return TrainState(
+                step=jnp.zeros((), jnp.int32),
+                params=params,
+                opt_state=opt_state,
+                rng=state_rng,
+                mutable=nn.unbox(mutable),
+            )
+
+        return jax.jit(init)(rng)
+
+    def member_state(self, fused: TrainState, i: int) -> TrainState:
+        """Slice member ``i`` out of a fused state as a solo-shaped
+        TrainState (typed RNG key restored) — what gets checkpointed
+        and what a solo Trainer would hold."""
+        solo = jax.tree_util.tree_map(lambda x: x[i], fused)
+        return solo.replace(rng=jax.random.wrap_key_data(solo.rng))
+
+    @staticmethod
+    def _stack_members(solo_states: Sequence[TrainState]) -> TrainState:
+        """Stack solo states on a new leading member axis; the typed RNG
+        key becomes raw key_data so the active mask can select over it."""
+        as_data = [s.replace(rng=jax.random.key_data(s.rng))
+                   for s in solo_states]
+        return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *as_data)
+
+    # -- fused step --------------------------------------------------------
+
+    def _member_step(self, state: TrainState, batch: Any):
+        """One member's solo step (rank as in Trainer._step_body); vmap
+        lifts it over the leading member axis."""
+        rng, step_rng = jax.random.split(jax.random.wrap_key_data(state.rng))
+
+        def loss(params):
+            with self.mesh, nn.logical_axis_rules(list(self.rules)):
+                return self.loss_fn(params, state.mutable, batch, step_rng)
+
+        (loss_val, (aux, new_mutable)), grads = jax.value_and_grad(
+            loss, has_aux=True
+        )(state.params)
+        updates, new_opt = self._tx.update(
+            grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        new_state = state.replace(
+            step=state.step + 1,
+            params=new_params,
+            opt_state=new_opt,
+            rng=jax.random.key_data(rng),
+            mutable=new_mutable,
+        )
+        metrics = {
+            "loss": loss_val,
+            "grad_norm": optax.global_norm(grads),
+            **aux,
+        }
+        return new_state, metrics
+
+    def _step_cache_key(self):
+        """Everything the traced step closes over.  ``_member_step``
+        reads loss_fn, mesh, rules and the tx_factory-built update (lr
+        is optimizer-state DATA, so the factory identity suffices);
+        the mask layer reads mask_nonfinite.  Member count is NOT in
+        the key — jit re-traces per width under the one wrapper."""
+        try:
+            return (self.loss_fn, self.mesh, self.tx_factory,
+                    tuple(self.rules), self.mask_nonfinite)
+        except TypeError:        # unhashable custom rules: no sharing
+            return None
+
+    def compile_step(self):
+        """jit(vmap(member_step)) + the mask layer: candidates are
+        computed for every member, then discarded wholesale for masked
+        ones — a frozen member's state is BIT-identical to its freeze
+        point, not merely close."""
+        if self._fused_step is not None:
+            return self._fused_step
+        key = self._step_cache_key()
+        if key is not None and key in _STEP_CACHE:
+            self._fused_step = _STEP_CACHE[key]
+            return self._fused_step
+
+        def fused(state: TrainState, active: jax.Array,
+                  stops: jax.Array, batch: Any):
+            cand, metrics = jax.vmap(
+                self._member_step, in_axes=(0, None))(state, batch)
+            keep = active
+            if self.mask_nonfinite:
+                keep = keep & jnp.isfinite(metrics["loss"])
+
+            def select(new, old):
+                mask = keep.reshape(
+                    keep.shape + (1,) * (new.ndim - 1))
+                return jnp.where(mask, new, old)
+
+            new_state = jax.tree_util.tree_map(select, cand, state)
+            new_active = keep & (new_state.step < stops)
+            return new_state, new_active, metrics
+
+        self._fused_step = jax.jit(fused, donate_argnums=(0, 1))
+        if key is not None:
+            _STEP_CACHE[key] = self._fused_step
+        return self._fused_step
+
+    def shard_batch(self, batch: Any) -> Any:
+        """One batch feeds every member (HFTA's shared-input shape);
+        batch dim sharded over the mesh's dp axes as in Trainer."""
+        return jax.tree_util.tree_map(
+            lambda x: jax.device_put(
+                x, batch_sharding(self.mesh, ndim=getattr(x, "ndim", 1))),
+            batch)
+
+    # -- loop --------------------------------------------------------------
+
+    def _stop_of(self, member: MemberSpec, num_steps: int) -> int:
+        return min(num_steps, member.stop_step
+                   if member.stop_step is not None else num_steps)
+
+    def fit(
+        self,
+        data: Iterable[Any],
+        num_steps: int,
+        *,
+        examples_per_step: int = 0,
+        log_every: int = 10,
+        on_step: Optional[Callable[[int], None]] = None,
+    ) -> TrainState:
+        """Run the fused loop; returns the final stacked TrainState.
+
+        Resume: each member independently ``restore_or_init``s from its
+        own verified subdirectory; the gang re-enters at
+        ``max(start_i)`` with members that froze earlier (or already
+        hit their stop) re-entering MASKED — their state rides along
+        untouched, which is what keeps a post-preemption member
+        bit-identical to its solo control.
+        """
+        n = len(self.members)
+        solo_states: List[TrainState] = []
+        starts: List[int] = []
+        for m in self.members:
+            init = self.create_member_state(m)
+            mgr = self.manager(m)
+            if mgr is not None:
+                st, s0 = mgr.restore_or_init(init)
+            else:
+                st, s0 = init, 0
+            solo_states.append(st)
+            starts.append(s0)
+        gang_start = max(starts)
+        stops = [self._stop_of(m, num_steps) for m in self.members]
+        active_host = [starts[i] == gang_start and gang_start < stops[i]
+                       for i in range(n)]
+        state = self._stack_members(solo_states)
+        # Restored checkpoint arrays arrive COMMITTED to whatever
+        # device the restore used; fresh-init arrays are uncommitted.
+        # Pin the whole fused state replicated over the mesh so both
+        # paths hand jit the same placement (a mixed state raises
+        # "incompatible devices" against the sharded batch).
+        state = jax.device_put(
+            state, jax.sharding.NamedSharding(
+                self.mesh, jax.sharding.PartitionSpec()))
+        self._members_active.set(float(sum(active_host)))
+        if gang_start >= num_steps or not any(active_host):
+            self._last_active = active_host
+            self._last_metrics = {}
+            return state
+
+        step_fn = self.compile_step()
+        active = jnp.asarray(active_host)
+        stops_arr = jnp.asarray(stops, jnp.int32)
+        n_chips = self.mesh.devices.size
+
+        it = iter(data)
+        if gang_start:
+            seek = getattr(data, "seek", None)
+            if callable(seek):
+                seek(gang_start)
+            else:
+                for _ in range(gang_start):
+                    next(it)
+
+        last_saved = [s - 1 for s in starts]
+        last_counted = list(starts)
+        save_points = {s for s in stops if s < num_steps}
+        final_metrics: Dict[str, Any] = {}
+        batch = self.shard_batch(next(it))
+        timer = Timer()
+        timer.start()
+        window_steps = 0
+        inflight: Deque[Any] = deque()
+        i = gang_start
+        while i < num_steps:
+            faults.fire("train.step")
+            state, active, metrics = step_fn(state, active, stops_arr, batch)
+            i_next = i + 1
+            window_steps += 1
+            if i_next < num_steps:
+                batch = self.shard_batch(next(it))
+            inflight.append(metrics["loss"])
+            if len(inflight) > 2:
+                jax.block_until_ready(inflight.popleft())
+            if on_step is not None:
+                on_step(i_next)
+            last = i_next - 1
+            at_end = i_next == num_steps
+            crossed_stop = i_next in save_points
+            at_boundary = (
+                i_next // self.checkpoint_every > i // self.checkpoint_every)
+            if log_every and (i_next // log_every > i // log_every or at_end):
+                losses = jax.device_get(metrics["loss"])
+                act = jax.device_get(active)
+                dt = timer.stop() / window_steps
+                timer.start()
+                window_steps = 0
+                live = [float(l) for l, a in zip(losses, act) if a]
+                self.metrics.step(
+                    step=last,
+                    step_time_s=dt,
+                    examples_per_step=examples_per_step * max(1, sum(act)),
+                    n_chips=n_chips,
+                    loss=sum(live) / len(live) if live else None,
+                    members=n,
+                    members_active=int(sum(act)),
+                )
+            if crossed_stop or at_boundary or at_end:
+                steps_host = [int(s) for s in jax.device_get(state.step)]
+                act_host = [bool(a) for a in jax.device_get(active)]
+                self._members_active.set(float(sum(act_host)))
+                for idx, m in enumerate(self.members):
+                    delta = steps_host[idx] - last_counted[idx]
+                    if delta > 0:
+                        self._member_steps.inc(delta, member=m.name)
+                    last_counted[idx] = steps_host[idx]
+                    mgr = self.manager(m)
+                    saved = steps_host[idx] - 1
+                    if (mgr is not None and saved >= 0
+                            and saved != last_saved[idx]
+                            and (at_boundary or at_end
+                                 or steps_host[idx] == stops[idx])):
+                        mgr.save(saved, self.member_state(state, idx),
+                                 force=at_end or steps_host[idx] == stops[idx])
+                        last_saved[idx] = saved
+                if not any(act_host):
+                    # Every member froze: the remaining gang steps would
+                    # be pure masked no-ops — finish early.
+                    final_metrics = metrics
+                    i = i_next
+                    break
+            final_metrics = metrics
+            i = i_next
+        # Final settle: force-save any member whose newest step never hit
+        # a boundary (covers non-finite freezes and the early break).
+        steps_host = [int(s) for s in jax.device_get(state.step)]
+        for idx, m in enumerate(self.members):
+            delta = steps_host[idx] - last_counted[idx]
+            if delta > 0:
+                self._member_steps.inc(delta, member=m.name)
+                last_counted[idx] = steps_host[idx]
+            mgr = self.manager(m)
+            saved = steps_host[idx] - 1
+            if mgr is not None and saved >= 0 and saved != last_saved[idx]:
+                mgr.save(saved, self.member_state(state, idx), force=True)
+                last_saved[idx] = saved
+        for mgr in self._managers.values():
+            mgr.wait()
+        act_host = [bool(a) for a in jax.device_get(active)]
+        self._last_active = act_host
+        self._members_active.set(float(sum(act_host)))
+        losses = jax.device_get(final_metrics["loss"]) \
+            if final_metrics else []
+        self._last_metrics = {
+            f"loss/{m.name}": float(l)
+            for m, l in zip(self.members, losses)
+        }
+        if len(losses):
+            self._last_metrics["loss"] = float(
+                sum(float(l) for l in losses) / len(losses))
+        return state

@@ -15,8 +15,17 @@ Layout:
     queue.py    persistent pending-queue bookkeeping + ClusterScheduler
                 (the facade the reconciler consults)
     preempt.py  victim selection + the preemption rate limiter
+    fuse.py     horizontal fusion: fold fusable singleton swarms into
+                one gang (the HFTA admission tier; runtime/hfta.py is
+                the training half)
 """
 
+from kubeflow_tpu.scheduler.fuse import (  # noqa: F401
+    LABEL_FUSE_FAMILY,
+    fold_pending,
+    fused_gang_key,
+    fused_gang_name,
+)
 from kubeflow_tpu.scheduler.policy import (  # noqa: F401
     DEFAULT_PRIORITY_CLASSES,
     LABEL_PRIORITY,
@@ -26,6 +35,7 @@ from kubeflow_tpu.scheduler.policy import (  # noqa: F401
     Plan,
     SchedulerConfig,
     SchedulingPolicy,
+    tenant_shares,
 )
 from kubeflow_tpu.scheduler.preempt import (  # noqa: F401
     PreemptionConfig,

@@ -53,6 +53,9 @@ from kubeflow_tpu.testing import faults
 # the reconciler stamps on pods).
 LABEL_TENANT = "kubeflow-tpu.org/tenant"
 LABEL_PRIORITY = "kubeflow-tpu.org/priority"
+# Opt-in marker for horizontal fusion (scheduler/fuse.py): singleton
+# jobs sharing a family value assert same-architecture compatibility.
+LABEL_FUSE_FAMILY = "kubeflow-tpu.org/fuse-family"
 
 DEFAULT_TENANT = "default"
 DEFAULT_PRIORITY = "normal"
@@ -80,6 +83,28 @@ class JobView:
     enqueued_at: float = 0.0
     resumable: bool = False
     preemptions: int = 0
+    # Horizontal fusion (scheduler/fuse.py): ``family`` is the CR's
+    # opt-in label; a FUSED view carries its member views in
+    # ``members`` (then ``chips`` is the whole gang's slice, billed
+    # per-member by :func:`tenant_shares`); a MEMBER view carries the
+    # gang it belongs to in ``fused_gang`` plus the member count for
+    # status rendering.
+    family: str = ""
+    members: Tuple["JobView", ...] = ()
+    fused_gang: str = ""
+    fused_members: int = 0
+
+
+def tenant_shares(job: JobView) -> List[Tuple[str, float]]:
+    """(tenant, chips) pairs a view bills against quota/fair-share.
+
+    THE fused fair-share rule: a fused gang charges each member's
+    tenant its per-member share of the slice — never one tenant for
+    the whole gang.  Singletons bill themselves in full."""
+    if job.members:
+        share = job.chips / len(job.members)
+        return [(m.tenant, share) for m in job.members]
+    return [(job.tenant, job.chips)]
 
 
 @dataclasses.dataclass
@@ -163,6 +188,12 @@ class Decision:
     message: str = ""
     backfilled: bool = False
     preemptor: str = ""      # preempt decisions: who the slices go to
+    # Mirrored member decisions (scheduler/fuse.py): the gang claim key
+    # this member's admission rides on, every member key in the gang,
+    # and whether THIS member leads pod materialization/teardown.
+    fused_gang: str = ""
+    fused_members: Tuple[str, ...] = ()
+    fused_leader: bool = False
 
 
 @dataclasses.dataclass
@@ -196,6 +227,9 @@ def job_view(cr_obj: dict, spec: Any, config: SchedulerConfig) -> JobView:
         phase=status.get("phase", ""),
         resumable=bool(status.get("resumable")),
         preemptions=int(status.get("preemptions", 0)),
+        family=labels.get(LABEL_FUSE_FAMILY, ""),
+        fused_gang=str(status.get("fusedGang") or ""),
+        fused_members=int(status.get("fusedMembers", 0) or 0),
     )
 
 
@@ -220,10 +254,11 @@ class SchedulingPolicy:
         plan = Plan()
         free = dict(free)
         usage = self._usage(running)
-        tenant_chips = {}
+        tenant_chips: Dict[str, float] = {}
         for job in running:
-            tenant_chips[job.tenant] = \
-                tenant_chips.get(job.tenant, 0) + job.chips
+            for tenant, share in tenant_shares(job):
+                tenant_chips[tenant] = \
+                    tenant_chips.get(tenant, 0) + share
 
         # Claims already being torn down: capacity that will free
         # without any new eviction, per slice type.
@@ -251,27 +286,39 @@ class SchedulingPolicy:
                              f"{capacity.get(job.slice_type, 0)}"))
                 continue
 
-            quota = self.config.quota_chips(job.tenant, job.slice_type)
-            used = usage.get((job.tenant, job.slice_type), 0)
-            if quota is not None and job.chips > quota:
-                # Exceeds the tenant's ceiling even with NOTHING else
-                # admitted: it can never run under this config —
-                # terminal, like the capacity-unsatisfiable path, not
-                # a permanent queue squatter.
-                plan.decisions[job.key] = Decision(
-                    action=UNSATISFIABLE, reason="QuotaUnsatisfiable",
-                    message=(f"requires {job.chips} chips of "
-                             f"{job.slice_type} but tenant "
-                             f"{job.tenant!r} quota is {quota}"))
-                continue
-            if quota is not None and used + job.chips > quota:
-                # Skipped, not blocking: quota is the tenant's own
-                # ceiling, and a capped tenant must not wedge others.
-                plan.decisions[job.key] = Decision(
-                    action=WAIT, reason="QuotaExceeded",
-                    message=(f"tenant {job.tenant!r} at "
-                             f"{used}/{quota} chips of "
-                             f"{job.slice_type}"))
+            # Quota checks bill per tenant SHARE: a singleton is its own
+            # whole demand; a fused gang charges each member's tenant
+            # chips/len(members) (tenant_shares).
+            verdict = None
+            for tenant, share in tenant_shares(job):
+                quota = self.config.quota_chips(tenant, job.slice_type)
+                if quota is None:
+                    continue
+                used = usage.get((tenant, job.slice_type), 0)
+                if share > quota:
+                    # Exceeds the tenant's ceiling even with NOTHING
+                    # else admitted: it can never run under this
+                    # config — terminal, like the capacity-
+                    # unsatisfiable path, not a permanent queue
+                    # squatter.
+                    verdict = Decision(
+                        action=UNSATISFIABLE, reason="QuotaUnsatisfiable",
+                        message=(f"requires {share:g} chips of "
+                                 f"{job.slice_type} but tenant "
+                                 f"{tenant!r} quota is {quota}"))
+                    break
+                if used + share > quota:
+                    # Skipped, not blocking: quota is the tenant's own
+                    # ceiling, and a capped tenant must not wedge
+                    # others.
+                    verdict = Decision(
+                        action=WAIT, reason="QuotaExceeded",
+                        message=(f"tenant {tenant!r} at "
+                                 f"{used:g}/{quota} chips of "
+                                 f"{job.slice_type}"))
+                    break
+            if verdict is not None:
+                plan.decisions[job.key] = verdict
                 continue
 
             fits = free.get(job.slice_type, 0) >= job.count
@@ -287,9 +334,11 @@ class SchedulingPolicy:
                     action=ADMIT, reason="Admitted",
                     backfilled=bool(blocked))
                 free[job.slice_type] -= job.count
-                usage[(job.tenant, job.slice_type)] = used + job.chips
-                tenant_chips[job.tenant] = \
-                    tenant_chips.get(job.tenant, 0) + job.chips
+                for tenant, share in tenant_shares(job):
+                    usage[(tenant, job.slice_type)] = \
+                        usage.get((tenant, job.slice_type), 0) + share
+                    tenant_chips[tenant] = \
+                        tenant_chips.get(tenant, 0) + share
                 continue
 
             if fits:
@@ -333,21 +382,27 @@ class SchedulingPolicy:
     # -- internals ---------------------------------------------------------
 
     @staticmethod
-    def _usage(running: List[JobView]) -> Dict[Tuple[str, str], int]:
-        usage: Dict[Tuple[str, str], int] = {}
+    def _usage(running: List[JobView]) -> Dict[Tuple[str, str], float]:
+        """Admitted chips by (tenant, slice_type) — fused gangs billed
+        per-member via :func:`tenant_shares`."""
+        usage: Dict[Tuple[str, str], float] = {}
         for job in running:
-            key = (job.tenant, job.slice_type)
-            usage[key] = usage.get(key, 0) + job.chips
+            for tenant, share in tenant_shares(job):
+                key = (tenant, job.slice_type)
+                usage[key] = usage.get(key, 0) + share
         return usage
 
     def _pick(self, candidates: List[JobView],
-              tenant_chips: Dict[str, int]) -> JobView:
+              tenant_chips: Dict[str, float]) -> JobView:
         """Next job: strict priority, then least admitted chips per
         weight across tenants (recomputed against simulated
-        admissions), then FIFO."""
+        admissions), then FIFO.  A fused gang ranks by its
+        LEAST-served member tenant — the gang is pulled forward by
+        whichever member fair-share would pick first."""
         def rank(job: JobView):
-            fair = tenant_chips.get(job.tenant, 0) / \
-                self.config.weight(job.tenant)
+            fair = min(
+                tenant_chips.get(tenant, 0) / self.config.weight(tenant)
+                for tenant, _ in tenant_shares(job))
             return (-job.priority_value, fair, job.enqueued_at, job.key)
         return min(candidates, key=rank)
 

@@ -18,10 +18,11 @@ import collections
 import dataclasses
 import logging
 import threading
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 from kubeflow_tpu.operator import crd
 from kubeflow_tpu.runtime import tracing
+from kubeflow_tpu.scheduler import fuse
 from kubeflow_tpu.scheduler.policy import (
     ADMIT,
     PREEMPT,
@@ -209,19 +210,30 @@ class ClusterScheduler:
             views[view.key] = view
             if view.phase in self._TERMINAL:
                 continue
-            if self.gang.admitted(view.key):
+            if self.gang.admitted(view.key) or (
+                    view.fused_gang
+                    and self.gang.admitted(view.fused_gang)):
                 running.append(view)
             else:
+                view.fused_gang = ""   # stale stamp: gang released
                 view.enqueued_at = self.queue.touch(view)
                 pending.append(view)
         self.queue.prune([v.key for v in pending])
+        # Horizontal fusion: fold compatible pending singletons into
+        # one gang view, regroup admitted fused members back into
+        # theirs, then mirror the gang verdicts onto member keys so
+        # the reconciler drives ordinary member CRs.
+        pending, fused_pending = fuse.fold_pending(pending, self.gang)
+        running, fused_running = fuse.fold_running(running, self.gang)
         free = {t: self.gang.free(t) for t in self.gang.capacity}
         plan = self.policy.plan(pending, running, free,
                                 dict(self.gang.capacity))
+        fuse.mirror_decisions(plan, fused_pending + fused_running)
         with self._lock:
             self._last_plan = plan
             self._last_views = views
-        self._export_metrics(pending, running)
+        self._export_metrics(pending, running,
+                             fused_pending + fused_running)
         return plan
 
     def note_admitted(self, key: str, backfilled: bool = False,
@@ -278,8 +290,18 @@ class ClusterScheduler:
     # -- observability -----------------------------------------------------
 
     def _export_metrics(self, pending: List[JobView],
-                        running: List[JobView]) -> None:
+                        running: List[JobView],
+                        fused: List[JobView] = ()) -> None:
         from kubeflow_tpu.runtime.prom import REGISTRY
+
+        REGISTRY.gauge(
+            "kft_scheduler_fused_gangs",
+            "fused training gangs in the current plan "
+            "(pending folds + admitted)").set(float(len(fused)))
+        REGISTRY.gauge(
+            "kft_scheduler_fused_members",
+            "member jobs folded into fused gangs in the current "
+            "plan").set(float(sum(len(f.members) for f in fused)))
 
         depth = REGISTRY.gauge(
             "kft_scheduler_queue_depth",
@@ -325,7 +347,8 @@ class ClusterScheduler:
             if view.phase in self._TERMINAL:
                 continue
             decision = plan.decisions.get(key)
-            admitted = self.gang.admitted(key)
+            admitted = self.gang.admitted(key) or (
+                view.fused_gang and self.gang.admitted(view.fused_gang))
             if admitted:
                 state = ("Preempting"
                          if decision is not None
@@ -338,24 +361,42 @@ class ClusterScheduler:
             else:
                 state = decision.reason or "Pending"
             wait = self.queue.wait_of(key)
+            # A fused member's chips column shows its SHARE of the
+            # gang slice — the quantity its tenant is billed.
+            chips = (view.chips / view.fused_members
+                     if view.fused_members else view.chips)
             jobs.append({
                 "job": key,
                 "tenant": view.tenant,
                 "priority": view.priority,
                 "slices": f"{view.count}x{view.slice_type}",
-                "chips": view.chips,
+                "chips": chips,
                 "state": state,
                 "detail": (decision.message if decision else ""),
                 "position": position.get(key),
                 "wait_s": round(wait, 3) if wait is not None else None,
                 "resumable": view.resumable,
                 "preemptions": view.preemptions,
+                "members": view.fused_members or None,
             })
         quotas = []
-        usage = SchedulingPolicy._usage(
-            [v for v in views.values()
-             if v.phase not in self._TERMINAL
-             and self.gang.admitted(v.key)])
+        # Fused-aware usage over LIVE claims (a job admitted during
+        # the current sweep was still pending at plan time, so a
+        # plan-time snapshot would under-bill): each fused member
+        # bills its tenant its SHARE of the gang slice, a singleton
+        # its whole gang.
+        usage: Dict[Tuple[str, str], float] = {}
+        for view in views.values():
+            if view.phase in self._TERMINAL:
+                continue
+            if not (self.gang.admitted(view.key) or
+                    (view.fused_gang and
+                     self.gang.admitted(view.fused_gang))):
+                continue
+            share = (view.chips / view.fused_members
+                     if view.fused_members else view.chips)
+            slot = (view.tenant, view.slice_type)
+            usage[slot] = usage.get(slot, 0) + share
         for tenant, per_type in sorted(self.config.quotas.items()):
             for slice_type, chips in sorted(per_type.items()):
                 quotas.append({

@@ -2793,6 +2793,255 @@ def train_smoke(namespace: str = "kubeflow-test") -> None:
     assert proc.returncode == 0, proc.stderr[-2000:]
 
 
+def hfta_smoke(namespace: str = "kubeflow-test") -> None:
+    """Hermetic horizontally-fused-training-array scenario — the whole
+    HFTA tier, scheduler fold (scheduler/fuse.py) through fused
+    runtime (runtime/hfta.py):
+
+      1. fold at admission — two tenants submit four fusable
+         singleton TPUJobs (same family/slice/priority) over the fake
+         apiserver; they admit as ONE fused gang claim on one slice,
+         each member stamped with its gang and billed its fair SHARE
+         of the slice chips (2 of 8), so both tenants fit a 4-chip
+         quota that could not admit even one 8-chip singleton;
+      2. preemption with per-member resume — a high-priority arrival
+         evicts the fused gang through the clock-skewed grace window;
+         every member requeues ``resumable`` with its gang stamp
+         cleared, and once the preemptor finishes the fold re-forms
+         and resumes ALL members (resume counter == member count);
+      3. member-level completion — the shared pod gang succeeding
+         completes every member CR individually (one
+         FusedMemberCompleted event per member);
+      4. runtime bit-identity across the same lifecycle — a width-4
+         FusedTrainer (two tenants, one member early-stopping masked
+         mid-run) is killed after 3 steps and resumed from its
+         per-member verified-manifest checkpoints: per-member steps
+         stay monotone across the boundary and final params are
+         bit-identical to an uninterrupted control run, the
+         early-stopped member included;
+      5. outcomes are scrapeable: kft_scheduler_fused_gangs/_members
+         while the gang runs, kft_train_member_steps_total /
+         kft_train_members_active from the fused fit.
+    """
+    import tempfile
+
+    import jax
+    import numpy as np
+
+    from kubeflow_tpu.models.transformer import TransformerConfig, lm_task
+    from kubeflow_tpu.operator import crd
+    from kubeflow_tpu.operator.gang import GangScheduler
+    from kubeflow_tpu.operator.kube_http import HttpKube
+    from kubeflow_tpu.operator.reconciler import (
+        JOB_PREEMPTING,
+        JOB_SUCCEEDED,
+        QUEUED,
+        STARTING,
+        TPUJobController,
+    )
+    from kubeflow_tpu.parallel import MeshSpec
+    from kubeflow_tpu.runtime.hfta import FusedTrainer, MemberSpec
+    from kubeflow_tpu.runtime.metrics import MetricsLogger
+    from kubeflow_tpu.runtime.prom import (
+        REGISTRY,
+        parse_metrics,
+        sample_value,
+    )
+    from kubeflow_tpu.scheduler import (
+        LABEL_FUSE_FAMILY,
+        LABEL_PRIORITY,
+        LABEL_TENANT,
+        ClusterScheduler,
+        PreemptionConfig,
+        SchedulerConfig,
+    )
+    from kubeflow_tpu.testing import faults
+    from kubeflow_tpu.testing.fake_apiserver import make_fake_apiserver
+
+    def metric(parsed, name, **labels):
+        return sample_value(parsed, name, **labels) or 0.0
+
+    def make_cr(name, tenant, priority="low", family="sweep"):
+        job = crd.TPUJobSpec(name=name, namespace=namespace,
+                             slice_type="v5e-8", num_slices=1)
+        cr = job.to_custom_resource()
+        cr["metadata"]["labels"] = {LABEL_TENANT: tenant,
+                                    LABEL_PRIORITY: priority,
+                                    LABEL_FUSE_FAMILY: family}
+        return cr
+
+    before = parse_metrics(REGISTRY.render())
+    apiserver = None
+    with faults.injected("seed=20260807") as inj, \
+            tempfile.TemporaryDirectory() as tmp:
+        try:
+            apiserver, _, store = make_fake_apiserver()
+            kube = HttpKube(
+                base_url=f"http://127.0.0.1:"
+                         f"{apiserver.server_address[1]}")
+            gang = GangScheduler({"v5e-8": 1})
+            cluster = ClusterScheduler(gang, SchedulerConfig(
+                quotas={"tenant-a": {"v5e-8": 4},
+                        "tenant-b": {"v5e-8": 4}},
+                preemption=PreemptionConfig(grace_period_s=30.0)))
+            ctl = TPUJobController(kube, gang, cluster)
+
+            def statuses():
+                return {c["metadata"]["name"]: (c.get("status") or {})
+                        for c in kube.list_custom(namespace)}
+
+            # -- 1. two tenants' singletons fold into one gang --------
+            for i in range(4):
+                kube.create_custom(make_cr(
+                    f"m{i}", tenant=f"tenant-{'ab'[i % 2]}"))
+            ctl.reconcile_all()
+            st = statuses()
+            gkey = f"fused:{namespace}/sweep"
+            for i in range(4):
+                assert st[f"m{i}"]["phase"] == STARTING, st
+                assert st[f"m{i}"]["fusedGang"] == gkey, st
+            assert gang.admitted(gkey)
+            assert kube.list_pods(
+                namespace,
+                labels={"kubeflow-tpu.org/job-name": "fused-sweep"}), \
+                "fused gang must run ONE shared pod gang"
+            # Fair share: each tenant is billed its members' slice
+            # share (2 x 2 chips), inside a quota an 8-chip singleton
+            # would blow on its own.
+            quotas = {q["tenant"]: q["used_chips"]
+                      for q in cluster.status()["quotas"]}
+            assert quotas == {"tenant-a": 4.0, "tenant-b": 4.0}, quotas
+            rows = {r["job"]: r for r in cluster.status()["jobs"]}
+            assert rows[f"{namespace}/m0"]["members"] == 4
+            assert rows[f"{namespace}/m0"]["chips"] == 2.0
+            parsed = parse_metrics(REGISTRY.render())
+            assert sample_value(
+                parsed, "kft_scheduler_fused_gangs") == 1.0
+            assert sample_value(
+                parsed, "kft_scheduler_fused_members") == 4.0
+
+            # -- 2. preempt the gang; every member resumes ------------
+            # vip rides an unquoted tenant — the point is priority
+            # eviction, not quota.
+            kube.create_custom(make_cr("vip", tenant="prod",
+                                       priority="high", family=""))
+            ctl.reconcile_all()
+            st = statuses()
+            for i in range(4):
+                assert st[f"m{i}"]["phase"] == JOB_PREEMPTING, st
+                assert st[f"m{i}"]["resumable"] is True
+            inj.advance_clock(31)   # grace elapses, no wall waiting
+            ctl.reconcile_all()
+            st = statuses()
+            for i in range(4):
+                assert st[f"m{i}"]["phase"] == QUEUED, st
+                assert st[f"m{i}"]["reason"] == "PreemptedRequeued"
+                assert not st[f"m{i}"].get("fusedGang"), st
+            assert not gang.admitted(gkey)
+            ctl.reconcile_all()
+            assert statuses()["vip"]["phase"] == STARTING
+            for p in kube.list_pods(
+                    namespace,
+                    labels={"kubeflow-tpu.org/job-name": "vip"}):
+                store.set_pod_phase(namespace, p["metadata"]["name"],
+                                    "Succeeded")
+            ctl.reconcile_all()
+            ctl.reconcile_all()
+            st = statuses()
+            assert st["vip"]["phase"] == JOB_SUCCEEDED
+            for i in range(4):
+                assert st[f"m{i}"]["phase"] == STARTING, st
+                assert int(st[f"m{i}"]["preemptions"]) == 1
+            assert gang.admitted(gkey)
+            assert cluster.status()["counters"]["resumed"] == 4
+
+            # -- 3. one pod-gang success completes every member -------
+            for p in kube.list_pods(
+                    namespace,
+                    labels={"kubeflow-tpu.org/job-name": "fused-sweep"}):
+                store.set_pod_phase(namespace, p["metadata"]["name"],
+                                    "Succeeded")
+            ctl.reconcile_all()
+            st = statuses()
+            for i in range(4):
+                assert st[f"m{i}"]["phase"] == JOB_SUCCEEDED, st
+            assert not gang.admitted(gkey)
+            completed = [e for e in store.events
+                         if e["reason"] == "FusedMemberCompleted"]
+            assert len(completed) == 4, store.events
+
+            # -- 4. the members' TRAINING side of that lifecycle ------
+            mesh = MeshSpec(data=-1).build()
+            cfg = TransformerConfig(
+                vocab_size=64, d_model=16, n_layers=1, n_heads=2,
+                n_kv_heads=2, d_ff=32, head_dim=8, max_seq_len=16,
+                dtype="float32")
+            init_fn, loss_fn = lm_task(cfg, mesh=mesh)
+            batch = 2 * jax.device_count()
+            members = [MemberSpec(name=f"m{i}", seed=i,
+                                  lr=1e-3 * (i + 1),
+                                  tenant=f"tenant-{'ab'[i % 2]}",
+                                  stop_step=(2 if i == 1 else None))
+                       for i in range(4)]
+
+            def data_factory():
+                rng = np.random.RandomState(0)
+                while True:
+                    yield {"tokens": rng.randint(
+                        0, cfg.vocab_size,
+                        size=(batch, 16)).astype(np.int32)}
+
+            def fused_trainer(ckpt=None):
+                return FusedTrainer(
+                    init_fn=init_fn, loss_fn=loss_fn, members=members,
+                    mesh=mesh, checkpoint_dir=ckpt, checkpoint_every=1,
+                    metrics=MetricsLogger(stream=open("/dev/null",
+                                                      "w")))
+
+            def member_leaves(ft, state, i):
+                return [np.asarray(x) for x in
+                        jax.tree_util.tree_leaves(
+                            ft.member_state(state, i).params)]
+
+            control = fused_trainer()
+            s_control = control.fit(data_factory(), 6, log_every=0)
+            # Kill after 3 steps; m1 froze at its stop_step before the
+            # kill, so the resume must re-enter it MASKED.
+            victim = fused_trainer(ckpt=f"{tmp}/fused")
+            s_victim = victim.fit(data_factory(), 3, log_every=0)
+            cut = [int(victim.member_state(s_victim, i).step)
+                   for i in range(4)]
+            assert cut == [3, 2, 3, 3], cut
+            resumed = fused_trainer(ckpt=f"{tmp}/fused")
+            s_resumed = resumed.fit(data_factory(), 6, log_every=0)
+            steps = [int(resumed.member_state(s_resumed, i).step)
+                     for i in range(4)]
+            assert steps == [6, 2, 6, 6], steps
+            assert all(a >= b for a, b in zip(steps, cut)), (steps, cut)
+            for i in range(4):
+                got = member_leaves(resumed, s_resumed, i)
+                want = member_leaves(control, s_control, i)
+                assert len(got) == len(want)
+                for g, w in zip(got, want):
+                    assert np.array_equal(g, w), \
+                        f"member {i} diverged across preempt/resume"
+
+            # -- 5. fused-fit observability ---------------------------
+            parsed = parse_metrics(REGISTRY.render())
+            assert metric(parsed, "kft_train_member_steps_total",
+                          member="m0") \
+                - metric(before, "kft_train_member_steps_total",
+                         member="m0") >= 6
+            # Every member either completed num_steps or early-stopped
+            # — the active gauge must read 0 after the final fit.
+            assert sample_value(
+                parsed, "kft_train_members_active") == 0.0
+        finally:
+            if apiserver is not None:
+                apiserver.shutdown()
+                apiserver.server_close()
+
+
 def _kubectl(args, *, input_text: str = None, timeout: int = 300) -> str:
     import subprocess
 
@@ -2918,6 +3167,7 @@ COMMANDS = {
     "scheduler": scheduler_smoke,
     "train": train_smoke,
     "train_resilience": train_resilience_smoke,
+    "hfta": hfta_smoke,
     "deploy": deploy_real,
     "deploy-crds": deploy_crds,
     "tpujob-real": tpujob_real,

@@ -77,6 +77,10 @@ Hook sites planted in production code (grep for ``faults.fire``):
                       error path must contain it)
     scheduler.preempt each eviction wave the policy commits (before
                       victims are marked)
+    scheduler.fuse    each fused gang the fold pass forms from
+                      fusable queued singletons (scheduler/fuse.py;
+                      raise = wedged fold — contained like a wedged
+                      admission pass, members stay queued singletons)
     train.step        each Trainer.fit loop iteration, before the
                       dispatch (raise = step fault the supervisor
                       restarts from, skew = ages stall/backoff

@@ -135,7 +135,8 @@ def default_e2e(name: str = "e2e", namespace: str = "kubeflow-test",
 PLATFORM_STEPS = {
     "hermetic": ["tpujob", "scheduler", "serving", "engine", "faults",
                  "fleet", "survivable", "kv_spill", "multichip_serving",
-                 "adapter_serving", "train", "train_resilience"],
+                 "adapter_serving", "train", "train_resilience",
+                 "hfta"],
     "kind": ["deploy-crds", "tpujob-real"],
     "gke": ["deploy", "tpujob-real"],
 }

@@ -227,17 +227,20 @@ def cmd_queue_status(args: argparse.Namespace) -> int:
     if not jobs:
         print("queue empty: no live TPUJobs")
     else:
-        fmt = "{:<28} {:<12} {:<8} {:>10} {:>6} {:<20} {:>8}"
+        fmt = "{:<28} {:<12} {:<8} {:>10} {:>6} {:>7} {:<20} {:>8}"
         print(fmt.format("JOB", "TENANT", "PRIORITY", "SLICES",
-                         "CHIPS", "STATE", "WAIT_S"))
+                         "CHIPS", "MEMBERS", "STATE", "WAIT_S"))
         for row in jobs:
             wait = row.get("wait_s")
             state = row["state"]
             if row.get("resumable") and state not in ("Admitted",
                                                       "Preempting"):
                 state += "*"  # resumable: restarts from checkpoint
+            # A fused member's CHIPS is its billed SHARE of the gang
+            # slice (scheduler/fuse.py) — possibly fractional.
             print(fmt.format(row["job"], row["tenant"], row["priority"],
-                             row["slices"], int(row["chips"]), state,
+                             row["slices"], f"{row['chips']:g}",
+                             row.get("members") or "-", state,
                              f"{wait:.1f}" if wait is not None else "-"))
     for q in payload.get("quotas", []):
         print(f"quota {q['tenant']}/{q['slice_type']}: "
@@ -388,15 +391,8 @@ def _resume_step(rows):
     return max(candidates, default=None)
 
 
-def cmd_checkpoints_list(args: argparse.Namespace) -> int:
-    """Table of the checkpoint steps under a directory with their
-    verification verdicts — the on-disk analogue of ``queue status``
-    (what would restore_or_init pick, and why)."""
-    rows = _checkpoint_rows(args.directory)
-    if not rows:
-        print(f"no checkpoint steps under {args.directory}")
-        return 0
-    fmt = "{:>10} {:<10} {:>7} {:>9}  {}"
+def _print_checkpoint_table(rows, indent: str = "") -> None:
+    fmt = indent + "{:>10} {:<10} {:>7} {:>9}  {}"
     print(fmt.format("STEP", "STATUS", "FILES", "SIZE_MB", "DETAIL"))
     resume = _resume_step(rows)
     for step, status, reason, files, size in rows:
@@ -409,6 +405,46 @@ def cmd_checkpoints_list(args: argparse.Namespace) -> int:
                          files if files is not None else "-",
                          f"{size / 1e6:.1f}" if size is not None
                          else "-", detail))
+
+
+def _member_checkpoint_dirs(directory: str):
+    """(name, rows) per immediate subdirectory holding checkpoint
+    steps — the fused-gang layout (runtime/hfta.py saves member i
+    under ``<dir>/<member-name>/``)."""
+    try:
+        names = sorted(os.listdir(directory))
+    except OSError:
+        return []
+    members = []
+    for name in names:
+        sub = os.path.join(directory, name)
+        if not os.path.isdir(sub):
+            continue
+        rows = _checkpoint_rows(sub)
+        if rows:
+            members.append((name, rows))
+    return members
+
+
+def cmd_checkpoints_list(args: argparse.Namespace) -> int:
+    """Table of the checkpoint steps under a directory with their
+    verification verdicts — the on-disk analogue of ``queue status``
+    (what would restore_or_init pick, and why).  A fused-gang
+    directory (no steps at the root, per-member subdirectories from
+    runtime/hfta.py) renders one verdict table per member."""
+    rows = _checkpoint_rows(args.directory)
+    if rows:
+        _print_checkpoint_table(rows)
+        return 0
+    members = _member_checkpoint_dirs(args.directory)
+    if not members:
+        print(f"no checkpoint steps under {args.directory}")
+        return 0
+    for i, (name, member_rows) in enumerate(members):
+        if i:
+            print()
+        print(f"member {name}:")
+        _print_checkpoint_table(member_rows, indent="  ")
     return 0
 
 

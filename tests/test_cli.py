@@ -119,6 +119,11 @@ def test_queue_status_renders_scheduler_table(capsys):
              "detail": "tenant 'batch' at 16/16 chips of v5e-8",
              "position": 0, "wait_s": 12.5, "resumable": True,
              "preemptions": 1},
+            {"job": "kubeflow/sweep-3", "tenant": "batch",
+             "priority": "low", "slices": "1xv5e-8", "chips": 2.0,
+             "state": "Admitted", "detail": "", "position": None,
+             "wait_s": None, "resumable": False, "preemptions": 0,
+             "members": 4},
         ],
         "quotas": [{"tenant": "batch", "slice_type": "v5e-8",
                     "used_chips": 16, "quota_chips": 16}],
@@ -149,6 +154,15 @@ def test_queue_status_renders_scheduler_table(capsys):
         assert rc == 0
         out = capsys.readouterr().out
         assert "kubeflow/train-a" in out and "Admitted" in out
+        assert "MEMBERS" in out
+        # The fused member row bills its SHARE of the gang slice and
+        # shows the gang width; singletons render "-".
+        sweep = next(ln for ln in out.splitlines()
+                     if "kubeflow/sweep-3" in ln)
+        assert sweep.split()[4:6] == ["2", "4"]
+        solo = next(ln for ln in out.splitlines()
+                    if "kubeflow/train-a" in ln)
+        assert solo.split()[4:6] == ["16", "-"]
         # The resumable queued job is marked: it restarts from its
         # checkpoint, not step 0.
         assert "QuotaExceeded*" in out
@@ -470,3 +484,23 @@ def test_checkpoints_list_empty_dir(tmp_path, capsys):
     rc = cli.main(["checkpoints", "list", str(tmp_path)])
     assert rc == 0
     assert "no checkpoint steps" in capsys.readouterr().out
+
+
+def test_checkpoints_list_fused_member_layout(tmp_path, capsys):
+    """A fused-gang checkpoint root (runtime/hfta.py: per-member
+    subdirectories, no steps at the root) renders one verdict table
+    per member."""
+    import numpy as np
+
+    from kubeflow_tpu.runtime.checkpoint import CheckpointManager
+
+    root = tmp_path / "fused"
+    for name in ("m0", "m1"):
+        with CheckpointManager(root / name, max_to_keep=3) as mgr:
+            mgr.save(4, {"w": np.arange(4, dtype=np.float32)})
+    rc = cli.main(["checkpoints", "list", str(root)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "member m0:" in out and "member m1:" in out
+    assert out.count("resumes here") == 2
+    assert out.count("verified") == 2

@@ -22,6 +22,7 @@ from kubeflow_tpu.operator.reconciler import (
     TPUJobController,
 )
 from kubeflow_tpu.scheduler import (
+    LABEL_FUSE_FAMILY,
     LABEL_PRIORITY,
     LABEL_TENANT,
     ClusterScheduler,
@@ -30,7 +31,9 @@ from kubeflow_tpu.scheduler import (
     PreemptionRateLimiter,
     SchedulerConfig,
     SchedulingPolicy,
+    fuse,
     pick_victims,
+    tenant_shares,
 )
 from kubeflow_tpu.testing import faults
 
@@ -643,3 +646,140 @@ class TestSchedulerSnapshotLockDiscipline:
         sched.note_admitted("default/j0")
         sched.note_preempted("default/j0")
         assert guarded.bare_reads == []
+
+
+def fusable_cr(name, tenant="default", family="sweep",
+               priority="normal"):
+    cr = make_cr(name, tenant=tenant, priority=priority)
+    cr["metadata"]["labels"][LABEL_FUSE_FAMILY] = family
+    return cr
+
+
+class TestFusedGangs:
+    """Horizontal fusion (scheduler/fuse.py): fusable singleton swarms
+    fold into ONE gang claim whose quota/fair-share bill is split
+    per member tenant."""
+
+    def test_tenant_shares_bills_member_share_not_whole_gang(self):
+        """THE fair-share regression: before tenant_shares, every
+        member of an N-way fused gang was billed the gang's FULL chip
+        count, so a 4-member fuse charged each tenant 4x its real
+        footprint and starved them out of their own quota."""
+        solo = view("ns/solo")
+        assert tenant_shares(solo) == [("default", 8.0)]
+        members = [view(f"ns/m{i}", tenant=t) for i, t in
+                   enumerate(["a", "a", "b", "b"])]
+        for m in members:
+            m.family = "sweep"
+        plan_input, fused = fuse.fold_pending(members)
+        assert len(plan_input) == 1 and len(fused) == 1
+        shares = dict(tenant_shares(fused[0]))
+        assert shares == {"a": 2.0, "b": 2.0}
+
+    def test_fused_members_admit_within_quota_where_singletons_not(
+            self, cluster):
+        """greedy's 16-chip quota fits two 8-chip singletons — but all
+        FOUR fusable singletons fused onto one slice (2 chips each)."""
+        kube, gang, sched, ctl = cluster
+        for i in range(4):
+            kube.create_custom(fusable_cr(f"g{i}", tenant="greedy"))
+        ctl.reconcile_all()
+        st = phases_by_name(kube)
+        assert all(st[f"g{i}"]["phase"] == STARTING for i in range(4))
+        assert all(st[f"g{i}"]["fusedGang"] == "fused:kubeflow/sweep"
+                   for i in range(4))
+        assert gang.admitted("fused:kubeflow/sweep")
+        # One shared pod gang under the fused workload name.
+        assert kube.list_pods(
+            "kubeflow",
+            labels={"kubeflow-tpu.org/job-name": "fused-sweep"})
+        quotas = {q["tenant"]: q["used_chips"]
+                  for q in sched.status()["quotas"]}
+        assert quotas["greedy"] == 8.0   # 4 members x 2 chips, not 32
+
+    def test_status_rows_show_members_and_billed_share(self, cluster):
+        kube, gang, sched, ctl = cluster
+        for i in range(4):
+            kube.create_custom(fusable_cr(f"g{i}", tenant="greedy"))
+        ctl.reconcile_all()
+        rows = {r["job"]: r for r in sched.status()["jobs"]}
+        for i in range(4):
+            row = rows[f"kubeflow/g{i}"]
+            assert row["members"] == 4
+            assert row["chips"] == 2.0
+
+    def test_below_min_members_and_multislice_stay_singletons(self,
+                                                              cluster):
+        kube, gang, sched, ctl = cluster
+        kube.create_custom(fusable_cr("only"))
+        multi = make_cr("wide", num_slices=2)
+        multi["metadata"]["labels"][LABEL_FUSE_FAMILY] = "sweep"
+        kube.create_custom(multi)
+        ctl.reconcile_all()
+        st = phases_by_name(kube)
+        assert not gang.admitted("fused:kubeflow/sweep")
+        assert "fusedGang" not in st["only"]
+        assert "fusedGang" not in st["wide"]
+
+    def test_fused_gang_preempted_resumes_with_members(self):
+        """vip evicts the fused gang; every member requeues resumable
+        and the gang re-folds + resumes once vip completes."""
+        kube = FakeKube()
+        gang = GangScheduler({"v5e-8": 1})
+        sched = ClusterScheduler(gang, SchedulerConfig(
+            preemption=PreemptionConfig(grace_period_s=5.0)))
+        ctl = TPUJobController(kube, gang, sched)
+        with faults.injected("seed=1") as inj:
+            for i in range(4):
+                kube.create_custom(fusable_cr(f"m{i}", priority="low"))
+            ctl.reconcile_all()
+            assert gang.admitted("fused:kubeflow/sweep")
+            kube.create_custom(make_cr("vip", priority="high"))
+            ctl.reconcile_all()
+            st = phases_by_name(kube)
+            assert all(st[f"m{i}"]["phase"] == JOB_PREEMPTING
+                       for i in range(4))
+            inj.advance_clock(10)
+            ctl.reconcile_all()
+            st = phases_by_name(kube)
+            for i in range(4):
+                assert st[f"m{i}"]["phase"] == QUEUED
+                assert st[f"m{i}"]["resumable"] is True
+                assert st[f"m{i}"]["preemptions"] == 1
+                assert not st[f"m{i}"]["fusedGang"]
+            assert not gang.admitted("fused:kubeflow/sweep")
+            ctl.reconcile_all()
+            assert phases_by_name(kube)["vip"]["phase"] == STARTING
+            for p in kube.list_pods(
+                    "kubeflow",
+                    labels={"kubeflow-tpu.org/job-name": "vip"}):
+                kube.set_pod_phase("kubeflow", p["metadata"]["name"],
+                                   SUCCEEDED)
+            ctl.reconcile_all()
+            ctl.reconcile_all()
+            st = phases_by_name(kube)
+            assert all(st[f"m{i}"]["phase"] == STARTING
+                       for i in range(4))
+            assert gang.admitted("fused:kubeflow/sweep")
+            # Resume consumed each member's flag individually.
+            assert sched.status()["counters"]["resumed"] == 4
+
+    def test_fused_gang_completion_releases_claim_per_member(self,
+                                                             cluster):
+        kube, gang, sched, ctl = cluster
+        for i in range(3):
+            kube.create_custom(fusable_cr(f"m{i}"))
+        ctl.reconcile_all()
+        for p in kube.list_pods(
+                "kubeflow",
+                labels={"kubeflow-tpu.org/job-name": "fused-sweep"}):
+            kube.set_pod_phase("kubeflow", p["metadata"]["name"],
+                               SUCCEEDED)
+        ctl.reconcile_all()
+        st = phases_by_name(kube)
+        assert all(st[f"m{i}"]["phase"] == "Succeeded"
+                   for i in range(3))
+        assert not gang.admitted("fused:kubeflow/sweep")
+        completed = [e for e in kube.events
+                     if e["reason"] == "FusedMemberCompleted"]
+        assert len(completed) == 3

@@ -9,6 +9,7 @@ from kubeflow_tpu.testing.e2e import (
     engine_smoke,
     fault_injection_smoke,
     fleet_smoke,
+    hfta_smoke,
     kv_spill_smoke,
     multichip_serving_smoke,
     scheduler_smoke,
@@ -167,6 +168,19 @@ class TestE2EDrivers:
         # kft_checkpoint_* metric deltas asserted (see
         # kubeflow_tpu/testing/e2e.py train_resilience_smoke).
         train_resilience_smoke()
+
+    def test_hfta_smoke(self):
+        # The ci/e2e_config.yaml hermetic `hfta` step: two tenants'
+        # four fusable singleton TPUJobs fold into ONE fused gang
+        # (fair-share chip billing inside a quota no singleton could
+        # enter), survive a high-priority preemption with every
+        # member requeued resumable and resumed, complete per member
+        # on pod-gang success; plus the runtime side — a width-4
+        # FusedTrainer with one early-stopped masked member killed
+        # mid-run resumes from per-member verified checkpoints with
+        # steps monotone and params bit-identical to an uninterrupted
+        # control (see kubeflow_tpu/testing/e2e.py hfta_smoke).
+        hfta_smoke()
 
 
 class _FakeKubectl:
