@@ -3065,6 +3065,360 @@ def bench_hfta(args, devices, n_chips, on_tpu):
     }
 
 
+def bench_colocation(args, devices, n_chips, on_tpu):
+    """Elastic train/serve colocation (scheduler/colocate.py, user
+    guide §5.13): one simulated diurnal cycle on ONE shared chip pool,
+    beside the static split-pool baseline it replaces.
+
+    The control plane is real — FakeKube + ClusterScheduler +
+    TPUJobController + the fleet Autoscaler in claims mode — on an
+    injected clock, so an 8 h phase costs microseconds of wall time.
+    The morning burst writes a 2-replica serving claim that evicts the
+    low-priority training gang on the SHORT serving grace; the evening
+    trough releases the chips and training backfills.  Reported:
+
+      * combined-pool utilization across the 24 h cycle (chip-seconds
+        used / capacity), beside the static-partition counterfactual
+        computed from the SAME demand curve — the split pool strands
+        its serving half all night (acceptance: >= 0.85 colocated);
+      * claim-grant latency in simulated seconds (dominated by the
+        serving grace window the victim drains under) plus the wall
+        cost of the whole control-plane transition;
+      * bit-identity: the evicted job, resumed from its verified
+        checkpoint, must FINISH with params identical to an
+        uninterrupted control run — or the "elastic" story is silently
+        corrupting training;
+      * burst-phase serving p50/p99 from a closed-loop burst with
+        deadline_ms on every request — the shed/deadline contract is
+        zero 429/504 and p99 under the deadline.
+
+    On CPU the serving latencies measure a compute-bound host, not TPU
+    decode; cpu_compute_bound_note marks the record.
+    """
+    import http.client
+    import json as _json
+    import tempfile
+    import threading
+
+    import jax
+    import numpy as np
+
+    from kubeflow_tpu.fleet.autoscaler import Autoscaler
+    from kubeflow_tpu.models.transformer import Transformer
+    from kubeflow_tpu.operator import crd
+    from kubeflow_tpu.operator.gang import GangScheduler
+    from kubeflow_tpu.operator.kube import FakeKube
+    from kubeflow_tpu.operator.reconciler import TPUJobController
+    from kubeflow_tpu.runtime.checkpoint import CheckpointManager
+    from kubeflow_tpu.scheduler import (
+        LABEL_PRIORITY,
+        LABEL_TENANT,
+        ClusterScheduler,
+        PreemptionConfig,
+        SchedulerConfig,
+        colocate,
+    )
+    from kubeflow_tpu.serving.export import export
+    from kubeflow_tpu.serving.http import make_http_server
+    from kubeflow_tpu.serving.loaders import _model_config
+    from kubeflow_tpu.serving.main import batcher_factory
+    from kubeflow_tpu.serving.model_server import ModelServer
+    from kubeflow_tpu.testing import faults
+
+    ns = "bench"
+    slices, chips_per_slice = 4, 8
+    cap = slices * chips_per_slice
+    phase_s = 8 * 3600.0   # trough / burst / trough: a 24 h cycle
+    drain_s = 6.0          # past the 5 s serving grace
+    if on_tpu:
+        overrides = {
+            "vocab_size": 32_000, "d_model": 1024, "n_layers": 12,
+            "n_heads": 8, "n_kv_heads": 8, "d_ff": 2816,
+            "head_dim": 128, "max_seq_len": 2048, "dtype": "bfloat16",
+        }
+        max_new, prompt_len, slots_n = 64, 64, 8
+        burst_requests, clients, deadline_ms = 48, 8, 10_000.0
+    else:
+        overrides = {
+            "vocab_size": 256, "d_model": 64, "n_layers": 2,
+            "n_heads": 4, "n_kv_heads": 4, "d_ff": 128, "head_dim": 16,
+            "max_seq_len": 128, "dtype": "float32",
+        }
+        max_new, prompt_len, slots_n = 16, 8, 4
+        burst_requests, clients, deadline_ms = 24, 4, 30_000.0
+    print(f"bench: colocation diurnal cycle, pool {cap} chips, "
+          f"{burst_requests}-request serving burst, "
+          f"{devices[0].device_kind}", file=sys.stderr)
+
+    total_steps, evict_after = 9, 5
+
+    def train_step(w, step):
+        # Any reordering/precision drift between the control run and
+        # the resumed run breaks exact equality.
+        return w * np.float32(1.0 + 2.0 ** -10) + np.float32(step)
+
+    def train_cr(name, priority, n):
+        job = crd.TPUJobSpec(name=name, namespace=ns, num_slices=n)
+        cr = job.to_custom_resource()
+        cr["metadata"]["labels"] = {LABEL_TENANT: "research",
+                                    LABEL_PRIORITY: priority}
+        return cr
+
+    class _Load:
+        """Registry stand-in scripting the diurnal curve."""
+
+        load = 0.0
+
+        def total_load(self):
+            return self.load
+
+        def ready_count(self):
+            return 1
+
+    # Demand curve (chips wanted per phase): training always wants the
+    # whole pool; serving wants 2 replicas (16 chips) during the burst.
+    # The static-partition counterfactual reserves half the pool per
+    # side and can never trade — that is the number colocation exists
+    # to beat.
+    half = cap // 2
+    static_segments = [(phase_s, min(cap, half) + 0),
+                       (phase_s, min(cap, half) + min(
+                           2 * chips_per_slice, half)),
+                       (phase_s, min(cap, half) + 0)]
+
+    segments = []   # (sim_seconds, used_chips) — the colocated pool
+    base = np.arange(8, dtype=np.float32)
+    with faults.injected("seed=20260807") as inj, \
+            tempfile.TemporaryDirectory() as tmp:
+        kube = FakeKube()
+        kube.create_deployment({
+            "metadata": {"name": "lm", "namespace": ns},
+            "spec": {"replicas": 0}})
+        gang = GangScheduler({"v5e-8": slices})
+        cluster = ClusterScheduler(gang, SchedulerConfig(
+            preemption=PreemptionConfig(
+                grace_period_s=30.0, serving_grace_period_s=5.0)))
+        ctl = TPUJobController(kube, gang, cluster)
+        load = _Load()
+        claims = colocate.ServingClaimClient(kube, ns, "lm")
+        scaler = Autoscaler(
+            kube, ns, "lm", load, target_inflight_per_replica=4.0,
+            min_replicas=0, max_replicas=4,
+            scale_up_cooldown_s=10.0, scale_down_cooldown_s=60.0,
+            claims=claims)
+
+        def job_statuses():
+            return {c["metadata"]["name"]: (c.get("status") or {})
+                    for c in kube.list_custom(ns)}
+
+        # -- night trough: training owns the whole pool ---------------
+        scaler.reconcile_once()
+        kube.create_custom(train_cr("night-batch", "low", 2))
+        kube.create_custom(train_cr("steady", "normal", 2))
+        ctl.reconcile_all()
+        w = base.copy()
+        with CheckpointManager(f"{tmp}/ckpt",
+                               save_interval_steps=1) as mgr:
+            for step in range(evict_after):
+                w = train_step(w, step)
+                mgr.save(step, {"step": np.full((), step, np.int32),
+                                "w": w})
+        for i, p in enumerate(kube.list_pods(
+                ns, labels={"kubeflow-tpu.org/job-name":
+                            "night-batch"})):
+            kube.set_pod_node(ns, p["metadata"]["name"], f"node-{i}")
+        segments.append((phase_s, cluster.pool_status()["used_chips"]))
+        inj.advance_clock(phase_s)
+
+        # -- morning burst: the claim steals chips --------------------
+        wall0 = time.perf_counter()
+        load.load = 8.0   # ceil(8/4) = 2 replicas wanted
+        scaler.reconcile_once()   # writes the 2-replica claim CR
+        ctl.reconcile_all()       # victim drains; prepull pods pin up
+        prepulls = len(kube.list_pods(
+            ns, labels={colocate.LABEL_WORKLOAD:
+                        colocate.WORKLOAD_PREPULL}))
+        # The victim holds its chips through the SHORT drain window
+        # (the 30 s training grace would still be holding it at 6 s).
+        segments.append((drain_s,
+                         cluster.pool_status()["used_chips"]))
+        inj.advance_clock(drain_s)
+        granted = False
+        for _ in range(6):
+            ctl.reconcile_all()
+            claim_st = job_statuses().get(
+                colocate.claim_name("lm"), {})
+            if claim_st.get("grantedReplicas") == 2:
+                granted = True
+                break
+        wall_grant_ms = (time.perf_counter() - wall0) * 1e3
+        assert granted, f"claim never granted: {job_statuses()}"
+        assert kube.get_deployment(
+            ns, "lm")["spec"]["replicas"] == 2
+        pool = cluster.pool_status()
+        serving_chips = pool["serving_chips"]
+        segments.append((phase_s - drain_s, pool["used_chips"]))
+
+        # -- burst-phase serving latency: the shed/deadline contract --
+        cfg = _model_config(overrides)
+        model = Transformer(cfg)
+        rng = np.random.RandomState(0)
+        variables = model.init(jax.random.key(0),
+                               np.zeros((1, prompt_len), np.int32))
+        prompt = rng.randint(1, cfg.vocab_size,
+                             size=(prompt_len,)).tolist()
+        body = _json.dumps({
+            "deadline_ms": deadline_ms,
+            "instances": [{"tokens": prompt}]}).encode()
+        export(f"{tmp}/lm-model", 1, variables,
+               loader="kubeflow_tpu.serving.loaders:lm_generate",
+               config={"model": overrides, "max_new_tokens": max_new,
+                       "temperature": 0.0})
+        httpd = None
+        server = None
+        try:
+            server = ModelServer()
+            server.add_model("lm", f"{tmp}/lm-model")
+            server.enable_batching("lm", batcher_factory(
+                micro_batch_size=0, batch_timeout_s=0.005,
+                lm_engine=True, lm_engine_slots=slots_n,
+                lm_engine_prefill_len=prompt_len))
+            httpd, _ = make_http_server(server, port=0,
+                                        host="127.0.0.1")
+            port = httpd.server_address[1]
+
+            def one(conn):
+                conn.request("POST", "/model/lm:predict", body=body)
+                resp = conn.getresponse()
+                resp.read()
+                return resp.status
+
+            lock = threading.Lock()
+            work = list(range(burst_requests))
+            outcomes = []
+
+            def client_loop():
+                conn = http.client.HTTPConnection(
+                    "127.0.0.1", port, timeout=600)
+                try:
+                    while True:
+                        with lock:
+                            if not work:
+                                return
+                            work.pop()
+                        t0 = time.perf_counter()
+                        try:
+                            status = one(conn)
+                        except Exception:  # noqa: BLE001 — recorded
+                            outcomes.append((0, 0.0))
+                            conn.close()
+                            conn = http.client.HTTPConnection(
+                                "127.0.0.1", port, timeout=600)
+                            continue
+                        outcomes.append(
+                            (status, time.perf_counter() - t0))
+                finally:
+                    conn.close()
+
+            warm = http.client.HTTPConnection("127.0.0.1", port,
+                                              timeout=600)
+            assert one(warm) == 200  # compile outside the timed burst
+            warm.close()
+            threads = [threading.Thread(target=client_loop)
+                       for _ in range(clients)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        finally:
+            if httpd is not None:
+                httpd.shutdown()
+                httpd.server_close()
+            if server is not None:
+                server.stop()
+        lat = sorted(s for code, s in outcomes if code == 200)
+        sheds = sum(1 for code, _ in outcomes if code == 429)
+        expired = sum(1 for code, _ in outcomes if code == 504)
+        errors = sum(1 for code, _ in outcomes
+                     if code not in (200, 429, 504))
+        p50 = lat[len(lat) // 2] if lat else 0.0
+        p99 = lat[min(len(lat) - 1,
+                      int(0.99 * len(lat)))] if lat else 0.0
+        contract_ok = bool(lat and sheds == 0 and expired == 0
+                           and errors == 0
+                           and p99 * 1e3 <= deadline_ms)
+        inj.advance_clock(phase_s - drain_s)
+
+        # -- evening trough: release, backfill, bit-identical resume --
+        load.load = 0.0
+        scaler.reconcile_once()   # deletes the claim, zeroes replicas
+        ctl.reconcile_all()       # stale sweep frees the gang claim
+        ctl.reconcile_all()       # backfill re-admits the victim
+        victim = job_statuses().get("night-batch", {})
+        victim_restarts = int(victim.get("restarts", 0) or 0)
+        victim_preemptions = int(victim.get("preemptions", 0) or 0)
+        segments.append((phase_s,
+                         cluster.pool_status()["used_chips"]))
+        fresh = {"step": np.zeros((), np.int32),
+                 "w": np.zeros(8, np.float32)}
+        with CheckpointManager(f"{tmp}/ckpt") as mgr2:
+            restored, start = mgr2.restore_or_init(fresh)
+        resumed = restored["w"]
+        for step in range(start, total_steps):
+            resumed = train_step(resumed, step)
+        control = base.copy()
+        for step in range(total_steps):
+            control = train_step(control, step)
+        bit_identical = bool(start == evict_after
+                             and np.array_equal(resumed, control))
+        claims.close()
+
+    total_s = sum(d for d, _ in segments)
+    util = sum(d * u for d, u in segments) / (cap * total_s)
+    static_total = sum(d for d, _ in static_segments)
+    static_util = sum(d * u for d, u in static_segments) \
+        / (cap * static_total)
+    print(f"colocation: pool util {util:.3f} colocated vs "
+          f"{static_util:.3f} static split, claim grant "
+          f"{drain_s:.1f}s sim ({wall_grant_ms:.0f}ms wall), "
+          f"resume bit-identical={bit_identical}, burst p50 "
+          f"{p50 * 1e3:.1f}ms p99 {p99 * 1e3:.1f}ms (sheds={sheds}, "
+          f"expired={expired})", file=sys.stderr)
+    return {
+        "metric": "colocation_pool_utilization",
+        "value": round(util, 4),
+        "unit": "chip-seconds used / capacity, 24h diurnal cycle",
+        "vs_baseline": round(util / max(static_util, 1e-9), 3),
+        "detail": {
+            "device": devices[0].device_kind,
+            "combined_pool_utilization": round(util, 4),
+            "static_partition_utilization": round(static_util, 4),
+            "utilization_target": ">= 0.85 colocated",
+            "utilization_ok": bool(util >= 0.85),
+            "pool_capacity_chips": cap,
+            "burst_serving_chips": serving_chips,
+            "claim_grant_latency_s_simulated": round(drain_s, 1),
+            "claim_grant_note": "dominated by the 5s serving grace "
+                                "the victim drains under",
+            "claim_grant_control_wall_ms": round(wall_grant_ms, 1),
+            "prepull_pods_during_drain": prepulls,
+            "victim_restarts": victim_restarts,
+            "victim_preemptions": victim_preemptions,
+            "resume_bit_identical": bit_identical,
+            "burst_requests": burst_requests,
+            "clients": clients,
+            "deadline_ms": deadline_ms,
+            "burst_serving_p50_ms": round(p50 * 1e3, 2),
+            "burst_serving_p99_ms": round(p99 * 1e3, 2),
+            "burst_sheds_429": sheds,
+            "burst_deadline_expired_504": expired,
+            "burst_transport_errors": errors,
+            "shed_deadline_contract_ok": contract_ok,
+            **({} if on_tpu else {"cpu_compute_bound_note": True}),
+        },
+    }
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--model",
@@ -3287,6 +3641,13 @@ def main() -> None:
                 result["detail"]["hfta"] = hf["detail"]
         except Exception as e:
             print(f"hfta sub-benchmark failed: {e}", file=sys.stderr)
+        try:
+            if not over_budget("colocation"):
+                co = bench_colocation(args, devices, n_chips, on_tpu)
+                result["detail"]["colocation"] = co["detail"]
+        except Exception as e:
+            print(f"colocation sub-benchmark failed: {e}",
+                  file=sys.stderr)
         if skipped:
             result["detail"]["skipped_sub_benches"] = skipped
     emit(result)
@@ -3343,6 +3704,10 @@ def headline_summary(result: dict,
             "data_native_examples_per_sec":
                 pick("data", "pipeline_native_examples_per_sec"),
             "data_native_vs_python": pick("data", "native_vs_python_ratio"),
+            "colocation_pool_utilization":
+                pick("colocation", "combined_pool_utilization"),
+            "colocation_burst_p99_ms":
+                pick("colocation", "burst_serving_p99_ms"),
             "skipped_sub_benches": d.get("skipped_sub_benches", []),
             "full_results": full_results,
         },

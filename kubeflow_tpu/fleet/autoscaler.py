@@ -56,13 +56,20 @@ class Autoscaler:
                  min_replicas: int = 1,
                  max_replicas: int = 8,
                  scale_up_cooldown_s: float = 10.0,
-                 scale_down_cooldown_s: float = 60.0):
+                 scale_down_cooldown_s: float = 60.0,
+                 claims: Any = None):
         if target_inflight_per_replica <= 0:
             raise ValueError("target_inflight_per_replica must be > 0")
         self._kube = kube
         self._namespace = namespace
         self._deployment = deployment
         self._registry = registry
+        # Colocation mode (scheduler/colocate.py): a ServingClaimClient
+        # translates the desired count into a claim on the shared chip
+        # pool; the cluster reconciler patches spec.replicas on grant.
+        # None = legacy direct-patch path (--no-colocation).
+        self._claims = claims
+        self._last_claim_desired: Optional[int] = None
         self.target = float(target_inflight_per_replica)
         self.tolerance = float(tolerance)
         self.min_replicas = max(0, int(min_replicas))
@@ -89,7 +96,28 @@ class Autoscaler:
             .get("spec", {}).get("replicas", 0))
         desired = self._decide(load, current, now)
         applied = False
-        if desired != current:
+        claim = None
+        if self._claims is not None:
+            # Colocation: desire goes into the claim CR, never onto
+            # spec.replicas — the arbiter's reconciler patches that on
+            # grant.  Synced every pass (level-triggered, idempotent)
+            # so the verdict and pool snapshot stay fresh; a scale
+            # EVENT is only the desired count actually changing.
+            changed = desired != self._last_claim_desired
+            claim = self._claims.sync(desired)
+            self._last_claim_desired = desired
+            if changed and desired != current:
+                self._last_scale_t = now
+                applied = True
+                direction = "up" if desired > current else "down"
+                REGISTRY.counter(
+                    SCALE_EVENTS_TOTAL,
+                    SCALE_EVENTS_HELP).inc(direction=direction)
+                log.info("claimed %s/%s %d -> %d replicas (load %.1f, "
+                         "state %s)", self._namespace,
+                         self._deployment, current, desired, load,
+                         claim.get("state"))
+        elif desired != current:
             self._kube.patch_deployment_scale(
                 self._namespace, self._deployment, desired)
             self._last_scale_t = now
@@ -103,8 +131,11 @@ class Autoscaler:
         REGISTRY.gauge(DESIRED_GAUGE, DESIRED_HELP).set(desired)
         REGISTRY.gauge(OBSERVED_GAUGE, OBSERVED_HELP).set(load)
         REGISTRY.gauge(READY_GAUGE, READY_HELP).set(ready)
-        return {"load": load, "ready": ready, "current": current,
-                "desired": desired, "applied": applied}
+        record = {"load": load, "ready": ready, "current": current,
+                  "desired": desired, "applied": applied}
+        if claim is not None:
+            record["claim"] = claim
+        return record
 
     def _decide(self, load: float, current: int, now: float) -> int:
         raw = math.ceil(load / self.target) if load > 0 else \

@@ -93,6 +93,23 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--scale_down_cooldown_s", type=float,
                     default=60.0)
     ap.add_argument("--autoscale_interval_s", type=float, default=2.0)
+    ap.add_argument("--no-colocation", dest="no_colocation",
+                    action="store_true",
+                    help="legacy direct spec.replicas patching: scale "
+                         "without claiming chips from the shared "
+                         "train/serve pool arbiter")
+    ap.add_argument("--claim_slice_type", default="v5e-8",
+                    help="slice shape one serving replica occupies in "
+                         "the shared pool (colocation mode)")
+    ap.add_argument("--claim_tenant", default="fleet",
+                    help="tenant the serving claim bills")
+    ap.add_argument("--claim_priority", default="high",
+                    help="priority class of the serving claim — must "
+                         "outrank preemptible training to steal chips "
+                         "under load")
+    ap.add_argument("--claim_image", default="",
+                    help="serving image prepull pods warm on freeing "
+                         "nodes (empty = the colocate default)")
     ap.add_argument("--drain_deadline_s", type=float, default=30.0)
     from kubeflow_tpu.runtime import tracing
 
@@ -133,11 +150,29 @@ def main(argv=None) -> int:
         eject_backoff_cap_s=args.eject_backoff_cap_s)
     registry.refresh()
     registry.start()
+    claims = None
+    if args.autoscale_deployment and not args.no_colocation:
+        from kubeflow_tpu.scheduler.colocate import ServingClaimClient
+
+        if kube is None:
+            from kubeflow_tpu.operator.kube_http import HttpKube
+
+            kube = HttpKube(base_url=args.kube_base_url or None)
+        claim_kwargs = dict(
+            slice_type=args.claim_slice_type,
+            tenant=args.claim_tenant,
+            priority=args.claim_priority)
+        if args.claim_image:
+            claim_kwargs["image"] = args.claim_image
+        claims = ServingClaimClient(
+            kube, args.kube_namespace, args.autoscale_deployment,
+            **claim_kwargs)
     router = FleetRouter(
         registry, max_tries=args.max_tries,
         try_timeout_s=args.try_timeout_s,
         retry_budget_ratio=args.retry_budget_ratio,
-        max_replays=args.max_replays)
+        max_replays=args.max_replays,
+        pool_status=claims.pool if claims is not None else None)
     httpd, _ = make_router_server(router, port=args.port,
                                   host=args.host)
     autoscaler = None
@@ -154,7 +189,8 @@ def main(argv=None) -> int:
             min_replicas=args.min_replicas,
             max_replicas=args.max_replicas,
             scale_up_cooldown_s=args.scale_up_cooldown_s,
-            scale_down_cooldown_s=args.scale_down_cooldown_s)
+            scale_down_cooldown_s=args.scale_down_cooldown_s,
+            claims=claims)
         autoscaler.start(args.autoscale_interval_s)
     logging.info("fleet router on :%d (%d endpoints discovered%s)",
                  httpd.server_address[1], len(registry.all()),

@@ -226,8 +226,13 @@ class FleetRouter:
                  retry_budget_ratio: float = 0.2,
                  retry_budget_cap: float = 10.0,
                  max_replays: int = 2,
-                 rng: Optional[random.Random] = None):
+                 rng: Optional[random.Random] = None,
+                 pool_status=None):
         self.registry = registry
+        # Optional zero-arg callable returning the shared chip pool's
+        # accounting (train/serve colocation): surfaced verbatim on
+        # /fleet/endpoints for the `fleet status` footer.
+        self.pool_status = pool_status
         self.max_tries = max(1, int(max_tries))
         # Per-request replay cap: transport failures AFTER bytes
         # reached a replica may re-execute at most this many times on
@@ -1230,11 +1235,19 @@ class _Handler(BaseHTTPRequestHandler):
         if self.path == "/fleet/endpoints":
             # Endpoint table plus the router-wide failover budget —
             # the `kubeflow-tpu fleet status` payload.
-            self._respond(200, {}, json.dumps({
+            payload = {
                 "endpoints": router.registry.describe(),
                 "retry_budget": router.budget.snapshot(),
                 "max_replays": router.max_replays,
-            }).encode())
+            }
+            if router.pool_status is not None:
+                try:
+                    pool = router.pool_status()
+                except Exception:
+                    pool = None
+                if pool:
+                    payload["pool"] = pool
+            self._respond(200, {}, json.dumps(payload).encode())
             return
         if self.path == "/debug/traces":
             # Tail-sampled request traces (router root + forward

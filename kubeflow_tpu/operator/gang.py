@@ -79,6 +79,34 @@ class GangScheduler:
         with self._lock:
             return job in self.claims
 
+    def claim_count(self, job: str) -> int:
+        """Slices held by ``job``'s live claim (0 when not admitted)."""
+        with self._lock:
+            claim = self.claims.get(job)
+            return claim.count if claim else 0
+
+    def resize(self, job: str, count: int) -> bool:
+        """Grow or shrink an existing claim in place (elastic serving
+        claims — scheduler/colocate.py).  Atomic like ``offer``: a grow
+        succeeds only when the delta fits the free pool right now;
+        callers route non-fitting grows through the policy plan (which
+        may preempt) instead of retrying here.  A shrink always
+        succeeds and immediately re-drains the FIFO so released slices
+        backfill pending gangs in the same pass."""
+        if count < 1:
+            raise ValueError("resize to < 1 slice; use release()")
+        with self._lock:
+            claim = self.claims.get(job)
+            if claim is None:
+                return False
+            delta = count - claim.count
+            if delta > 0 and self.free(claim.slice_type) < delta:
+                return False
+            claim.count = count
+            if delta < 0:
+                self._drain_locked()
+            return True
+
     def unsatisfiable(self, job: str) -> bool:
         """True if the job's demand exceeds TOTAL capacity — it can never
         be admitted no matter what finishes.  The reconciler consumes this

@@ -53,7 +53,7 @@ from kubeflow_tpu.operator.kube import (
     NotFound,
 )
 from kubeflow_tpu.runtime import bootstrap, tracing
-from kubeflow_tpu.scheduler import fuse
+from kubeflow_tpu.scheduler import colocate, fuse
 from kubeflow_tpu.testing import faults
 
 log = logging.getLogger(__name__)
@@ -196,6 +196,14 @@ class TPUJobController:
         self._admitted_at: Dict[str, float] = {}
         # Preemption grace deadlines on the policy clock, keyed by job.
         self._preempt_deadline: Dict[str, float] = {}
+        # Serving claims seen (scheduler/colocate.py): their gang
+        # claims are released when the CR vanishes — scale-to-zero
+        # deletes the claim CR outright instead of resizing to 0.
+        self._serving_claims: set = set()
+        # Live speculative-placement pods by claim key ->
+        # {(namespace, pod name)}; retired once the claim is fully
+        # granted or gone.
+        self._prepull: Dict[str, set] = {}
         # Job-lifecycle traces (runtime/tracing.py): one trace per job,
         # a span per phase dwelled in, the root stamped at the terminal
         # transition (tail sampling then always keeps Failed jobs).
@@ -220,9 +228,11 @@ class TPUJobController:
                if cr.get("kind") == crd.KIND]
         decisions: dict = {}
         order: Dict[str, int] = {}
+        plan_obj = None
         if self.cluster is not None:
             try:
                 plan = self.cluster.plan(crs)
+                plan_obj = plan
                 decisions = plan.decisions
                 order = {key: i for i, key in enumerate(plan.order)}
             except Exception:
@@ -246,6 +256,15 @@ class TPUJobController:
         if order:
             crs.sort(key=lambda cr: order.get(cr_key(cr), len(order)))
 
+        if plan_obj is not None:
+            # Speculative placement: pin prepull pods to the nodes the
+            # plan just decided will free, in the SAME sweep that
+            # starts the victims' drains.
+            try:
+                self._sync_prepull(plan_obj, crs, cr_key)
+            except Exception:
+                log.exception("prepull sync failed")
+
         phases: dict = {}
         for cr_obj in crs:
             try:
@@ -268,6 +287,16 @@ class TPUJobController:
             "kft_operator_reconcile_passes_total",
             "full reconcile sweeps over all TPUJobs",
         ).inc()
+        # Serving claims whose CR vanished (scale-to-zero, kubectl
+        # delete): release their chips so pending training backfills
+        # this sweep, not next.
+        live_claim_keys = {cr_key(cr) for cr in crs}
+        for skey in [k for k in self._serving_claims
+                     if k not in live_claim_keys]:
+            self.scheduler.release(skey)
+            self._serving_claims.discard(skey)
+            if self.cluster is not None:
+                self.cluster.forget(skey)
         # Trace state of jobs whose CR vanished pre-terminal (kubectl
         # delete mid-run) would otherwise accumulate forever — no
         # terminal transition will ever prune them.  Keys come from
@@ -312,6 +341,15 @@ class TPUJobController:
             if self.cluster is not None:
                 self.cluster.forget(key)
             return phase
+
+        # Serving claims (scheduler/colocate.py): the fleet
+        # autoscaler's desired-replica count riding the TPUJob shape.
+        # No pods or service — the grant is a gang claim plus a
+        # Deployment scale patch; the serving replicas themselves live
+        # under the Deployment.
+        if colocate.is_serving_claim_cr(cr_obj):
+            return self._reconcile_serving_claim(
+                cr_obj, job, status, phase, key, decision)
 
         # Fused members (scheduler/fuse.py): the plan mirrored the
         # gang's verdict onto this member key; one shared pod gang is
@@ -570,6 +608,180 @@ class TPUJobController:
                             extra={"restarts": restarts})
         return STARTING
 
+    # -- serving claims (train/serve colocation) ---------------------------
+
+    def _reconcile_serving_claim(self, cr_obj: dict,
+                                 job: crd.TPUJobSpec, status: dict,
+                                 phase: str, key: str,
+                                 decision) -> str:
+        """Drive one ServingClaim CR through the shared-pool arbiter.
+
+        Grants and grows go through the plan verdict (which may have
+        preempted training to make room); shrinks release in place
+        with no arbitration — freed slices backfill pending training
+        the same sweep (``GangScheduler.resize`` re-drains the FIFO).
+        The granted count is patched onto the Deployment's
+        ``spec.replicas`` HERE, keeping every chip movement inside the
+        reconcile loop; the autoscaler only ever writes desire into
+        the claim CR.
+        """
+        desired = job.num_slices
+        labels = (cr_obj.get("metadata") or {}).get("labels") or {}
+        deployment = labels.get(colocate.LABEL_DEPLOYMENT, "")
+        self._serving_claims.add(key)
+        admitted = self.scheduler.admitted(key)
+        held = self.scheduler.claim_count(key)
+        denied = False
+        reason = message = ""
+
+        if admitted and desired < held:
+            self.scheduler.resize(key, desired)
+            held = desired
+            self.kube.record_event(
+                job.namespace, f"TPUJob/{job.name}", "ClaimShrunk",
+                f"serving claim released {held} -> {desired} slices; "
+                f"training backfills")
+        elif admitted and desired > held:
+            if decision is not None and decision.action == "admit":
+                if self.scheduler.resize(key, desired):
+                    if self.cluster is not None:
+                        # Clear the grow-delta queue entry and record
+                        # its wait in the CLI window.
+                        self.cluster.queue.note_admitted(
+                            key + colocate.GROW_SUFFIX)
+                    held = desired
+            elif decision is not None:
+                reason = decision.reason or ""
+                message = decision.message
+                denied = (decision.action == "unsatisfiable"
+                          or reason == "PreemptionRateLimited")
+        elif not admitted:
+            if decision is not None and decision.action == "admit":
+                admitted = self.scheduler.offer(
+                    key, job.slice_type, desired, queue="serving")
+                if admitted:
+                    held = desired
+                    if self.cluster is not None:
+                        self.cluster.note_admitted(
+                            key, backfilled=decision.backfilled)
+            elif decision is not None:
+                reason = decision.reason or ""
+                message = decision.message
+                denied = (decision.action == "unsatisfiable"
+                          or reason == "PreemptionRateLimited")
+            elif self.cluster is None:
+                # No policy layer: claims fall back to gang FIFO like
+                # any job (--no-scheduler operators still colocate).
+                admitted = self.scheduler.offer(
+                    key, job.slice_type, desired, queue="serving")
+                if admitted:
+                    held = desired
+
+        granted = self.scheduler.claim_count(key)
+        if deployment and granted > 0:
+            # Patch only on grant/resize; a claim pending its FIRST
+            # grant must not scale the deployment down to zero.
+            try:
+                dep = self.kube.get_deployment(job.namespace,
+                                               deployment)
+                current = int(
+                    (dep.get("spec") or {}).get("replicas", 0) or 0)
+                if current != granted:
+                    self.kube.patch_deployment_scale(
+                        job.namespace, deployment, granted)
+                    self.kube.record_event(
+                        job.namespace, f"Deployment/{deployment}",
+                        "ServingScaled",
+                        f"claim {key}: {current} -> {granted} "
+                        f"replicas")
+            except NotFound:
+                pass
+
+        pool = (self.cluster.pool_status()
+                if self.cluster is not None else None)
+        if granted >= desired and granted > 0:
+            new_phase, new_reason = JOB_RUNNING, "ClaimGranted"
+            message = f"{granted}/{desired} replicas granted"
+        elif admitted:
+            new_phase = STARTING
+            new_reason = reason or "ClaimGrowing"
+            message = message or (f"{granted}/{desired} replicas "
+                                  f"granted")
+        else:
+            new_phase = QUEUED
+            new_reason = reason or "WaitingForSlices"
+        extra: dict = {"grantedReplicas": granted, "denied": denied}
+        if pool is not None:
+            extra["pool"] = pool
+        if (phase != new_phase or status.get("reason") != new_reason
+                or int(status.get("grantedReplicas", -1) or 0)
+                != granted
+                or bool(status.get("denied")) != denied):
+            self._set_phase(cr_obj, new_phase, reason=new_reason,
+                            message=message, extra=extra)
+        elif pool is not None and status.get("pool") != pool:
+            # Pool accounting moved but the verdict didn't: refresh
+            # the stamp without minting an event per sweep.
+            new_status = dict(status)
+            new_status["pool"] = pool
+            cr_obj["status"] = new_status
+            self.kube.update_custom_status(
+                job.namespace, job.name, new_status)
+        return new_phase
+
+    def _sync_prepull(self, plan, crs: List[dict], cr_key) -> None:
+        """Speculative placement (arXiv 2010.11307): pin prepull pods
+        to the nodes of victims evicted FOR a serving claim, so the
+        replica image pull overlaps the victim's drain; retired once
+        the claim is fully granted (or its CR vanished).  Fused-gang
+        victims are skipped — their key is not a CR key, and their
+        members' pods ride the gang name."""
+        by_key = {cr_key(cr): cr for cr in crs}
+        for victim, preemptor in plan.preemptions:
+            claim_cr = by_key.get(preemptor)
+            if claim_cr is None or \
+                    not colocate.is_serving_claim_cr(claim_cr):
+                continue
+            victim_cr = by_key.get(victim)
+            if victim_cr is None:
+                continue
+            vmeta = victim_cr.get("metadata", {})
+            vns = vmeta.get("namespace", "kubeflow")
+            cmeta = claim_cr.get("metadata", {})
+            cns = cmeta.get("namespace", "kubeflow")
+            cname = cmeta.get("name", "")
+            image = (((claim_cr.get("spec") or {}).get("worker")
+                      or {}).get("image")
+                     or colocate.DEFAULT_SERVING_IMAGE)
+            nodes = set()
+            for pod in self.kube.list_pods(
+                    vns, labels={LABEL_JOB: vmeta.get("name", "")}):
+                node = (pod.get("spec") or {}).get("nodeName")
+                if node:
+                    nodes.add(node)
+            for node in sorted(nodes):
+                pod = colocate.build_prepull_pod(cns, cname, node,
+                                                 image)
+                try:
+                    self.kube.create_pod(pod)
+                except Conflict:
+                    pass
+                self._prepull.setdefault(preemptor, set()).add(
+                    (cns, pod["metadata"]["name"]))
+        for ckey in list(self._prepull):
+            claim_cr = by_key.get(ckey)
+            done = claim_cr is None
+            if not done:
+                want = int((claim_cr.get("spec") or {})
+                           .get("numSlices", 0) or 0)
+                done = self.scheduler.claim_count(ckey) >= want
+            if done:
+                for ns, name in self._prepull.pop(ckey):
+                    try:
+                        self.kube.delete_pod(ns, name)
+                    except NotFound:
+                        pass
+
     # -- fused gangs -------------------------------------------------------
 
     def _fused_gang_spec(self, job: crd.TPUJobSpec,
@@ -644,6 +856,8 @@ class TPUJobController:
             now = faults.monotonic()
             grace = (self.cluster.config.preemption.grace_period_s
                      if self.cluster is not None else 0.0)
+            if decision.grace_s >= 0:
+                grace = decision.grace_s
             deadline = self._preempt_deadline.setdefault(
                 gkey, now + grace)
             if phase != JOB_PREEMPTING:
@@ -849,6 +1063,10 @@ class TPUJobController:
         now = faults.monotonic()
         grace = (self.cluster.config.preemption.grace_period_s
                  if self.cluster is not None else 0.0)
+        if decision.grace_s >= 0:
+            # Per-victim override (scheduler/colocate.py): a serving
+            # preemptor drains its victim on the short serving grace.
+            grace = decision.grace_s
         deadline = self._preempt_deadline.get(key)
         preemptions = int(status.get("preemptions", 0))
         if deadline is None:

@@ -18,8 +18,23 @@ Layout:
     fuse.py     horizontal fusion: fold fusable singleton swarms into
                 one gang (the HFTA admission tier; runtime/hfta.py is
                 the training half)
+    colocate.py train/serve colocation: the fleet autoscaler's desired
+                replicas as a high-priority ServingClaim on the SAME
+                pool (elastic grow/shrink, short-grace preemption,
+                speculative prepull)
 """
 
+from kubeflow_tpu.scheduler.colocate import (  # noqa: F401
+    LABEL_DEPLOYMENT,
+    LABEL_WORKLOAD,
+    SERVING_PRIORITY,
+    SERVING_TENANT,
+    WORKLOAD_SERVING,
+    ServingClaimClient,
+    build_claim_cr,
+    claim_key,
+    claim_name,
+)
 from kubeflow_tpu.scheduler.fuse import (  # noqa: F401
     LABEL_FUSE_FAMILY,
     fold_pending,

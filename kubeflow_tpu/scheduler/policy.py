@@ -56,6 +56,11 @@ LABEL_PRIORITY = "kubeflow-tpu.org/priority"
 # Opt-in marker for horizontal fusion (scheduler/fuse.py): singleton
 # jobs sharing a family value assert same-architecture compatibility.
 LABEL_FUSE_FAMILY = "kubeflow-tpu.org/fuse-family"
+# Workload class of a CR: "" (ordinary training) or the values
+# scheduler/colocate.py stamps on serving claims / prepull pods.
+# Lives HERE so colocate can import it without policy ever importing
+# colocate (one-way dependency).
+LABEL_WORKLOAD = "kubeflow-tpu.org/workload"
 
 DEFAULT_TENANT = "default"
 DEFAULT_PRIORITY = "normal"
@@ -93,6 +98,9 @@ class JobView:
     members: Tuple["JobView", ...] = ()
     fused_gang: str = ""
     fused_members: int = 0
+    # Workload class (scheduler/colocate.py): "serving" marks a
+    # ServingClaim riding the TPUJob shape; "" is ordinary training.
+    workload: str = ""
 
 
 def tenant_shares(job: JobView) -> List[Tuple[str, float]]:
@@ -194,6 +202,10 @@ class Decision:
     fused_gang: str = ""
     fused_members: Tuple[str, ...] = ()
     fused_leader: bool = False
+    # Grace-window override (scheduler/colocate.py): >= 0 replaces the
+    # config grace_period_s for THIS victim — serving claims evict on
+    # the short serving grace so cold-start overlaps the drain.
+    grace_s: float = -1.0
 
 
 @dataclasses.dataclass
@@ -230,6 +242,7 @@ def job_view(cr_obj: dict, spec: Any, config: SchedulerConfig) -> JobView:
         family=labels.get(LABEL_FUSE_FAMILY, ""),
         fused_gang=str(status.get("fusedGang") or ""),
         fused_members=int(status.get("fusedMembers", 0) or 0),
+        workload=labels.get(LABEL_WORKLOAD, ""),
     )
 
 

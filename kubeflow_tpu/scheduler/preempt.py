@@ -51,6 +51,11 @@ class PreemptionConfig:
     # per sliding window_s.
     max_preemptions: int = 4
     window_s: float = 300.0
+    # Grace window when the preemptor is a SERVING claim
+    # (scheduler/colocate.py): a traffic spike cannot wait out the full
+    # training grace, and the victim's checkpoint cadence — not the
+    # window — bounds lost work, so serving evictions drain short.
+    serving_grace_period_s: float = 5.0
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "PreemptionConfig":

@@ -22,7 +22,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from kubeflow_tpu.operator import crd
 from kubeflow_tpu.runtime import tracing
-from kubeflow_tpu.scheduler import fuse
+from kubeflow_tpu.scheduler import colocate, fuse
 from kubeflow_tpu.scheduler.policy import (
     ADMIT,
     PREEMPT,
@@ -218,6 +218,13 @@ class ClusterScheduler:
                 view.fused_gang = ""   # stale stamp: gang released
                 view.enqueued_at = self.queue.touch(view)
                 pending.append(view)
+        # Train/serve colocation: split admitted serving claims into a
+        # held base view plus a pending grow-delta view BEFORE the
+        # prune (the grow key's queue entry must survive it), so the
+        # policy arbitrates the increment as ordinary high-priority
+        # demand.
+        pending, running, grow_views, serving_keys = colocate.fold(
+            pending, running, self.gang, self.queue)
         self.queue.prune([v.key for v in pending])
         # Horizontal fusion: fold compatible pending singletons into
         # one gang view, regroup admitted fused members back into
@@ -228,6 +235,19 @@ class ClusterScheduler:
         free = {t: self.gang.free(t) for t in self.gang.capacity}
         plan = self.policy.plan(pending, running, free,
                                 dict(self.gang.capacity))
+        # Merge grow verdicts onto base keys and stamp the short
+        # serving grace BEFORE mirroring, so a fused victim's members
+        # inherit the override.
+        colocated = colocate.finalize(
+            plan, grow_views, serving_keys,
+            self.config.preemption.serving_grace_period_s)
+        if colocated:
+            from kubeflow_tpu.runtime.prom import REGISTRY
+
+            REGISTRY.counter(
+                "kft_scheduler_colocation_preemptions_total",
+                "training gangs evicted for serving claims",
+            ).inc(colocated)
         fuse.mirror_decisions(plan, fused_pending + fused_running)
         with self._lock:
             self._last_plan = plan
@@ -303,6 +323,17 @@ class ClusterScheduler:
             "member jobs folded into fused gangs in the current "
             "plan").set(float(sum(len(f.members) for f in fused)))
 
+        claim = REGISTRY.gauge(
+            "kft_scheduler_serving_claim_chips",
+            "chips held by admitted serving claims")
+        for labels in claim.labelsets():
+            claim.set(0, **labels)
+        for job in running:
+            # Post-fold base views carry the HELD count (what the
+            # gang claim actually bills), not the CR's desired count.
+            if job.workload == colocate.WORKLOAD_SERVING:
+                claim.set(job.chips, claim=job.key)
+
         depth = REGISTRY.gauge(
             "kft_scheduler_queue_depth",
             "pending TPUJobs by tenant and priority class")
@@ -367,6 +398,9 @@ class ClusterScheduler:
                      if view.fused_members else view.chips)
             jobs.append({
                 "job": key,
+                "kind": ("serving-claim"
+                         if view.workload == colocate.WORKLOAD_SERVING
+                         else "train"),
                 "tenant": view.tenant,
                 "priority": view.priority,
                 "slices": f"{view.count}x{view.slice_type}",
@@ -411,4 +445,38 @@ class ClusterScheduler:
             "queue_wait": self.queue.wait_percentiles(),
             "counters": counters,
             "preemptions_in_window": self.limiter.in_window(),
+            "pool": self.pool_status(),
+        }
+
+    def pool_status(self) -> dict:
+        """Combined-pool chip accounting (train + serve on ONE
+        inventory) — the fleet status footer's data source, stamped
+        onto claim CR status by the reconciler each grant."""
+        from kubeflow_tpu.runtime.topology import parse_slice_type
+
+        capacity = used = 0
+        per_type: Dict[str, int] = {}
+        for slice_type, count in self.gang.capacity.items():
+            try:
+                per = parse_slice_type(slice_type).chips
+            except ValueError:
+                per = 0
+            per_type[slice_type] = per
+            capacity += per * count
+            used += per * (count - self.gang.free(slice_type))
+        with self._lock:
+            views = dict(self._last_views)
+        serving = 0
+        for key, view in views.items():
+            if view.workload != colocate.WORKLOAD_SERVING:
+                continue
+            held = self.gang.claim_count(key)
+            if held:
+                serving += per_type.get(view.slice_type, 0) * held
+        return {
+            "capacity_chips": capacity,
+            "used_chips": used,
+            "free_chips": capacity - used,
+            "serving_chips": serving,
+            "training_chips": used - serving,
         }

@@ -3042,6 +3042,242 @@ def hfta_smoke(namespace: str = "kubeflow-test") -> None:
                 apiserver.server_close()
 
 
+def colocation_smoke(namespace: str = "kubeflow-test") -> None:
+    """Hermetic train/serve colocation scenario (§5.13): ONE chip pool
+    under the shared arbiter, driven through the fake apiserver (real
+    sockets, HttpKube) by the REAL fleet Autoscaler in claims mode:
+
+      1. trough — zero serving load with min_replicas=0 makes no
+         claim; training owns the whole pool;
+      2. burst — scraped load spikes, the autoscaler writes a
+         2-replica claim CR (never spec.replicas), the arbiter evicts
+         the low-priority training gang on the SHORT serving grace
+         while prepull pods pin to the victim's exact nodes, and the
+         reconciler patches the Deployment only on grant;
+      3. the victim checkpoints inside the grace window and — after
+         the evening trough releases the claim (CR deleted,
+         Deployment zeroed, stale sweep frees the gang claim) — is
+         backfilled and resumes bit-identical from its latest
+         verified step, restart budget untouched;
+      4. the combined-pool snapshot rides the claim status back to
+         the ServingClaimClient (the fleet-status footer's data) and
+         every transition lands in kft_* metric deltas.
+    """
+    import tempfile
+
+    import numpy as np
+
+    from kubeflow_tpu.fleet.autoscaler import Autoscaler
+    from kubeflow_tpu.operator import crd
+    from kubeflow_tpu.operator.gang import GangScheduler
+    from kubeflow_tpu.operator.kube_http import HttpKube
+    from kubeflow_tpu.operator.reconciler import (
+        JOB_PREEMPTING,
+        JOB_RUNNING,
+        QUEUED,
+        STARTING,
+        TPUJobController,
+    )
+    from kubeflow_tpu.runtime.checkpoint import CheckpointManager
+    from kubeflow_tpu.runtime.prom import (
+        REGISTRY,
+        parse_metrics,
+        sample_value,
+    )
+    from kubeflow_tpu.scheduler import (
+        LABEL_PRIORITY,
+        LABEL_TENANT,
+        ClusterScheduler,
+        PreemptionConfig,
+        SchedulerConfig,
+        colocate,
+    )
+    from kubeflow_tpu.testing import faults
+    from kubeflow_tpu.testing.fake_apiserver import make_fake_apiserver
+
+    class ScrapedLoad:
+        """Registry stand-in: the diurnal curve the test scripts."""
+
+        def __init__(self):
+            self.load = 0.0
+
+        def total_load(self):
+            return self.load
+
+        def ready_count(self):
+            return 1
+
+    def make_train_cr(name, priority, n=1):
+        job = crd.TPUJobSpec(name=name, namespace=namespace,
+                             num_slices=n)
+        cr = job.to_custom_resource()
+        cr["metadata"]["labels"] = {LABEL_TENANT: "research",
+                                    LABEL_PRIORITY: priority}
+        return cr
+
+    apiserver = None
+    with faults.injected("seed=20260807") as inj, \
+            tempfile.TemporaryDirectory() as tmp:
+        try:
+            apiserver, _, store = make_fake_apiserver()
+            kube = HttpKube(
+                base_url=f"http://127.0.0.1:"
+                         f"{apiserver.server_address[1]}")
+            gang = GangScheduler({"v5e-8": 4})
+            cluster = ClusterScheduler(gang, SchedulerConfig(
+                preemption=PreemptionConfig(
+                    grace_period_s=30.0,
+                    serving_grace_period_s=5.0)))
+            ctl = TPUJobController(kube, gang, cluster)
+            store.create_deployment({
+                "metadata": {"name": "lm", "namespace": namespace},
+                "spec": {"replicas": 0}})
+            load = ScrapedLoad()
+            claims = colocate.ServingClaimClient(kube, namespace, "lm")
+            scaler = Autoscaler(
+                kube, namespace, "lm", load,
+                target_inflight_per_replica=4.0,
+                min_replicas=0, max_replicas=4,
+                scale_up_cooldown_s=10.0,
+                scale_down_cooldown_s=60.0,
+                claims=claims)
+
+            def statuses():
+                return {c["metadata"]["name"]: (c.get("status") or {})
+                        for c in kube.list_custom(namespace)}
+
+            # -- 1. overnight trough: training owns the pool ----------
+            out = scaler.reconcile_once()
+            assert out["desired"] == 0
+            assert out["claim"]["state"] == "released"
+            kube.create_custom(make_train_cr("night-batch", "low", n=2))
+            kube.create_custom(make_train_cr("steady", "normal", n=2))
+            ctl.reconcile_all()
+            st = statuses()
+            assert st["night-batch"]["phase"] == STARTING, st
+            assert st["steady"]["phase"] == STARTING, st
+            pool = cluster.pool_status()
+            assert pool["free_chips"] == 0
+            assert pool["training_chips"] == pool["capacity_chips"]
+            # The victim's trainer checkpoints through step 4.
+            base = np.arange(8, dtype=np.float32)
+            with CheckpointManager(f"{tmp}/night-ckpt",
+                                   save_interval_steps=1) as mgr:
+                for step in range(5):
+                    mgr.save(step,
+                             {"step": np.full((), step, np.int32),
+                              "w": base + step})
+            for i, p in enumerate(kube.list_pods(
+                    namespace,
+                    labels={"kubeflow-tpu.org/job-name":
+                            "night-batch"})):
+                store.set_pod_node(namespace, p["metadata"]["name"],
+                                   f"node-{i}")
+
+            # -- 2. morning burst: claim steals chips -----------------
+            load.load = 8.0   # ceil(8/4) = 2 replicas wanted
+            out = scaler.reconcile_once()
+            assert out["applied"] and out["desired"] == 2
+            assert out["claim"]["state"] == "pending"
+            # Desire rode the claim CR; replicas are still 0.
+            assert kube.get_deployment(
+                namespace, "lm")["spec"]["replicas"] == 0
+            ctl.reconcile_all()
+            st = statuses()
+            # Lowest-priority 2-slice gang drains; high-priority claim
+            # outranks it on the shared pool.
+            assert st["night-batch"]["phase"] == JOB_PREEMPTING, st
+            assert st["night-batch"]["resumable"] is True
+            assert st["steady"]["phase"] == STARTING, st
+            # Speculative placement: prepull pods pin the EXACT nodes
+            # the plan predicts will free, during the drain.
+            prepulls = kube.list_pods(
+                namespace,
+                labels={colocate.LABEL_WORKLOAD:
+                        colocate.WORKLOAD_PREPULL})
+            assert sorted(
+                p["spec"]["nodeName"] for p in prepulls) == \
+                ["node-0", "node-1"], prepulls
+            # SHORT serving grace: 6 s ends the drain (the 30 s
+            # training grace would still be holding it).
+            inj.advance_clock(6)
+            ctl.reconcile_all()
+            st = statuses()
+            assert st["night-batch"]["phase"] == QUEUED
+            assert st["night-batch"]["reason"] == "PreemptedRequeued"
+            ctl.reconcile_all()
+            ctl.reconcile_all()
+            st = statuses()
+            assert st["serving-lm"]["phase"] == JOB_RUNNING, st
+            assert st["serving-lm"]["grantedReplicas"] == 2
+            # The RECONCILER patched replicas on grant.
+            assert kube.get_deployment(
+                namespace, "lm")["spec"]["replicas"] == 2
+            inj.advance_clock(11)
+            out = scaler.reconcile_once()
+            assert out["claim"]["state"] == "granted"
+            # Combined-pool snapshot rode the claim status back to the
+            # client (the `fleet status` footer's data source).
+            pool = claims.pool()
+            assert pool is not None
+            assert pool["serving_chips"] == 16
+            assert pool["used_chips"] == pool["capacity_chips"]
+            # Prepull warmers retire once the claim is fully granted.
+            ctl.reconcile_all()
+            assert kube.list_pods(
+                namespace,
+                labels={colocate.LABEL_WORKLOAD:
+                        colocate.WORKLOAD_PREPULL}) == []
+
+            # -- 3. evening trough: release, backfill, resume ---------
+            load.load = 0.0
+            inj.advance_clock(120)   # past the scale-down cooldown
+            out = scaler.reconcile_once()
+            assert out["desired"] == 0
+            assert out["claim"]["state"] == "released"
+            assert kube.get_deployment(
+                namespace, "lm")["spec"]["replicas"] == 0
+            ctl.reconcile_all()   # stale sweep frees the gang claim
+            ctl.reconcile_all()   # backfill re-admits the victim
+            st = statuses()
+            assert "serving-lm" not in st
+            assert st["night-batch"]["phase"] == STARTING, st
+            assert st["night-batch"]["resumable"] is False
+            assert int(st["night-batch"]["preemptions"]) == 1
+            assert int(st["night-batch"].get("restarts", 0)) == 0, \
+                "eviction must not consume the restart budget"
+            # Bit-identical resume from the verified checkpoint.
+            fresh = {"step": np.zeros((), np.int32),
+                     "w": np.zeros(8, np.float32)}
+            with CheckpointManager(f"{tmp}/night-ckpt") as mgr2:
+                restored, start = mgr2.restore_or_init(fresh)
+            assert start == 5, f"resume restarted at {start}"
+            np.testing.assert_allclose(restored["w"], base + 4)
+
+            # -- 4. every transition is scrapeable --------------------
+            parsed = parse_metrics(REGISTRY.render())
+            assert (sample_value(
+                parsed,
+                "kft_scheduler_colocation_preemptions_total") or 0) \
+                >= 1
+            assert (sample_value(
+                parsed, "kft_autoscaler_claim_granted_total",
+                deployment="lm") or 0) >= 1
+            assert (sample_value(
+                parsed, "kft_scheduler_resumes_total",
+                tenant="research") or 0) >= 1
+            claims.close()
+            parsed = parse_metrics(REGISTRY.render())
+            assert not any(
+                v for _, v in parsed.get(
+                    "kft_scheduler_serving_claim_chips", [])), \
+                "claim gauge must read 0 after close()"
+        finally:
+            if apiserver is not None:
+                apiserver.shutdown()
+                apiserver.server_close()
+
+
 def _kubectl(args, *, input_text: str = None, timeout: int = 300) -> str:
     import subprocess
 
@@ -3168,6 +3404,7 @@ COMMANDS = {
     "train": train_smoke,
     "train_resilience": train_resilience_smoke,
     "hfta": hfta_smoke,
+    "colocation": colocation_smoke,
     "deploy": deploy_real,
     "deploy-crds": deploy_crds,
     "tpujob-real": tpujob_real,

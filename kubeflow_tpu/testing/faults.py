@@ -81,6 +81,17 @@ Hook sites planted in production code (grep for ``faults.fire``):
                       fusable queued singletons (scheduler/fuse.py;
                       raise = wedged fold — contained like a wedged
                       admission pass, members stay queued singletons)
+    scheduler.colocate
+                      each serving-claim view the colocation fold
+                      splits or admits into the shared pool
+                      (scheduler/colocate.py; raise = wedged fold —
+                      contained, the claim stays pending and training
+                      is untouched)
+    autoscaler.claim  each ServingClaimClient.sync of the desired
+                      replica count into the claim CR (raise =
+                      apiserver blip — the autoscaler loop absorbs it
+                      and the next level-triggered pass repairs;
+                      sleep = slow claim write)
     train.step        each Trainer.fit loop iteration, before the
                       dispatch (raise = step fault the supervisor
                       restarts from, skew = ages stall/backoff

@@ -136,7 +136,7 @@ PLATFORM_STEPS = {
     "hermetic": ["tpujob", "scheduler", "serving", "engine", "faults",
                  "fleet", "survivable", "kv_spill", "multichip_serving",
                  "adapter_serving", "train", "train_resilience",
-                 "hfta"],
+                 "hfta", "colocation"],
     "kind": ["deploy-crds", "tpujob-real"],
     "gke": ["deploy", "tpujob-real"],
 }

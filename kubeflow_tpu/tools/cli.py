@@ -210,6 +210,16 @@ def cmd_fleet_status(args: argparse.Namespace) -> int:
             print(f"retry budget: {tokens:.1f}/{cap:.0f} tokens; "
                   f"replay cap {payload.get('max_replays', '-')} "
                   f"per request")
+        # Shared train/serve chip pool (scheduler/colocate.py): the
+        # arbiter's snapshot rides the serving claim's status back to
+        # the router.  Only colocation-mode routers report it.
+        pool = payload.get("pool")
+        if pool:
+            print(f"pool: {pool.get('used_chips', 0)}/"
+                  f"{pool.get('capacity_chips', 0)} chips used "
+                  f"({pool.get('serving_chips', 0)} serving, "
+                  f"{pool.get('training_chips', 0)} training, "
+                  f"{pool.get('free_chips', 0)} free)")
     return 0
 
 
@@ -227,8 +237,9 @@ def cmd_queue_status(args: argparse.Namespace) -> int:
     if not jobs:
         print("queue empty: no live TPUJobs")
     else:
-        fmt = "{:<28} {:<12} {:<8} {:>10} {:>6} {:>7} {:<20} {:>8}"
-        print(fmt.format("JOB", "TENANT", "PRIORITY", "SLICES",
+        fmt = ("{:<28} {:<14} {:<12} {:<8} {:>10} {:>6} {:>7} {:<20}"
+               " {:>8}")
+        print(fmt.format("JOB", "KIND", "TENANT", "PRIORITY", "SLICES",
                          "CHIPS", "MEMBERS", "STATE", "WAIT_S"))
         for row in jobs:
             wait = row.get("wait_s")
@@ -237,8 +248,12 @@ def cmd_queue_status(args: argparse.Namespace) -> int:
                                                       "Preempting"):
                 state += "*"  # resumable: restarts from checkpoint
             # A fused member's CHIPS is its billed SHARE of the gang
-            # slice (scheduler/fuse.py) — possibly fractional.
-            print(fmt.format(row["job"], row["tenant"], row["priority"],
+            # slice (scheduler/fuse.py) — possibly fractional.  KIND
+            # separates training gangs from the fleet autoscaler's
+            # serving claims on the same pool (scheduler/colocate.py);
+            # pre-colocation operators report no kind -> "train".
+            print(fmt.format(row["job"], row.get("kind", "train"),
+                             row["tenant"], row["priority"],
                              row["slices"], f"{row['chips']:g}",
                              row.get("members") or "-", state,
                              f"{wait:.1f}" if wait is not None else "-"))

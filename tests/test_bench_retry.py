@@ -144,7 +144,8 @@ def test_soft_deadline_skips_tail_but_prints_headline(monkeypatch, capsys):
         raise AssertionError("sub-bench ran past the deadline")
 
     for name in ("bench_lm", "bench_serving", "bench_lm_decode",
-                 "bench_lm_engine", "bench_data"):
+                 "bench_lm_engine", "bench_data", "bench_hfta",
+                 "bench_colocation"):
         monkeypatch.setattr(bench, name, boom)
     monkeypatch.setattr(
         bench, "acquire_devices",
@@ -156,7 +157,7 @@ def test_soft_deadline_skips_tail_but_prints_headline(monkeypatch, capsys):
     assert record["metric"] == "resnet50_images_per_sec_per_chip"
     assert set(record["detail"]["skipped_sub_benches"]) == {
         "lm", "lm_moe", "serving", "lm_decode", "lm_decode_int8",
-        "lm_engine", "data"}
+        "lm_engine", "data", "hfta", "colocation"}
 
 
 def _both_result():

@@ -124,6 +124,11 @@ def test_queue_status_renders_scheduler_table(capsys):
              "state": "Admitted", "detail": "", "position": None,
              "wait_s": None, "resumable": False, "preemptions": 0,
              "members": 4},
+            {"job": "kubeflow/serving-lm", "kind": "serving-claim",
+             "tenant": "fleet", "priority": "high",
+             "slices": "2xv5e-8", "chips": 16, "state": "Admitted",
+             "detail": "", "position": None, "wait_s": None,
+             "resumable": False, "preemptions": 0},
         ],
         "quotas": [{"tenant": "batch", "slice_type": "v5e-8",
                     "used_chips": 16, "quota_chips": 16}],
@@ -155,14 +160,21 @@ def test_queue_status_renders_scheduler_table(capsys):
         out = capsys.readouterr().out
         assert "kubeflow/train-a" in out and "Admitted" in out
         assert "MEMBERS" in out
+        # KIND column (§5.13): rows without a kind are training jobs
+        # from pre-colocation operators; serving claims are labeled.
+        assert "KIND" in out
         # The fused member row bills its SHARE of the gang slice and
         # shows the gang width; singletons render "-".
         sweep = next(ln for ln in out.splitlines()
                      if "kubeflow/sweep-3" in ln)
-        assert sweep.split()[4:6] == ["2", "4"]
+        assert sweep.split()[1] == "train"
+        assert sweep.split()[5:7] == ["2", "4"]
         solo = next(ln for ln in out.splitlines()
                     if "kubeflow/train-a" in ln)
-        assert solo.split()[4:6] == ["16", "-"]
+        assert solo.split()[5:7] == ["16", "-"]
+        claim = next(ln for ln in out.splitlines()
+                     if "kubeflow/serving-lm" in ln)
+        assert claim.split()[1:4] == ["serving-claim", "fleet", "high"]
         # The resumable queued job is marked: it restarts from its
         # checkpoint, not step 0.
         assert "QuotaExceeded*" in out
@@ -191,7 +203,10 @@ def test_fleet_status_renders_endpoint_table(capsys):
              "breaker_state": "half_open"}]
     payload = {"endpoints": rows,
                "retry_budget": {"tokens": 7.4, "cap": 10.0},
-               "max_replays": 2}
+               "max_replays": 2,
+               "pool": {"capacity_chips": 32, "used_chips": 24,
+                        "free_chips": 8, "serving_chips": 8,
+                        "training_chips": 16}}
 
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, *a):
@@ -223,6 +238,10 @@ def test_fleet_status_renders_endpoint_table(capsys):
         # Router-wide failover budget footer.
         assert "retry budget: 7.4/10 tokens" in out
         assert "replay cap 2" in out
+        # Combined train/serve pool footer (§5.13) — only reported by
+        # colocation-mode routers.
+        assert "pool: 24/32 chips used" in out
+        assert "(8 serving, 16 training, 8 free)" in out
     finally:
         httpd.shutdown()
 

@@ -1363,6 +1363,145 @@ class TestAutoscaler:
         assert kube.get_deployment("kf", "srv")["spec"]["replicas"] == 5
 
 
+class TestAutoscalerClaims:
+    """Colocation mode (scheduler/colocate.py): desire flows into the
+    serving claim CR; ``spec.replicas`` belongs to the arbiter's
+    reconciler, never to the autoscaler."""
+
+    def _deployment(self, kube, replicas=1):
+        kube.create_deployment({
+            "metadata": {"namespace": "kf", "name": "srv"},
+            "spec": {"replicas": replicas}})
+
+    def _scaler(self, kube, reg, **kw):
+        from kubeflow_tpu.scheduler.colocate import ServingClaimClient
+
+        kw.setdefault("claims", ServingClaimClient(kube, "kf", "srv"))
+        kw.setdefault("target_inflight_per_replica", 4.0)
+        kw.setdefault("min_replicas", 1)
+        kw.setdefault("max_replicas", 8)
+        return Autoscaler(kube, "kf", "srv", reg, **kw)
+
+    def test_desire_rides_claim_cr_not_spec_replicas(self):
+        kube = FakeKube()
+        self._deployment(kube, 1)
+        reg = TestAutoscaler._FixedLoad(20.0)
+        with faults.injected("seed=0"):
+            out = self._scaler(kube, reg).reconcile_once()
+        assert out["applied"] and out["desired"] == 5
+        assert out["claim"]["state"] == "pending"
+        cr = kube.get_custom("kf", "serving-srv")
+        assert cr["spec"]["numSlices"] == 5
+        assert cr["metadata"]["labels"][
+            "kubeflow-tpu.org/workload"] == "serving"
+        # spec.replicas untouched: the reconciler patches on GRANT.
+        assert kube.get_deployment("kf", "srv")["spec"]["replicas"] == 1
+
+    def test_scale_to_zero_releases_whole_claim(self):
+        from kubeflow_tpu.operator.kube import NotFound
+
+        kube = FakeKube()
+        self._deployment(kube, 2)
+        reg = TestAutoscaler._FixedLoad(8.0)
+        with faults.injected("seed=0") as inj:
+            scaler = self._scaler(kube, reg, min_replicas=0)
+            scaler.reconcile_once()
+            assert kube.get_custom("kf", "serving-srv")
+            reg.load = 0.0
+            inj.advance_clock(120)
+            out = scaler.reconcile_once()
+        assert out["desired"] == 0
+        assert out["claim"]["state"] == "released"
+        # The trough hands every chip back: claim CR gone, and the
+        # deployment zeroed directly (release needs no arbitration).
+        with pytest.raises(NotFound):
+            kube.get_custom("kf", "serving-srv")
+        assert kube.get_deployment("kf", "srv")["spec"]["replicas"] == 0
+
+    def test_hysteresis_band_never_flaps_claim(self):
+        """Load wobbling inside the tolerance band must not churn the
+        claim CR (each churn is a delete+create the arbiter re-plans)
+        nor mint scale events."""
+        from kubeflow_tpu.runtime.prom import (
+            REGISTRY,
+            parse_metrics,
+            sample_value,
+        )
+
+        class CountingKube(FakeKube):
+            creates = 0
+
+            def create_custom(self, cr):
+                self.creates += 1
+                return super().create_custom(cr)
+
+        kube = CountingKube()
+        self._deployment(kube, 2)
+        reg = TestAutoscaler._FixedLoad(8.0, ready=2)
+        with faults.injected("seed=0") as inj:
+            scaler = self._scaler(kube, reg, tolerance=0.2)
+            scaler.reconcile_once()   # steady state: claim at 2
+            assert kube.creates == 1
+            before = sample_value(
+                parse_metrics(REGISTRY.render()),
+                "kft_autoscaler_scale_events_total", direction="up")
+            for load in (9.0, 7.0, 9.5, 6.5):   # inside the band
+                reg.load = load
+                inj.advance_clock(120)   # cooldowns can't be the gate
+                out = scaler.reconcile_once()
+                assert not out["applied"]
+        assert kube.creates == 1   # synced every pass, churned never
+        assert kube.get_custom("kf", "serving-srv")[
+            "spec"]["numSlices"] == 2
+        after = sample_value(
+            parse_metrics(REGISTRY.render()),
+            "kft_autoscaler_scale_events_total", direction="up")
+        assert after == before
+
+    def test_denied_claim_reported_and_counted(self):
+        from kubeflow_tpu.runtime.prom import (
+            REGISTRY,
+            parse_metrics,
+            sample_value,
+        )
+
+        kube = FakeKube()
+        self._deployment(kube, 1)
+        reg = TestAutoscaler._FixedLoad(20.0)
+        with faults.injected("seed=0") as inj:
+            scaler = self._scaler(kube, reg)
+            scaler.reconcile_once()
+            # The arbiter's verdict comes back on the claim status.
+            kube.update_custom_status(
+                "kf", "serving-srv",
+                {"grantedReplicas": 0, "denied": True})
+            inj.advance_clock(11)   # past the up-cooldown: desire holds
+            out = scaler.reconcile_once()
+        assert out["claim"]["state"] == "denied"
+        assert kube.get_deployment("kf", "srv")["spec"]["replicas"] == 1
+        assert sample_value(
+            parse_metrics(REGISTRY.render()),
+            "kft_autoscaler_claim_denied_total", deployment="srv") >= 1
+
+    def test_no_colocation_flag_restores_legacy_direct_patch(self):
+        """--no-colocation (fleet/main.py) builds no claim client;
+        claims=None is the legacy path — the autoscaler patches
+        spec.replicas itself."""
+        from kubeflow_tpu.fleet.main import build_parser
+
+        args = build_parser().parse_args(["--no-colocation"])
+        assert args.no_colocation is True
+        assert build_parser().parse_args([]).no_colocation is False
+        kube = FakeKube()
+        self._deployment(kube, 1)
+        reg = TestAutoscaler._FixedLoad(20.0)
+        with faults.injected("seed=0"):
+            out = self._scaler(kube, reg, claims=None).reconcile_once()
+        assert out["applied"] and "claim" not in out
+        assert kube.get_deployment("kf", "srv")["spec"]["replicas"] == 5
+        assert not kube.list_custom()
+
+
 class TestSnapshotLockDiscipline:
     """PR-8 lock-guard audit regressions: every field a status/stats
     snapshot reads must be read under the same lock the writer holds

@@ -31,6 +31,7 @@ from kubeflow_tpu.scheduler import (
     PreemptionRateLimiter,
     SchedulerConfig,
     SchedulingPolicy,
+    colocate,
     fuse,
     pick_victims,
     tenant_shares,
@@ -783,3 +784,248 @@ class TestFusedGangs:
         completed = [e for e in kube.events
                      if e["reason"] == "FusedMemberCompleted"]
         assert len(completed) == 3
+
+
+class TestColocation:
+    """Train/serve colocation (scheduler/colocate.py): the serving
+    Deployment's desired replicas as a high-priority claim on the
+    SAME pool the training scheduler arbitrates."""
+
+    def _mk(self, capacity=4, train_grace=30.0, serving_grace=5.0):
+        kube = FakeKube()
+        kube.create_deployment({
+            "metadata": {"name": "lm", "namespace": "kubeflow"},
+            "spec": {"replicas": 0}})
+        gang = GangScheduler({"v5e-8": capacity})
+        sched = ClusterScheduler(gang, SchedulerConfig(
+            preemption=PreemptionConfig(
+                grace_period_s=train_grace,
+                serving_grace_period_s=serving_grace)))
+        return kube, gang, sched, TPUJobController(kube, gang, sched)
+
+    def test_claim_admits_and_reconciler_patches_deployment(self):
+        kube, gang, sched, ctl = self._mk()
+        kube.create_custom(colocate.build_claim_cr(
+            "kubeflow", "lm", replicas=2))
+        ctl.reconcile_all()
+        st = phases_by_name(kube)
+        assert st["serving-lm"]["phase"] == JOB_RUNNING
+        assert st["serving-lm"]["reason"] == "ClaimGranted"
+        assert st["serving-lm"]["grantedReplicas"] == 2
+        # The RECONCILER patches replicas on grant — chips are held
+        # before a replica rollout, never after.
+        dep = kube.get_deployment("kubeflow", "lm")
+        assert dep["spec"]["replicas"] == 2
+        rows = {r["job"]: r for r in sched.status()["jobs"]}
+        assert rows["kubeflow/serving-lm"]["kind"] == "serving-claim"
+        assert rows["kubeflow/serving-lm"]["tenant"] == "fleet"
+        pool = sched.status()["pool"]
+        assert pool["capacity_chips"] == 32
+        assert pool["serving_chips"] == 16
+        assert pool["free_chips"] == 16
+
+    def test_burst_preempts_training_on_short_grace(self):
+        """A growing claim evicts low-priority training under the
+        ordinary contract but with serving_grace_period_s — 6 s of
+        clock skew ends the drain where the 30 s training grace would
+        still be holding it."""
+        kube, gang, sched, ctl = self._mk()
+        with faults.injected("seed=1") as inj:
+            for i in range(4):
+                kube.create_custom(make_cr(f"low{i}", priority="low"))
+            ctl.reconcile_all()
+            kube.create_custom(colocate.build_claim_cr(
+                "kubeflow", "lm", replicas=1))
+            ctl.reconcile_all()
+            st = phases_by_name(kube)
+            victims = [n for n in st
+                       if st[n]["phase"] == JOB_PREEMPTING]
+            assert len(victims) == 1
+            victim = victims[0]
+            assert st[victim]["resumable"] is True
+            inj.advance_clock(6)   # > serving grace, << training grace
+            ctl.reconcile_all()
+            st = phases_by_name(kube)
+            assert st[victim]["phase"] == QUEUED
+            assert st[victim]["reason"] == "PreemptedRequeued"
+            # Eviction consumed no restart budget.
+            assert int(st[victim].get("restarts", 0)) == 0
+            ctl.reconcile_all()
+            st = phases_by_name(kube)
+            assert st["serving-lm"]["phase"] == JOB_RUNNING
+            assert kube.get_deployment(
+                "kubeflow", "lm")["spec"]["replicas"] == 1
+
+    def test_grow_delta_competes_and_resizes_in_place(self):
+        """Desired outgrowing the held claim queues only the DELTA;
+        on grant the gang claim resizes — never a release/re-admit
+        flap of the already-held slices."""
+        kube, gang, sched, ctl = self._mk()
+        with faults.injected("seed=1") as inj:
+            kube.create_custom(colocate.build_claim_cr(
+                "kubeflow", "lm", replicas=1))
+            for i in range(3):
+                kube.create_custom(make_cr(f"low{i}", priority="low"))
+            ctl.reconcile_all()
+            assert gang.claim_count("kubeflow/serving-lm") == 1
+            # The autoscaler path: desired jumps to 3 (delete+create,
+            # the CR API has no spec patch).
+            client = colocate.ServingClaimClient(kube, "kubeflow", "lm")
+            client.sync(3)
+            ctl.reconcile_all()
+            st = phases_by_name(kube)
+            victims = [n for n in st
+                       if st[n]["phase"] == JOB_PREEMPTING]
+            assert len(victims) == 2
+            # Mid-grace the claim still HOLDS its base slice.
+            assert gang.claim_count("kubeflow/serving-lm") == 1
+            assert st["serving-lm"]["phase"] == STARTING
+            assert st["serving-lm"]["reason"] in (
+                "ClaimGrowing", "WaitingForPreemption")
+            inj.advance_clock(6)
+            ctl.reconcile_all()
+            ctl.reconcile_all()
+            assert gang.claim_count("kubeflow/serving-lm") == 3
+            assert kube.get_deployment(
+                "kubeflow", "lm")["spec"]["replicas"] == 3
+            assert client.observe()["state"] == "granted"
+
+    def test_shrink_releases_and_training_backfills(self):
+        kube, gang, sched, ctl = self._mk()
+        kube.create_custom(colocate.build_claim_cr(
+            "kubeflow", "lm", replicas=3))
+        ctl.reconcile_all()
+        for i in range(3):
+            kube.create_custom(make_cr(f"t{i}", priority="low"))
+        ctl.reconcile_all()
+        st = phases_by_name(kube)
+        admitted = [n for n in st if st[n].get("phase") == STARTING
+                    and n.startswith("t")]
+        assert len(admitted) == 1   # only 1 free slice
+        colocate.ServingClaimClient(kube, "kubeflow", "lm").sync(1)
+        ctl.reconcile_all()   # shrink releases, backfill same sweep
+        ctl.reconcile_all()
+        st = phases_by_name(kube)
+        assert gang.claim_count("kubeflow/serving-lm") == 1
+        assert all(st[f"t{i}"]["phase"] == STARTING for i in range(3))
+        assert kube.get_deployment(
+            "kubeflow", "lm")["spec"]["replicas"] == 1
+        shrunk = [e for e in kube.events
+                  if e["reason"] == "ClaimShrunk"]
+        assert shrunk
+
+    def test_scale_to_zero_deletes_claim_and_releases_chips(self):
+        kube, gang, sched, ctl = self._mk()
+        kube.create_custom(colocate.build_claim_cr(
+            "kubeflow", "lm", replicas=2))
+        ctl.reconcile_all()
+        assert gang.admitted("kubeflow/serving-lm")
+        client = colocate.ServingClaimClient(kube, "kubeflow", "lm")
+        out = client.sync(0)
+        assert out["state"] == "released"
+        # The trough hands the deployment straight to zero (no
+        # arbitration needed to RELEASE chips)...
+        assert kube.get_deployment(
+            "kubeflow", "lm")["spec"]["replicas"] == 0
+        # ...and the reconciler's stale sweep frees the gang claim.
+        ctl.reconcile_all()
+        assert not gang.admitted("kubeflow/serving-lm")
+        for i in range(4):
+            kube.create_custom(make_cr(f"t{i}", priority="low"))
+        ctl.reconcile_all()
+        st = phases_by_name(kube)
+        assert all(st[f"t{i}"]["phase"] == STARTING for i in range(4))
+
+    def test_prepull_pods_pin_victim_nodes_then_retire(self):
+        """Speculative placement: the sweep that starts a victim's
+        drain drops prepull pods on its nodes; full grant retires
+        them."""
+        kube, gang, sched, ctl = self._mk()
+        with faults.injected("seed=1") as inj:
+            for i in range(4):
+                kube.create_custom(make_cr(f"low{i}", priority="low"))
+            ctl.reconcile_all()
+            for i in range(4):
+                for p in kube.list_pods(
+                        "kubeflow",
+                        labels={"kubeflow-tpu.org/job-name": f"low{i}"}):
+                    kube.set_pod_node("kubeflow",
+                                      p["metadata"]["name"],
+                                      f"node-{i}")
+            kube.create_custom(colocate.build_claim_cr(
+                "kubeflow", "lm", replicas=1))
+            ctl.reconcile_all()
+            st = phases_by_name(kube)
+            victim = [n for n in st
+                      if st[n]["phase"] == JOB_PREEMPTING][0]
+            vnode = f"node-{victim[-1]}"
+            prepulls = kube.list_pods(
+                "kubeflow",
+                labels={colocate.LABEL_WORKLOAD:
+                        colocate.WORKLOAD_PREPULL})
+            assert [p["spec"]["nodeName"] for p in prepulls] == [vnode]
+            # Requests nothing: a warmer can never steal the slice.
+            assert prepulls[0]["spec"]["containers"][0][
+                "resources"] == {}
+            inj.advance_clock(6)
+            ctl.reconcile_all()
+            ctl.reconcile_all()
+            assert phases_by_name(kube)["serving-lm"]["phase"] == \
+                JOB_RUNNING
+            # Retirement is level-triggered: the sweep AFTER the full
+            # grant sees claim_count >= desired and reaps the warmers.
+            ctl.reconcile_all()
+            assert kube.list_pods(
+                "kubeflow",
+                labels={colocate.LABEL_WORKLOAD:
+                        colocate.WORKLOAD_PREPULL}) == []
+
+    def test_colocation_metrics_exported(self):
+        from kubeflow_tpu.runtime.prom import (
+            REGISTRY,
+            parse_metrics,
+            sample_value,
+        )
+
+        kube, gang, sched, ctl = self._mk()
+        with faults.injected("seed=1") as inj:
+            for i in range(4):
+                kube.create_custom(make_cr(f"low{i}", priority="low"))
+            ctl.reconcile_all()
+            parsed = parse_metrics(REGISTRY.render())
+            before = sample_value(
+                parsed, "kft_scheduler_colocation_preemptions_total"
+            ) or 0
+            kube.create_custom(colocate.build_claim_cr(
+                "kubeflow", "lm", replicas=1))
+            ctl.reconcile_all()
+            inj.advance_clock(6)
+            ctl.reconcile_all()
+            ctl.reconcile_all()
+            # Gauges export at PLAN time: one more sweep sees the
+            # admitted claim in its running set.
+            ctl.reconcile_all()
+            parsed = parse_metrics(REGISTRY.render())
+            assert sample_value(
+                parsed, "kft_scheduler_colocation_preemptions_total"
+            ) == before + 1
+            assert sample_value(
+                parsed, "kft_scheduler_serving_claim_chips",
+                claim="kubeflow/serving-lm") == 8
+
+    def test_fold_and_claim_sync_are_fault_sites(self):
+        kube, gang, sched, ctl = self._mk()
+        kube.create_custom(colocate.build_claim_cr(
+            "kubeflow", "lm", replicas=1))
+        with faults.injected("scheduler.colocate:raise"):
+            ctl.reconcile_all()   # wedged fold = wedged plan pass,
+        st = phases_by_name(kube)  # contained: claim stays un-admitted
+        assert st.get("serving-lm", {}).get("phase") in (None, QUEUED)
+        assert not gang.admitted("kubeflow/serving-lm")
+        client = colocate.ServingClaimClient(kube, "kubeflow", "lm")
+        with faults.injected("autoscaler.claim:raise"):
+            with pytest.raises(faults.FaultInjected):
+                client.sync(2)
+        ctl.reconcile_all()
+        assert phases_by_name(kube)["serving-lm"]["phase"] == \
+            JOB_RUNNING

@@ -6,6 +6,7 @@ import pytest
 
 from kubeflow_tpu.testing.e2e import (
     adapter_serving_smoke,
+    colocation_smoke,
     engine_smoke,
     fault_injection_smoke,
     fleet_smoke,
@@ -181,6 +182,18 @@ class TestE2EDrivers:
         # steps monotone and params bit-identical to an uninterrupted
         # control (see kubeflow_tpu/testing/e2e.py hfta_smoke).
         hfta_smoke()
+
+    def test_colocation_smoke(self):
+        # The ci/e2e_config.yaml hermetic `colocation` step: the real
+        # fleet Autoscaler in claims mode over the fake apiserver —
+        # a scripted diurnal burst writes a serving claim that evicts
+        # low-priority training on the SHORT serving grace (prepull
+        # pods pinned to the victim's nodes), the reconciler patches
+        # the Deployment only on grant, and the evening trough's
+        # released chips backfill the victim, which resumes
+        # bit-identical from its verified checkpoint (see
+        # kubeflow_tpu/testing/e2e.py colocation_smoke).
+        colocation_smoke()
 
 
 class _FakeKubectl:
